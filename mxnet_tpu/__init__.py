@@ -1,0 +1,70 @@
+"""mxnet_tpu: a TPU-native deep learning framework.
+
+A brand-new framework with the capabilities of pre-Gluon MXNet 0.9 (the
+reference described in SURVEY.md), designed TPU-first on JAX/XLA: imperative
+NDArray + symbolic Symbol/Executor over one operator registry, a Module
+training layer, KVStore-style data parallelism lowered to XLA collectives over
+a device mesh, and lax.scan RNNs. Importable as ``mx`` for script parity:
+
+    import mxnet_tpu as mx
+    x = mx.nd.ones((2, 3), ctx=mx.tpu())
+"""
+__version__ = "0.1.0"
+
+from . import base
+from .base import MXNetError
+from .context import Context, cpu, gpu, tpu, current_context, num_gpus, num_tpus
+from . import ndarray
+from . import ndarray as nd
+from . import random
+from . import random as rnd
+from . import ops
+
+__all__ = [
+    "MXNetError",
+    "Context",
+    "cpu",
+    "gpu",
+    "tpu",
+    "current_context",
+    "nd",
+    "ndarray",
+    "random",
+    "ops",
+]
+
+
+def __getattr__(name):
+    # lazy subsystem imports keep `import mxnet_tpu` light and avoid cycles
+    import importlib
+
+    lazy = {
+        "sym": ".symbol",
+        "symbol": ".symbol",
+        "executor": ".executor",
+        "mod": ".module",
+        "module": ".module",
+        "io": ".io",
+        "optimizer": ".optimizer",
+        "lr_scheduler": ".lr_scheduler",
+        "metric": ".metric",
+        "initializer": ".initializer",
+        "init": ".initializer",
+        "kvstore": ".kvstore",
+        "kv": ".kvstore",
+        "callback": ".callback",
+        "monitor": ".monitor",
+        "rnn": ".rnn",
+        "model": ".model",
+        "autograd": ".autograd",
+        "parallel": ".parallel",
+        "test_utils": ".test_utils",
+        "visualization": ".visualization",
+        "viz": ".visualization",
+        "profiler": ".profiler",
+        "recordio": ".recordio",
+        "models": ".models",
+    }
+    if name in lazy:
+        return importlib.import_module(lazy[name], __name__)
+    raise AttributeError("module %r has no attribute %r" % (__name__, name))

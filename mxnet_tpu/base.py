@@ -1,0 +1,68 @@
+"""Base utilities: dtypes, errors, naming.
+
+TPU-native replacement for the ctypes plumbing in the reference's
+``python/mxnet/base.py``. There is no C ABI boundary here — the Python layer
+talks straight to JAX — so this module only keeps the pieces of ``base.py``
+that are API surface: ``MXNetError``, dtype name<->numpy mapping
+(reference: python/mxnet/ndarray.py:36-52 ``_DTYPE_NP_TO_MX``), and name
+mangling helpers.
+"""
+from __future__ import annotations
+
+import numpy as np
+
+__all__ = ["MXNetError", "string_types", "numeric_types"]
+
+
+class MXNetError(Exception):
+    """Error raised by the framework (reference: python/mxnet/base.py MXNetError)."""
+
+
+string_types = (str,)
+numeric_types = (float, int, np.generic)
+
+# dtype code table, numerically compatible with the reference's
+# _DTYPE_NP_TO_MX / _DTYPE_MX_TO_NP (python/mxnet/ndarray.py:36-52) so that
+# serialized .params files round-trip.
+_DTYPE_NP_TO_MX = {
+    np.dtype(np.float32): 0,
+    np.dtype(np.float64): 1,
+    np.dtype(np.float16): 2,
+    np.dtype(np.uint8): 3,
+    np.dtype(np.int32): 4,
+    # TPU-native extensions (codes unused by the reference)
+    np.dtype(np.int64): 6,
+    np.dtype(np.bool_): 7,
+}
+_DTYPE_MX_TO_NP = {v: k for k, v in _DTYPE_NP_TO_MX.items()}
+
+try:  # bfloat16 is the TPU-native compute dtype; register if available
+    import ml_dtypes  # noqa: F401
+
+    _BFLOAT16 = np.dtype(ml_dtypes.bfloat16)
+    _DTYPE_NP_TO_MX[_BFLOAT16] = 5
+    _DTYPE_MX_TO_NP[5] = _BFLOAT16
+except ImportError:  # pragma: no cover
+    _BFLOAT16 = None
+
+
+def np_dtype(dtype) -> np.dtype:
+    """Normalize a user-provided dtype (str, np type, jnp dtype) to np.dtype."""
+    if dtype is None:
+        return np.dtype(np.float32)
+    if isinstance(dtype, str) and dtype == "bfloat16" and _BFLOAT16 is not None:
+        return _BFLOAT16
+    return np.dtype(dtype)
+
+
+def dtype_code(dtype) -> int:
+    d = np_dtype(dtype)
+    if d not in _DTYPE_NP_TO_MX:
+        raise MXNetError("unsupported dtype %s" % d)
+    return _DTYPE_NP_TO_MX[d]
+
+
+def dtype_from_code(code: int) -> np.dtype:
+    if code not in _DTYPE_MX_TO_NP:
+        raise MXNetError("unsupported dtype code %d" % code)
+    return _DTYPE_MX_TO_NP[code]

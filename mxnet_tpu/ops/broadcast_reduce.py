@@ -1,0 +1,146 @@
+"""Broadcasting binary ops and reductions.
+
+Covers the reference's src/operator/tensor/elemwise_binary_broadcast_op*.cc and
+broadcast_reduce_op_{value,index}.{cc,cu} (registration macros at
+broadcast_reduce_op.h:615-643). Reductions map to jnp reductions which XLA
+lowers to tiled tree-reductions on the VPU — the hand-written
+broadcast_reduce-inl.cuh kernels have no TPU analogue to write.
+"""
+from __future__ import annotations
+
+import jax.numpy as jnp
+import numpy as np
+
+from .registry import AttrSpec, register
+
+_B2 = ("lhs", "rhs")
+
+_BCAST = {
+    "broadcast_add": jnp.add,
+    "broadcast_sub": jnp.subtract,
+    "broadcast_mul": jnp.multiply,
+    "broadcast_div": jnp.divide,
+    "broadcast_mod": jnp.mod,
+    "broadcast_power": jnp.power,
+    "broadcast_maximum": jnp.maximum,
+    "broadcast_minimum": jnp.minimum,
+    "broadcast_hypot": jnp.hypot,
+    "broadcast_equal": lambda a, b: (a == b).astype(a.dtype),
+    "broadcast_not_equal": lambda a, b: (a != b).astype(a.dtype),
+    "broadcast_greater": lambda a, b: (a > b).astype(a.dtype),
+    "broadcast_greater_equal": lambda a, b: (a >= b).astype(a.dtype),
+    "broadcast_lesser": lambda a, b: (a < b).astype(a.dtype),
+    "broadcast_lesser_equal": lambda a, b: (a <= b).astype(a.dtype),
+}
+_BCAST_ALIASES = {
+    "broadcast_add": ("broadcast_plus",),
+    "broadcast_sub": ("broadcast_minus",),
+}
+for _name, _f in _BCAST.items():
+
+    def _fn(attrs, lhs, rhs, _f=_f):
+        return _f(lhs, rhs)
+
+    register(_name, input_names=_B2, aliases=_BCAST_ALIASES.get(_name, ()))(_fn)
+
+
+@register("broadcast_to", attrs={"shape": AttrSpec("shape", default=())})
+def _broadcast_to(attrs, data):
+    """Broadcast to target shape; 0 in shape keeps the input dim (reference:
+    broadcast_reduce_op.h BroadcastTo)."""
+    tgt = tuple(
+        int(s) if int(s) != 0 else int(d) for s, d in zip(attrs["shape"], data.shape)
+    )
+    return jnp.broadcast_to(data, tgt)
+
+
+@register(
+    "broadcast_axis",
+    attrs={"axis": AttrSpec("shape", default=()), "size": AttrSpec("shape", default=())},
+    aliases=("broadcast_axes",),
+)
+def _broadcast_axis(attrs, data):
+    tgt = list(data.shape)
+    for ax, sz in zip(attrs["axis"], attrs["size"]):
+        tgt[ax] = sz
+    return jnp.broadcast_to(data, tuple(tgt))
+
+
+def _norm_axis(axis, ndim):
+    if axis is None or axis == ():
+        return None
+    if isinstance(axis, int):
+        axis = (axis,)
+    return tuple(a % ndim for a in axis)
+
+
+_RED_ATTRS = lambda: {
+    "axis": AttrSpec("shape", default=()),
+    "keepdims": AttrSpec("bool", default=False),
+    "exclude": AttrSpec("bool", default=False),
+}
+
+
+def _resolve_axis(attrs, ndim):
+    ax = _norm_axis(attrs.get("axis", ()), ndim)
+    if attrs.get("exclude"):
+        ax = tuple(i for i in range(ndim) if ax is None or i not in ax)
+    return ax
+
+
+def _reg_reduce(name, f, aliases=()):
+    def fn(attrs, data, _f=f):
+        return _f(data, axis=_resolve_axis(attrs, data.ndim), keepdims=bool(attrs.get("keepdims", False)))
+
+    fn.__doc__ = "Reduce-%s over the given axes (reference: broadcast_reduce_op_value.cc)." % name
+    register(name, attrs=_RED_ATTRS(), aliases=aliases)(fn)
+
+
+_reg_reduce("sum", jnp.sum, aliases=("sum_axis",))
+_reg_reduce("mean", jnp.mean)
+_reg_reduce("prod", jnp.prod)
+_reg_reduce("nansum", jnp.nansum)
+_reg_reduce("nanprod", jnp.nanprod)
+_reg_reduce("max", jnp.max, aliases=("max_axis",))
+_reg_reduce("min", jnp.min, aliases=("min_axis",))
+
+
+@register("norm")
+def _norm(attrs, data):
+    """L2 norm of the whole array (reference: broadcast_reduce_op_value.cc norm)."""
+    return jnp.sqrt(jnp.sum(jnp.square(data.astype(jnp.float32)))).astype(data.dtype)
+
+
+def _argminmax(attrs, data, f):
+    ax = attrs.get("axis", None)
+    keepdims = bool(attrs.get("keepdims", False))
+    if ax is None or ax == ():
+        out = f(data.reshape(-1), axis=0)
+        return out.astype(jnp.float32)
+    ax = int(ax) if not isinstance(ax, tuple) else int(ax[0])
+    out = f(data, axis=ax)
+    if keepdims:
+        out = jnp.expand_dims(out, ax)
+    return out.astype(jnp.float32)
+
+
+_ARG_ATTRS = lambda: {
+    "axis": AttrSpec("any", default=None),
+    "keepdims": AttrSpec("bool", default=False),
+}
+
+
+@register("argmax", attrs=_ARG_ATTRS())
+def _argmax(attrs, data):
+    return _argminmax(attrs, data, jnp.argmax)
+
+
+@register("argmin", attrs=_ARG_ATTRS())
+def _argmin(attrs, data):
+    return _argminmax(attrs, data, jnp.argmin)
+
+
+@register("argmax_channel")
+def _argmax_channel(attrs, data):
+    """argmax over axis 1 (reference: broadcast_reduce_op_index.cc)."""
+    return jnp.argmax(data, axis=1).astype(jnp.float32)

@@ -1,0 +1,232 @@
+"""Elementwise, scalar, logic ops.
+
+Covers the reference's src/operator/tensor/elemwise_binary_op_basic.cc (+_extended,
+_logic), elemwise_unary_op.{h,cc}, elemwise_binary_scalar_op_*. Each op is a thin
+pure-JAX function; XLA fuses chains of these into single kernels, which replaces
+the reference's mshadow expression templates (elemwise_binary_op.h:18-33).
+"""
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from .registry import AttrSpec, register
+
+_B2 = ("lhs", "rhs")
+
+
+def _reg_binary(name, f, aliases=()):
+    def fn(attrs, lhs, rhs, _f=f):
+        return _f(lhs, rhs)
+
+    fn.__doc__ = "Elementwise %s (same-shape; see broadcast_%s for broadcasting)." % (name, name)
+    register(name, input_names=_B2, aliases=aliases)(fn)
+
+
+def _reg_unary(name, f, aliases=()):
+    def fn(attrs, data, _f=f):
+        return _f(data)
+
+    fn.__doc__ = "Elementwise %s." % name
+    register(name, aliases=aliases)(fn)
+
+
+def _reg_scalar(name, f, aliases=()):
+    specs = {"scalar": AttrSpec("float", required=True)}
+
+    def fn(attrs, data, _f=f):
+        return _f(data, jnp.asarray(attrs["scalar"], dtype=data.dtype))
+
+    register(name, attrs=specs, aliases=aliases)(fn)
+
+
+# --- binary (reference: elemwise_binary_op_basic.cc:11-78, _extended, _logic) ---
+_gelu = None
+_BINARY = {
+    "elemwise_add": jnp.add,
+    "elemwise_sub": jnp.subtract,
+    "elemwise_mul": jnp.multiply,
+    "elemwise_div": jnp.divide,
+    "_grad_add": jnp.add,  # gradient accumulation add (reference :18)
+    "_power": jnp.power,
+    "_maximum": jnp.maximum,
+    "_minimum": jnp.minimum,
+    "_hypot": jnp.hypot,
+    "_equal": lambda a, b: (a == b).astype(a.dtype),
+    "_not_equal": lambda a, b: (a != b).astype(a.dtype),
+    "_greater": lambda a, b: (a > b).astype(a.dtype),
+    "_greater_equal": lambda a, b: (a >= b).astype(a.dtype),
+    "_lesser": lambda a, b: (a < b).astype(a.dtype),
+    "_lesser_equal": lambda a, b: (a <= b).astype(a.dtype),
+    "_mod": jnp.mod,
+}
+_BINARY_ALIASES = {
+    "elemwise_add": ("_add", "_plus", "_Plus"),
+    "elemwise_sub": ("_sub", "_minus", "_Minus"),
+    "elemwise_mul": ("_mul", "_Mul"),
+    "elemwise_div": ("_div", "_Div"),
+    "_power": ("_Power", "_pow"),
+    "_maximum": ("_Maximum",),
+    "_minimum": ("_Minimum",),
+    "_hypot": ("_Hypot",),
+    "_equal": ("_Equal", "_eq"),
+    "_not_equal": ("_Not_Equal", "_ne"),
+    "_greater": ("_Greater", "_gt"),
+    "_greater_equal": ("_Greater_Equal", "_ge"),
+    "_lesser": ("_Lesser", "_lt"),
+    "_lesser_equal": ("_Lesser_Equal", "_le"),
+    "_mod": ("_Mod",),
+}
+for _n, _f in _BINARY.items():
+    _reg_binary(_n, _f, aliases=_BINARY_ALIASES.get(_n, ()))
+
+
+# --- unary (reference: elemwise_unary_op.cc, ~39 ops) -------------------------
+def _softrelu(x):
+    return jnp.logaddexp(x, 0.0)
+
+
+_UNARY = {
+    "abs": jnp.abs,
+    "sign": jnp.sign,
+    "round": jnp.round,
+    "rint": jnp.rint,
+    "ceil": jnp.ceil,
+    "floor": jnp.floor,
+    "fix": jnp.trunc,
+    "trunc": jnp.trunc,
+    "square": jnp.square,
+    "sqrt": jnp.sqrt,
+    "rsqrt": lambda x: jax.lax.rsqrt(x),
+    "cbrt": jnp.cbrt,
+    "rcbrt": lambda x: 1.0 / jnp.cbrt(x),
+    "exp": jnp.exp,
+    "log": jnp.log,
+    "log10": jnp.log10,
+    "log2": jnp.log2,
+    "log1p": jnp.log1p,
+    "expm1": jnp.expm1,
+    "sin": jnp.sin,
+    "cos": jnp.cos,
+    "tan": jnp.tan,
+    "arcsin": jnp.arcsin,
+    "arccos": jnp.arccos,
+    "arctan": jnp.arctan,
+    "sinh": jnp.sinh,
+    "cosh": jnp.cosh,
+    "tanh": jnp.tanh,
+    "arcsinh": jnp.arcsinh,
+    "arccosh": jnp.arccosh,
+    "arctanh": jnp.arctanh,
+    "degrees": jnp.degrees,
+    "radians": jnp.radians,
+    "negative": jnp.negative,
+    "reciprocal": lambda x: 1.0 / x,
+    "relu": lambda x: jnp.maximum(x, 0),
+    "sigmoid": jax.nn.sigmoid,
+    "softsign": lambda x: x / (1 + jnp.abs(x)),
+    "gamma": lambda x: jnp.exp(jax.lax.lgamma(x)),
+    "gammaln": lambda x: jax.lax.lgamma(x),
+    "erf": jax.lax.erf,
+    "logical_not": lambda x: (x == 0).astype(x.dtype),
+}
+for _n, _f in _UNARY.items():
+    _reg_unary(_n, _f)
+
+register("_copy", aliases=("identity", "_identity_with_attr_like_rhs"))(
+    lambda attrs, data, *rest: data
+)
+register("BlockGrad", aliases=("stop_gradient", "make_no_grad"))(
+    lambda attrs, data: jax.lax.stop_gradient(data)
+)
+register("_CrossDeviceCopy", aliases=("_copyto",))(lambda attrs, data: data)
+
+
+@register("Cast", attrs={"dtype": AttrSpec("dtype", required=True)}, aliases=("cast",))
+def _cast(attrs, data):
+    """Cast to a new dtype (reference: elemwise_unary_op.cc Cast)."""
+    return data.astype(attrs["dtype"])
+
+
+@register(
+    "clip",
+    attrs={"a_min": AttrSpec("float", required=True), "a_max": AttrSpec("float", required=True)},
+)
+def _clip(attrs, data):
+    return jnp.clip(data, attrs["a_min"], attrs["a_max"])
+
+
+# --- scalar ops (reference: elemwise_binary_scalar_op_*.cc) -------------------
+_SCALAR = {
+    "_plus_scalar": lambda x, s: x + s,
+    "_minus_scalar": lambda x, s: x - s,
+    "_rminus_scalar": lambda x, s: s - x,
+    "_mul_scalar": lambda x, s: x * s,
+    "_div_scalar": lambda x, s: x / s,
+    "_rdiv_scalar": lambda x, s: s / x,
+    "_power_scalar": lambda x, s: jnp.power(x, s),
+    "_rpower_scalar": lambda x, s: jnp.power(s, x),
+    "_maximum_scalar": lambda x, s: jnp.maximum(x, s),
+    "_minimum_scalar": lambda x, s: jnp.minimum(x, s),
+    "_hypot_scalar": lambda x, s: jnp.hypot(x, s),
+    "_mod_scalar": lambda x, s: jnp.mod(x, s),
+    "_rmod_scalar": lambda x, s: jnp.mod(s, x),
+    "_equal_scalar": lambda x, s: (x == s).astype(x.dtype),
+    "_not_equal_scalar": lambda x, s: (x != s).astype(x.dtype),
+    "_greater_scalar": lambda x, s: (x > s).astype(x.dtype),
+    "_greater_equal_scalar": lambda x, s: (x >= s).astype(x.dtype),
+    "_lesser_scalar": lambda x, s: (x < s).astype(x.dtype),
+    "_lesser_equal_scalar": lambda x, s: (x <= s).astype(x.dtype),
+}
+_SCALAR_ALIASES = {
+    "_plus_scalar": ("_PlusScalar",),
+    "_minus_scalar": ("_MinusScalar",),
+    "_rminus_scalar": ("_RMinusScalar",),
+    "_mul_scalar": ("_MulScalar",),
+    "_div_scalar": ("_DivScalar",),
+    "_rdiv_scalar": ("_RDivScalar",),
+    "_power_scalar": ("_PowerScalar",),
+    "_rpower_scalar": ("_RPowerScalar",),
+    "_maximum_scalar": ("_MaximumScalar",),
+    "_minimum_scalar": ("_MinimumScalar",),
+    "_hypot_scalar": ("_HypotScalar",),
+    "_equal_scalar": ("_EqualScalar",),
+    "_not_equal_scalar": ("_NotEqualScalar",),
+    "_greater_scalar": ("_GreaterScalar",),
+    "_greater_equal_scalar": ("_GreaterEqualScalar",),
+    "_lesser_scalar": ("_LesserScalar",),
+    "_lesser_equal_scalar": ("_LesserEqualScalar",),
+}
+for _n, _f in _SCALAR.items():
+    _reg_scalar(_n, _f, aliases=_SCALAR_ALIASES.get(_n, ()))
+
+
+@register(
+    "smooth_l1",
+    attrs={"scalar": AttrSpec("float", default=1.0)},
+)
+def _smooth_l1(attrs, data):
+    """Smooth L1 (reference: elemwise_binary_scalar_op_extended.cc smooth_l1)."""
+    s2 = attrs["scalar"] ** 2
+    a = jnp.abs(data)
+    return jnp.where(a < 1.0 / s2, 0.5 * s2 * jnp.square(data), a - 0.5 / s2)
+
+
+def _n_args_names(attrs):
+    n = int(attrs.get("num_args", 1))
+    return ["arg%d" % i for i in range(n)]
+
+
+@register(
+    "add_n",
+    attrs={"num_args": AttrSpec("int", required=True)},
+    input_names=_n_args_names,
+    aliases=("ElementWiseSum", "_sum"),
+)
+def _add_n(attrs, *args):
+    """Sum of N arrays (reference: ElementwiseSum, src/ndarray/ndarray.cc:302)."""
+    out = args[0]
+    for a in args[1:]:
+        out = out + a
+    return out

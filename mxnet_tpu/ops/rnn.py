@@ -1,0 +1,179 @@
+"""Fused multi-layer RNN op.
+
+TPU-native replacement for the reference's cuDNN-backed ``RNN`` operator
+(src/operator/rnn.cc:34, cudnn_rnn-inl.h): the whole sequence runs inside one
+``lax.scan`` per layer, so XLA compiles a single fused loop with the per-step
+gate matmuls batched onto the MXU. Weight layout matches FusedRNNCell packing
+(python/mxnet/rnn/rnn_cell.py:497): per layer (and per direction), i2h_weight
+then h2h_weight; all biases after all weights (i2h_bias, h2h_bias per
+layer/direction). Gate order: LSTM [i, f, c, o]; GRU [r, z, n].
+
+Data layout (seq_len, batch, input) — the reference's default TNC.
+"""
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from ..base import MXNetError
+from .registry import AttrSpec, register
+
+
+def _gates(mode):
+    return {"rnn_relu": 1, "rnn_tanh": 1, "lstm": 4, "gru": 3}[mode]
+
+
+def rnn_param_size(num_layers, input_size, state_size, bidirectional, mode):
+    """Total number of elements in the packed parameter vector."""
+    g = _gates(mode)
+    d = 2 if bidirectional else 1
+    size = 0
+    for layer in range(num_layers):
+        in_sz = input_size if layer == 0 else state_size * d
+        size += d * g * state_size * (in_sz + state_size)  # weights
+    size += num_layers * d * 2 * g * state_size  # biases
+    return size
+
+
+def _unpack_params(params, num_layers, input_size, state_size, bidirectional, mode):
+    """Slice the flat parameter vector into per-layer/direction (Wx, Wh, bx, bh)."""
+    g = _gates(mode)
+    d = 2 if bidirectional else 1
+    out = []
+    off = 0
+    for layer in range(num_layers):
+        in_sz = input_size if layer == 0 else state_size * d
+        layer_ws = []
+        for _ in range(d):
+            wx = params[off : off + g * state_size * in_sz].reshape(g * state_size, in_sz)
+            off += g * state_size * in_sz
+            wh = params[off : off + g * state_size * state_size].reshape(g * state_size, state_size)
+            off += g * state_size * state_size
+            layer_ws.append([wx, wh])
+        out.append(layer_ws)
+    for layer in range(num_layers):
+        for di in range(d):
+            bx = params[off : off + g * state_size]
+            off += g * state_size
+            bh = params[off : off + g * state_size]
+            off += g * state_size
+            out[layer][di].extend([bx, bh])
+    return out
+
+
+def _cell_step(mode, state_size):
+    H = state_size
+
+    if mode == "lstm":
+
+        def step(carry, x_t, wx, wh, bx, bh):
+            h, c = carry
+            z = x_t @ wx.T + h @ wh.T + bx + bh
+            i = jax.nn.sigmoid(z[:, :H])
+            f = jax.nn.sigmoid(z[:, H : 2 * H])
+            gg = jnp.tanh(z[:, 2 * H : 3 * H])
+            o = jax.nn.sigmoid(z[:, 3 * H :])
+            c_new = f * c + i * gg
+            h_new = o * jnp.tanh(c_new)
+            return (h_new, c_new), h_new
+
+    elif mode == "gru":
+
+        def step(carry, x_t, wx, wh, bx, bh):
+            (h,) = carry
+            zx = x_t @ wx.T + bx
+            zh = h @ wh.T + bh
+            r = jax.nn.sigmoid(zx[:, :H] + zh[:, :H])
+            z = jax.nn.sigmoid(zx[:, H : 2 * H] + zh[:, H : 2 * H])
+            n = jnp.tanh(zx[:, 2 * H :] + r * zh[:, 2 * H :])
+            h_new = (1 - z) * n + z * h
+            return (h_new,), h_new
+
+    else:
+        act = jnp.maximum if mode == "rnn_relu" else None
+
+        def step(carry, x_t, wx, wh, bx, bh):
+            (h,) = carry
+            z = x_t @ wx.T + h @ wh.T + bx + bh
+            h_new = jnp.maximum(z, 0) if mode == "rnn_relu" else jnp.tanh(z)
+            return (h_new,), h_new
+
+    return step
+
+
+def _run_layer(mode, state_size, x, h0, c0, wx, wh, bx, bh, reverse=False):
+    step = _cell_step(mode, state_size)
+    carry0 = (h0, c0) if mode == "lstm" else (h0,)
+
+    def scan_fn(carry, x_t):
+        return step(carry, x_t, wx, wh, bx, bh)
+
+    carry, ys = jax.lax.scan(scan_fn, carry0, x, reverse=reverse)
+    return carry, ys
+
+
+def _rnn_names(attrs):
+    names = ["data", "parameters", "state"]
+    if attrs.get("mode") == "lstm":
+        names.append("state_cell")
+    return names
+
+
+def _rnn_nout(attrs):
+    if not attrs.get("state_outputs"):
+        return 1
+    return 3 if attrs.get("mode") == "lstm" else 2
+
+
+@register(
+    "RNN",
+    attrs={
+        "state_size": AttrSpec("int", required=True),
+        "num_layers": AttrSpec("int", required=True),
+        "bidirectional": AttrSpec("bool", default=False),
+        "mode": AttrSpec("str", required=True),
+        "p": AttrSpec("float", default=0.0),
+        "state_outputs": AttrSpec("bool", default=False),
+    },
+    input_names=_rnn_names,
+    num_outputs=_rnn_nout,
+    output_names=lambda a: ["output", "state_output", "statecell_output"][: _rnn_nout(a)],
+    needs_rng=True,
+    needs_train_flag=True,
+)
+def _rnn(attrs, data, parameters, state, state_cell=None, is_train=False, rng=None):
+    mode = attrs["mode"]
+    H = attrs["state_size"]
+    L = attrs["num_layers"]
+    bidir = bool(attrs["bidirectional"])
+    d = 2 if bidir else 1
+    T, N, I = data.shape
+    layers = _unpack_params(parameters, L, I, H, bidir, mode)
+
+    x = data
+    h_out, c_out = [], []
+    for layer in range(L):
+        if is_train and attrs["p"] > 0 and layer > 0 and rng is not None:
+            rng, sub = jax.random.split(rng)
+            keep = 1.0 - attrs["p"]
+            mask = jax.random.bernoulli(sub, keep, x.shape)
+            x = jnp.where(mask, x / keep, 0.0).astype(x.dtype)
+        dir_outs = []
+        for di in range(d):
+            wx, wh, bx, bh = layers[layer][di]
+            h0 = state[layer * d + di]
+            c0 = state_cell[layer * d + di] if mode == "lstm" else None
+            carry, ys = _run_layer(mode, H, x, h0, c0, wx, wh, bx, bh, reverse=(di == 1))
+            dir_outs.append(ys)
+            h_out.append(carry[0])
+            if mode == "lstm":
+                c_out.append(carry[1])
+        x = dir_outs[0] if d == 1 else jnp.concatenate(dir_outs, axis=-1)
+
+    outs = [x]
+    if attrs["state_outputs"]:
+        outs.append(jnp.stack(h_out, axis=0))
+        if mode == "lstm":
+            outs.append(jnp.stack(c_out, axis=0))
+    return tuple(outs) if len(outs) > 1 else outs[0]

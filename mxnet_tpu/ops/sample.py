@@ -1,0 +1,106 @@
+"""Random sampling ops.
+
+Covers the reference's src/operator/tensor/sample_op.* (uniform, normal, gamma,
+exponential, poisson, negative_binomial, generalized_negative_binomial). The
+reference draws from a per-device mshadow::Random resource
+(ResourceRequest::kRandom, include/mxnet/resource.h:20-25); here every sampler
+takes a JAX PRNG key threaded by the dispatch layer — functional, reproducible,
+and SPMD-safe (keys can be split per mesh shard).
+"""
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from .registry import AttrSpec, register
+
+
+def _sample_attrs(**extra):
+    base = {
+        "shape": AttrSpec("shape", default=()),
+        "dtype": AttrSpec("dtype", default=np.float32),
+        "ctx": AttrSpec("str", default=""),
+    }
+    base.update(extra)
+    return base
+
+
+def _reg_sampler(name, attr_extra, draw, aliases=()):
+    def fn(attrs, rng=None):
+        shape = tuple(attrs["shape"]) or (1,)
+        dtype = attrs["dtype"]
+        if rng is None:
+            rng = jax.random.PRNGKey(np.random.randint(0, 2**31 - 1))
+        return draw(rng, shape, dtype, attrs)
+
+    fn.__doc__ = "Draw samples (reference: tensor/sample_op.cc %s)." % name
+    register(
+        name, attrs=_sample_attrs(**attr_extra), input_names=(), needs_rng=True, aliases=aliases
+    )(fn)
+
+
+_reg_sampler(
+    "uniform",
+    {"low": AttrSpec("float", default=0.0), "high": AttrSpec("float", default=1.0)},
+    lambda k, s, d, a: jax.random.uniform(k, s, dtype=d, minval=a["low"], maxval=a["high"]),
+    aliases=("_sample_uniform", "random_uniform"),
+)
+_reg_sampler(
+    "normal",
+    {"loc": AttrSpec("float", default=0.0), "scale": AttrSpec("float", default=1.0)},
+    lambda k, s, d, a: a["loc"] + a["scale"] * jax.random.normal(k, s, dtype=d),
+    aliases=("_sample_normal", "random_normal"),
+)
+_reg_sampler(
+    "gamma",
+    {"alpha": AttrSpec("float", default=1.0), "beta": AttrSpec("float", default=1.0)},
+    lambda k, s, d, a: a["beta"] * jax.random.gamma(k, a["alpha"], s, dtype=d),
+    aliases=("_sample_gamma",),
+)
+_reg_sampler(
+    "exponential",
+    {"lam": AttrSpec("float", default=1.0)},
+    lambda k, s, d, a: jax.random.exponential(k, s, dtype=d) / a["lam"],
+    aliases=("_sample_exponential",),
+)
+_reg_sampler(
+    "poisson",
+    {"lam": AttrSpec("float", default=1.0)},
+    lambda k, s, d, a: jax.random.poisson(k, a["lam"], s).astype(d),
+    aliases=("_sample_poisson",),
+)
+
+
+def _neg_binomial(k, s, d, a):
+    kk, p = a["k"], a["p"]
+    k1, k2 = jax.random.split(k)
+    lam = jax.random.gamma(k1, kk, s) * (1.0 - p) / p
+    return jax.random.poisson(k2, lam, s).astype(d)
+
+
+_reg_sampler(
+    "negative_binomial",
+    {"k": AttrSpec("int", default=1), "p": AttrSpec("float", default=1.0)},
+    _neg_binomial,
+    aliases=("_sample_negbinomial",),
+)
+
+
+def _gen_neg_binomial(k, s, d, a):
+    mu, alpha = a["mu"], a["alpha"]
+    if alpha <= 0:
+        return jax.random.poisson(k, mu, s).astype(d)
+    k1, k2 = jax.random.split(k)
+    r = 1.0 / alpha
+    p = r / (r + mu)
+    lam = jax.random.gamma(k1, r, s) * (1.0 - p) / p
+    return jax.random.poisson(k2, lam, s).astype(d)
+
+
+_reg_sampler(
+    "generalized_negative_binomial",
+    {"mu": AttrSpec("float", default=1.0), "alpha": AttrSpec("float", default=1.0)},
+    _gen_neg_binomial,
+    aliases=("_sample_gennegbinomial",),
+)

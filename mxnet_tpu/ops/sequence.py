@@ -1,0 +1,58 @@
+"""Sequence ops for padded RNN batches.
+
+Covers the reference's src/operator/sequence_{last,mask,reverse}.{cc,cu}.
+Data layout (max_seq_len, batch, ...) with optional per-sample
+sequence_length vector, matching the reference's SequenceXxxParam.
+"""
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+from .registry import AttrSpec, register
+
+
+def _seq_names(attrs):
+    return ["data", "sequence_length"] if attrs.get("use_sequence_length") else ["data"]
+
+
+_SEQ_ATTRS = lambda: {"use_sequence_length": AttrSpec("bool", default=False)}
+
+
+@register("SequenceLast", attrs=_SEQ_ATTRS(), input_names=_seq_names)
+def _sequence_last(attrs, data, sequence_length=None):
+    if not attrs["use_sequence_length"] or sequence_length is None:
+        return data[-1]
+    idx = (sequence_length.astype(jnp.int32) - 1).clip(0, data.shape[0] - 1)
+    return jnp.take_along_axis(
+        data, idx.reshape((1, -1) + (1,) * (data.ndim - 2)), axis=0
+    )[0]
+
+
+@register(
+    "SequenceMask",
+    attrs={
+        "use_sequence_length": AttrSpec("bool", default=False),
+        "value": AttrSpec("float", default=0.0),
+    },
+    input_names=_seq_names,
+)
+def _sequence_mask(attrs, data, sequence_length=None):
+    if not attrs["use_sequence_length"] or sequence_length is None:
+        return data
+    steps = jnp.arange(data.shape[0])
+    mask = steps[:, None] < sequence_length.astype(jnp.int32)[None, :]
+    mask = mask.reshape(mask.shape + (1,) * (data.ndim - 2))
+    return jnp.where(mask, data, attrs["value"]).astype(data.dtype)
+
+
+@register("SequenceReverse", attrs=_SEQ_ATTRS(), input_names=_seq_names)
+def _sequence_reverse(attrs, data, sequence_length=None):
+    if not attrs["use_sequence_length"] or sequence_length is None:
+        return jnp.flip(data, axis=0)
+    T = data.shape[0]
+    lengths = sequence_length.astype(jnp.int32)
+    steps = jnp.arange(T)[:, None]
+    src = jnp.where(steps < lengths[None, :], lengths[None, :] - 1 - steps, steps)
+    src = src.reshape((T,) + lengths.shape + (1,) * (data.ndim - 2))
+    return jnp.take_along_axis(data, jnp.broadcast_to(src, data.shape), axis=0)

@@ -1,0 +1,56 @@
+"""Random number API.
+
+Replaces the reference's python/mxnet/random.py + per-device mshadow::Random
+resources (src/resource.cc:144 ResourceRandom). State is a single JAX PRNG key
+split per draw — functional and reproducible across backends, unlike the
+stateful per-device generators of the reference.
+"""
+from __future__ import annotations
+
+import numpy as np
+
+__all__ = ["seed", "uniform", "normal"]
+
+_KEY = None
+
+
+def _next_key():
+    global _KEY
+    import jax
+
+    if _KEY is None:
+        _KEY = jax.random.PRNGKey(np.random.randint(0, 2**31 - 1))
+    _KEY, sub = jax.random.split(_KEY)
+    return sub
+
+
+def seed(seed_state: int):
+    """Seed the global generator (reference: mx.random.seed → MXRandomSeed)."""
+    global _KEY
+    import jax
+
+    _KEY = jax.random.PRNGKey(int(seed_state))
+    np.random.seed(int(seed_state) & 0x7FFFFFFF)
+
+
+def uniform(low=0.0, high=1.0, shape=(1,), ctx=None, dtype=np.float32, out=None):
+    from .ndarray import imperative_invoke
+    from .context import current_context
+
+    return imperative_invoke(
+        "uniform",
+        [],
+        {"low": low, "high": high, "shape": shape, "dtype": dtype},
+        ctx=ctx or current_context(),
+        out=out,
+    )[0] if out is None else imperative_invoke(
+        "uniform", [], {"low": low, "high": high, "shape": shape, "dtype": dtype}, ctx=ctx, out=out
+    )[0]
+
+
+def normal(loc=0.0, scale=1.0, shape=(1,), ctx=None, dtype=np.float32, out=None):
+    from .ndarray import imperative_invoke
+    from .context import current_context
+
+    attrs = {"loc": loc, "scale": scale, "shape": shape, "dtype": dtype}
+    return imperative_invoke("normal", [], attrs, ctx=ctx or current_context(), out=out)[0]

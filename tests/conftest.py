@@ -1,0 +1,16 @@
+"""Test configuration: run everything on a virtual 8-device CPU mesh.
+
+The reference tests multi-device logic on CPU by mapping ctx groups to
+mx.cpu(0)/mx.cpu(1) (SURVEY.md §4 "multi-device-without-GPUs trick"). The JAX
+equivalent is --xla_force_host_platform_device_count: 8 virtual CPU devices,
+so sharding/collective paths compile and run without TPU hardware. Must be set
+before jax is imported anywhere.
+"""
+import os
+
+os.environ["JAX_PLATFORMS"] = "cpu"  # force: the session env presets the TPU platform
+os.environ["MXNET_DEFAULT_CONTEXT"] = "cpu"  # default ctx → virtual CPU devices
+flags = os.environ.get("XLA_FLAGS", "")
+if "xla_force_host_platform_device_count" not in flags:
+    os.environ["XLA_FLAGS"] = (flags + " --xla_force_host_platform_device_count=8").strip()
+os.environ.setdefault("JAX_ENABLE_X64", "0")

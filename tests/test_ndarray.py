@@ -1,0 +1,193 @@
+"""Imperative NDArray tests, modeled on the reference's
+tests/python/unittest/test_ndarray.py (numpy as the oracle)."""
+import numpy as np
+import pytest
+
+import mxnet_tpu as mx
+
+
+def test_creation():
+    a = mx.nd.zeros((3, 4))
+    assert a.shape == (3, 4)
+    assert a.dtype == np.float32
+    assert np.allclose(a.asnumpy(), 0)
+    b = mx.nd.ones((2, 2), dtype=np.float16)
+    assert b.dtype == np.float16
+    c = mx.nd.full((2, 3), 7.5)
+    assert np.allclose(c.asnumpy(), 7.5)
+    d = mx.nd.array([[1, 2], [3, 4]])
+    assert d.shape == (2, 2)
+    assert d.dtype == np.float32
+    e = mx.nd.array(np.array([1, 2], dtype=np.int32))
+    assert e.dtype == np.int32
+    f = mx.nd.arange(0, 10, 2)
+    assert np.allclose(f.asnumpy(), [0, 2, 4, 6, 8])
+
+
+def test_elementwise_binary():
+    rng = np.random.RandomState(0)
+    x = rng.rand(4, 5).astype(np.float32)
+    y = rng.rand(4, 5).astype(np.float32)
+    a, b = mx.nd.array(x), mx.nd.array(y)
+    assert np.allclose((a + b).asnumpy(), x + y, rtol=1e-5)
+    assert np.allclose((a - b).asnumpy(), x - y, rtol=1e-5)
+    assert np.allclose((a * b).asnumpy(), x * y, rtol=1e-5)
+    assert np.allclose((a / b).asnumpy(), x / y, rtol=1e-5)
+    assert np.allclose((a + 2.0).asnumpy(), x + 2, rtol=1e-5)
+    assert np.allclose((2.0 - a).asnumpy(), 2 - x, rtol=1e-5)
+    assert np.allclose((a**2).asnumpy(), x**2, rtol=1e-5)
+    assert np.allclose((-a).asnumpy(), -x, rtol=1e-5)
+
+
+def test_comparisons():
+    x = np.array([[1, 2], [3, 4]], dtype=np.float32)
+    a = mx.nd.array(x)
+    assert np.allclose((a > 2).asnumpy(), (x > 2).astype(np.float32))
+    assert np.allclose((a == 3).asnumpy(), (x == 3).astype(np.float32))
+
+
+def test_inplace_ops():
+    a = mx.nd.ones((2, 3))
+    a += 1
+    assert np.allclose(a.asnumpy(), 2)
+    a *= 3
+    assert np.allclose(a.asnumpy(), 6)
+    a /= 2
+    assert np.allclose(a.asnumpy(), 3)
+
+
+def test_unary_ops():
+    x = np.random.RandomState(1).rand(3, 3).astype(np.float32) + 0.1
+    a = mx.nd.array(x)
+    assert np.allclose(mx.nd.sqrt(a).asnumpy(), np.sqrt(x), rtol=1e-5)
+    assert np.allclose(mx.nd.exp(a).asnumpy(), np.exp(x), rtol=1e-5)
+    assert np.allclose(mx.nd.log(a).asnumpy(), np.log(x), rtol=1e-5)
+    assert np.allclose(mx.nd.square(a).asnumpy(), x * x, rtol=1e-5)
+
+
+def test_reductions():
+    x = np.random.RandomState(2).rand(3, 4, 5).astype(np.float32)
+    a = mx.nd.array(x)
+    assert np.allclose(mx.nd.sum(a).asnumpy(), x.sum(), rtol=1e-4)
+    assert np.allclose(mx.nd.sum(a, axis=1).asnumpy(), x.sum(axis=1), rtol=1e-4)
+    assert np.allclose(a.sum(axis=(0, 2)).asnumpy(), x.sum(axis=(0, 2)), rtol=1e-4)
+    assert np.allclose(mx.nd.max(a, axis=0).asnumpy(), x.max(axis=0))
+    assert np.allclose(mx.nd.argmax(a, axis=1).asnumpy(), x.argmax(axis=1))
+
+
+def test_dot():
+    rng = np.random.RandomState(3)
+    x = rng.rand(4, 5).astype(np.float32)
+    y = rng.rand(5, 6).astype(np.float32)
+    out = mx.nd.dot(mx.nd.array(x), mx.nd.array(y))
+    assert np.allclose(out.asnumpy(), x.dot(y), rtol=1e-4)
+    xt = rng.rand(5, 4).astype(np.float32)
+    out = mx.nd.dot(mx.nd.array(xt), mx.nd.array(y), transpose_a=True)
+    assert np.allclose(out.asnumpy(), xt.T.dot(y), rtol=1e-4)
+
+
+def test_reshape_and_views():
+    a = mx.nd.arange(0, 12).reshape((3, 4))
+    assert a.shape == (3, 4)
+    b = a.reshape((4, 3))
+    assert b.shape == (4, 3)
+    # reshape is a view: writes through
+    b[:] = 0
+    assert np.allclose(a.asnumpy(), 0)
+
+
+def test_slice_view_write_through():
+    a = mx.nd.zeros((4, 3))
+    s = a[1:3]
+    assert s.shape == (2, 3)
+    s[:] = 5
+    expect = np.zeros((4, 3), np.float32)
+    expect[1:3] = 5
+    assert np.allclose(a.asnumpy(), expect)
+    a[0] = 9
+    expect[0] = 9
+    assert np.allclose(a.asnumpy(), expect)
+    row = a[2]
+    assert row.shape == (3,)
+    assert np.allclose(row.asnumpy(), 5)
+
+
+def test_setitem_array():
+    a = mx.nd.zeros((3, 2))
+    a[1] = np.array([1.0, 2.0])
+    assert np.allclose(a.asnumpy()[1], [1, 2])
+    a[:] = np.ones((3, 2))
+    assert np.allclose(a.asnumpy(), 1)
+
+
+def test_copyto_astype():
+    a = mx.nd.ones((2, 2))
+    b = mx.nd.zeros((2, 2))
+    a.copyto(b)
+    assert np.allclose(b.asnumpy(), 1)
+    c = a.astype(np.float16)
+    assert c.dtype == np.float16
+    d = a.as_in_context(mx.cpu(1))
+    assert d.context == mx.cpu(1)
+
+
+def test_broadcast_ops():
+    x = np.random.rand(3, 1).astype(np.float32)
+    y = np.random.rand(1, 4).astype(np.float32)
+    out = mx.nd.broadcast_add(mx.nd.array(x), mx.nd.array(y))
+    assert np.allclose(out.asnumpy(), x + y, rtol=1e-5)
+    out = mx.nd.broadcast_to(mx.nd.array(x), shape=(3, 4))
+    assert out.shape == (3, 4)
+
+
+def test_concat_split():
+    x = np.random.rand(2, 3).astype(np.float32)
+    y = np.random.rand(2, 3).astype(np.float32)
+    out = mx.nd.concatenate([mx.nd.array(x), mx.nd.array(y)], axis=0)
+    assert np.allclose(out.asnumpy(), np.concatenate([x, y], 0))
+    parts = mx.nd.SliceChannel(mx.nd.array(x), num_outputs=3, axis=1)
+    assert len(parts) == 3
+    assert parts[0].shape == (2, 1)
+
+
+def test_save_load(tmp_path):
+    fname = str(tmp_path / "arrs.params")
+    d = {"w": mx.nd.ones((2, 3)), "b": mx.nd.arange(0, 4)}
+    mx.nd.save(fname, d)
+    loaded = mx.nd.load(fname)
+    assert set(loaded.keys()) == {"w", "b"}
+    assert np.allclose(loaded["w"].asnumpy(), 1)
+    assert np.allclose(loaded["b"].asnumpy(), [0, 1, 2, 3])
+    lst = [mx.nd.zeros((2,))]
+    mx.nd.save(fname, lst)
+    loaded = mx.nd.load(fname)
+    assert isinstance(loaded, list) and len(loaded) == 1
+
+
+def test_wait_and_scalar():
+    a = mx.nd.ones((1,))
+    a.wait_to_read()
+    assert a.asscalar() == 1.0
+    mx.nd.waitall()
+
+
+def test_take_onehot():
+    w = np.random.rand(10, 4).astype(np.float32)
+    idx = np.array([1, 3, 5], dtype=np.float32)
+    out = mx.nd.Embedding(mx.nd.array(idx), mx.nd.array(w), input_dim=10, output_dim=4)
+    assert np.allclose(out.asnumpy(), w[[1, 3, 5]])
+    oh = mx.nd.one_hot(mx.nd.array(idx), depth=10)
+    assert oh.shape == (3, 10)
+    assert np.allclose(oh.asnumpy().argmax(1), [1, 3, 5])
+
+
+def test_random_ops():
+    mx.random.seed(42)
+    a = mx.random.uniform(0, 1, shape=(1000,))
+    m = a.asnumpy().mean()
+    assert 0.4 < m < 0.6
+    b = mx.random.normal(0, 1, shape=(1000,))
+    assert abs(b.asnumpy().mean()) < 0.2
+    mx.random.seed(42)
+    a2 = mx.random.uniform(0, 1, shape=(1000,))
+    assert np.allclose(a.asnumpy(), a2.asnumpy())

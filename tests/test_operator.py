@@ -1,0 +1,287 @@
+"""Operator forward checks against numpy/torch oracles, modeled on the
+reference's tests/python/unittest/test_operator.py (numpy oracle strategy,
+SURVEY.md §4). Gradient checks live in test_executor.py / test_autograd.py."""
+import numpy as np
+import pytest
+
+import mxnet_tpu as mx
+
+
+def _nd(x):
+    return mx.nd.array(x)
+
+
+def test_fully_connected():
+    rng = np.random.RandomState(0)
+    x = rng.rand(4, 10).astype(np.float32)
+    w = rng.rand(5, 10).astype(np.float32)
+    b = rng.rand(5).astype(np.float32)
+    out = mx.nd.FullyConnected(_nd(x), _nd(w), _nd(b), num_hidden=5)
+    assert np.allclose(out.asnumpy(), x.dot(w.T) + b, rtol=1e-4)
+    out = mx.nd.FullyConnected(_nd(x), _nd(w), num_hidden=5, no_bias=True)
+    assert np.allclose(out.asnumpy(), x.dot(w.T), rtol=1e-4)
+    # 4D input flattens
+    x4 = rng.rand(2, 3, 2, 2).astype(np.float32)
+    w4 = rng.rand(7, 12).astype(np.float32)
+    out = mx.nd.FullyConnected(_nd(x4), _nd(w4), num_hidden=7, no_bias=True)
+    assert np.allclose(out.asnumpy(), x4.reshape(2, -1).dot(w4.T), rtol=1e-4)
+
+
+def test_convolution_vs_torch():
+    torch = pytest.importorskip("torch")
+    rng = np.random.RandomState(1)
+    x = rng.rand(2, 3, 8, 8).astype(np.float32)
+    w = rng.rand(4, 3, 3, 3).astype(np.float32)
+    b = rng.rand(4).astype(np.float32)
+    out = mx.nd.Convolution(
+        _nd(x), _nd(w), _nd(b), kernel=(3, 3), num_filter=4, stride=(2, 2), pad=(1, 1)
+    )
+    ref = torch.nn.functional.conv2d(
+        torch.from_numpy(x), torch.from_numpy(w), torch.from_numpy(b), stride=2, padding=1
+    ).numpy()
+    assert np.allclose(out.asnumpy(), ref, rtol=1e-3, atol=1e-4)
+
+
+def test_convolution_grouped_dilated():
+    torch = pytest.importorskip("torch")
+    rng = np.random.RandomState(2)
+    x = rng.rand(1, 4, 9, 9).astype(np.float32)
+    w = rng.rand(6, 2, 3, 3).astype(np.float32)
+    out = mx.nd.Convolution(
+        _nd(x), _nd(w), kernel=(3, 3), num_filter=6, num_group=2, dilate=(2, 2), no_bias=True
+    )
+    ref = torch.nn.functional.conv2d(
+        torch.from_numpy(x), torch.from_numpy(w), groups=2, dilation=2
+    ).numpy()
+    assert np.allclose(out.asnumpy(), ref, rtol=1e-3, atol=1e-4)
+
+
+def test_deconvolution_vs_torch():
+    torch = pytest.importorskip("torch")
+    rng = np.random.RandomState(3)
+    x = rng.rand(2, 3, 5, 5).astype(np.float32)
+    w = rng.rand(3, 4, 3, 3).astype(np.float32)  # (C_in, num_filter, kh, kw)
+    out = mx.nd.Deconvolution(_nd(x), _nd(w), kernel=(3, 3), num_filter=4, stride=(2, 2), pad=(1, 1), no_bias=True)
+    ref = torch.nn.functional.conv_transpose2d(
+        torch.from_numpy(x), torch.from_numpy(w), stride=2, padding=1
+    ).numpy()
+    assert np.allclose(out.asnumpy(), ref, rtol=1e-3, atol=1e-4)
+
+
+def test_pooling_vs_torch():
+    torch = pytest.importorskip("torch")
+    rng = np.random.RandomState(4)
+    x = rng.rand(2, 3, 8, 8).astype(np.float32)
+    out = mx.nd.Pooling(_nd(x), kernel=(2, 2), stride=(2, 2), pool_type="max")
+    ref = torch.nn.functional.max_pool2d(torch.from_numpy(x), 2, 2).numpy()
+    assert np.allclose(out.asnumpy(), ref)
+    out = mx.nd.Pooling(_nd(x), kernel=(2, 2), stride=(2, 2), pool_type="avg")
+    ref = torch.nn.functional.avg_pool2d(torch.from_numpy(x), 2, 2).numpy()
+    assert np.allclose(out.asnumpy(), ref, rtol=1e-5)
+    out = mx.nd.Pooling(_nd(x), kernel=(2, 2), global_pool=True, pool_type="avg")
+    assert np.allclose(out.asnumpy(), x.mean(axis=(2, 3), keepdims=True), rtol=1e-5)
+
+
+def test_activation():
+    x = np.array([[-1.0, 0.0, 2.0]], dtype=np.float32)
+    assert np.allclose(mx.nd.Activation(_nd(x), act_type="relu").asnumpy(), [[0, 0, 2]])
+    assert np.allclose(
+        mx.nd.Activation(_nd(x), act_type="sigmoid").asnumpy(), 1 / (1 + np.exp(-x)), rtol=1e-5
+    )
+    assert np.allclose(mx.nd.Activation(_nd(x), act_type="tanh").asnumpy(), np.tanh(x), rtol=1e-5)
+    assert np.allclose(
+        mx.nd.Activation(_nd(x), act_type="softrelu").asnumpy(), np.log1p(np.exp(x)), rtol=1e-4
+    )
+    assert np.allclose(
+        mx.nd.LeakyReLU(_nd(x), act_type="leaky", slope=0.1).asnumpy(),
+        np.where(x >= 0, x, 0.1 * x),
+        rtol=1e-5,
+    )
+
+
+def test_batchnorm_train_and_aux():
+    rng = np.random.RandomState(5)
+    x = rng.rand(4, 3, 2, 2).astype(np.float32)
+    gamma = np.ones(3, np.float32)
+    beta = np.zeros(3, np.float32)
+    mmean = mx.nd.zeros((3,))
+    mvar = mx.nd.ones((3,))
+    out = mx.nd.BatchNorm(_nd(x), _nd(gamma), _nd(beta), mmean, mvar, fix_gamma=False, momentum=0.9)
+    mean = x.mean(axis=(0, 2, 3))
+    var = (x**2).mean(axis=(0, 2, 3)) - mean**2
+    ref = (x - mean[None, :, None, None]) / np.sqrt(var[None, :, None, None] + 1e-3)
+    assert np.allclose(out.asnumpy(), ref, rtol=1e-3, atol=1e-4)
+    # aux moving stats updated in place (FMutateInputs semantics)
+    assert np.allclose(mmean.asnumpy(), 0.1 * mean, rtol=1e-3)
+    assert np.allclose(mvar.asnumpy(), 0.9 * 1.0 + 0.1 * var, rtol=1e-3)
+
+
+def test_softmax_output_forward():
+    rng = np.random.RandomState(6)
+    x = rng.rand(4, 5).astype(np.float32)
+    label = np.array([0, 1, 2, 3], dtype=np.float32)
+    out = mx.nd.SoftmaxOutput(_nd(x), _nd(label))
+    e = np.exp(x - x.max(axis=1, keepdims=True))
+    assert np.allclose(out.asnumpy(), e / e.sum(axis=1, keepdims=True), rtol=1e-4)
+
+
+def test_dropout():
+    x = np.ones((100, 100), dtype=np.float32)
+    out = mx.nd.Dropout(_nd(x), p=0.5)
+    arr = out.asnumpy()
+    frac = (arr == 0).mean()
+    assert 0.4 < frac < 0.6
+    kept = arr[arr != 0]
+    assert np.allclose(kept, 2.0, rtol=1e-5)
+
+
+def test_reshape_codes():
+    x = np.zeros((2, 3, 4), np.float32)
+    assert mx.nd.Reshape(_nd(x), shape=(-1,)).shape == (24,)
+    assert mx.nd.Reshape(_nd(x), shape=(0, -1)).shape == (2, 12)
+    assert mx.nd.Reshape(_nd(x), shape=(-2,)).shape == (2, 3, 4)
+    assert mx.nd.Reshape(_nd(x), shape=(-3, 0)).shape == (6, 4)
+    assert mx.nd.Reshape(_nd(x), shape=(-4, 1, 2, 0, 0)).shape == (1, 2, 3, 4)
+    assert mx.nd.Flatten(_nd(x)).shape == (2, 12)
+
+
+def test_transpose_swap_expand():
+    x = np.random.rand(2, 3, 4).astype(np.float32)
+    assert mx.nd.transpose(_nd(x)).shape == (4, 3, 2)
+    assert np.allclose(mx.nd.transpose(_nd(x), axes=(1, 0, 2)).asnumpy(), x.transpose(1, 0, 2))
+    assert np.allclose(mx.nd.SwapAxis(_nd(x), dim1=0, dim2=2).asnumpy(), x.swapaxes(0, 2))
+    assert mx.nd.expand_dims(_nd(x), axis=1).shape == (2, 1, 3, 4)
+
+
+def test_slice_ops():
+    x = np.arange(24, dtype=np.float32).reshape(2, 3, 4)
+    out = mx.nd.slice(_nd(x), begin=(0, 1, 0), end=(2, 3, 2))
+    assert np.allclose(out.asnumpy(), x[0:2, 1:3, 0:2])
+    out = mx.nd.slice_axis(_nd(x), axis=1, begin=1, end=3)
+    assert np.allclose(out.asnumpy(), x[:, 1:3])
+    out = mx.nd.slice_axis(_nd(x), axis=-1, begin=0, end=2)
+    assert np.allclose(out.asnumpy(), x[..., 0:2])
+
+
+def test_ordering():
+    x = np.array([[3.0, 1.0, 2.0], [0.0, 5.0, 4.0]], dtype=np.float32)
+    out = mx.nd.topk(_nd(x), k=2, ret_typ="value")
+    assert np.allclose(out.asnumpy(), [[3, 2], [5, 4]])
+    out = mx.nd.argsort(_nd(x))
+    assert np.allclose(out.asnumpy(), [[1, 2, 0], [0, 2, 1]])
+    out = mx.nd.sort(_nd(x), is_ascend=False)
+    assert np.allclose(out.asnumpy(), [[3, 2, 1], [5, 4, 0]])
+
+
+def test_sequence_ops():
+    x = np.random.rand(4, 2, 3).astype(np.float32)
+    slen = np.array([2, 4], dtype=np.float32)
+    out = mx.nd.SequenceLast(_nd(x), _nd(slen), use_sequence_length=True)
+    assert np.allclose(out.asnumpy(), np.stack([x[1, 0], x[3, 1]]))
+    out = mx.nd.SequenceMask(_nd(x), _nd(slen), use_sequence_length=True, value=-1.0)
+    assert np.allclose(out.asnumpy()[2:, 0], -1.0)
+    assert np.allclose(out.asnumpy()[:, 1], x[:, 1])
+    out = mx.nd.SequenceReverse(_nd(x), _nd(slen), use_sequence_length=True)
+    assert np.allclose(out.asnumpy()[0, 0], x[1, 0])
+    assert np.allclose(out.asnumpy()[1, 0], x[0, 0])
+    assert np.allclose(out.asnumpy()[2:, 0], x[2:, 0])
+    assert np.allclose(out.asnumpy()[:, 1], x[::-1, 1])
+
+
+def test_elemwise_sum_and_where():
+    xs = [np.random.rand(2, 2).astype(np.float32) for _ in range(3)]
+    out = mx.nd.add_n(*[_nd(x) for x in xs], num_args=3)
+    assert np.allclose(out.asnumpy(), sum(xs), rtol=1e-5)
+    cond = np.array([[1, 0], [0, 1]], np.float32)
+    out = mx.nd.where(_nd(cond), _nd(xs[0]), _nd(xs[1]))
+    assert np.allclose(out.asnumpy(), np.where(cond != 0, xs[0], xs[1]))
+
+
+def test_pick_take():
+    x = np.random.rand(3, 4).astype(np.float32)
+    idx = np.array([0, 2, 3], np.float32)
+    out = mx.nd.pick(_nd(x), _nd(idx))
+    assert np.allclose(out.asnumpy(), x[np.arange(3), idx.astype(int)])
+    out = mx.nd.batch_take(_nd(x), _nd(idx))
+    assert np.allclose(out.asnumpy(), x[np.arange(3), idx.astype(int)])
+    out = mx.nd.take(_nd(x), _nd(np.array([0, 2], np.float32)))
+    assert np.allclose(out.asnumpy(), x[[0, 2]])
+
+
+def test_lrn_l2norm():
+    x = np.random.rand(2, 4, 3, 3).astype(np.float32)
+    out = mx.nd.LRN(_nd(x), nsize=3, alpha=1e-4, beta=0.75, knorm=2.0)
+    # naive reference
+    sq = x**2
+    ref = np.zeros_like(x)
+    for c in range(4):
+        lo, hi = max(0, c - 1), min(4, c + 2)
+        s = sq[:, lo:hi].sum(axis=1)
+        ref[:, c] = x[:, c] * (2.0 + (1e-4 / 3) * s) ** -0.75
+    assert np.allclose(out.asnumpy(), ref, rtol=1e-4)
+    out = mx.nd.L2Normalization(_nd(x), mode="instance")
+    n = np.sqrt((x.reshape(2, -1) ** 2).sum(axis=1) + 1e-10)
+    assert np.allclose(out.asnumpy(), x / n[:, None, None, None], rtol=1e-4)
+
+
+def test_optimizer_update_ops():
+    w = np.random.rand(5).astype(np.float32)
+    g = np.random.rand(5).astype(np.float32)
+    wn, gn = _nd(w), _nd(g)
+    out = mx.nd.sgd_update(wn, gn, lr=0.1, wd=0.01)
+    ref = w - 0.1 * (g + 0.01 * w)
+    assert np.allclose(out.asnumpy(), ref, rtol=1e-5)
+    # in-place via out=
+    mx.nd.sgd_update(wn, gn, lr=0.1, wd=0.01, out=wn)
+    assert np.allclose(wn.asnumpy(), ref, rtol=1e-5)
+    # momentum
+    w2, m2 = _nd(w), mx.nd.zeros((5,))
+    new_w, new_m = mx.nd.sgd_mom_update(w2, gn, m2, lr=0.1, momentum=0.9)
+    assert np.allclose(new_m.asnumpy(), -0.1 * g, rtol=1e-5)
+    assert np.allclose(new_w.asnumpy(), w - 0.1 * g, rtol=1e-5)
+
+
+def test_cast_clip_onehot():
+    x = np.array([[0.5, 1.7]], np.float32)
+    assert mx.nd.Cast(_nd(x), dtype=np.int32).dtype == np.int32
+    assert np.allclose(mx.nd.clip(_nd(x), a_min=0.6, a_max=1.0).asnumpy(), [[0.6, 1.0]])
+
+
+def test_rnn_op_lstm_shapes():
+    from mxnet_tpu.ops.rnn import rnn_param_size
+
+    T, N, I, H, L = 5, 2, 3, 4, 2
+    psize = rnn_param_size(L, I, H, False, "lstm")
+    params = np.random.RandomState(7).rand(psize).astype(np.float32) * 0.1
+    x = np.random.rand(T, N, I).astype(np.float32)
+    h0 = np.zeros((L, N, H), np.float32)
+    c0 = np.zeros((L, N, H), np.float32)
+    outs = mx.nd.RNN(
+        _nd(x), _nd(params), _nd(h0), _nd(c0),
+        state_size=H, num_layers=L, mode="lstm", state_outputs=True,
+    )
+    out, hT, cT = outs
+    assert out.shape == (T, N, H)
+    assert hT.shape == (L, N, H)
+    assert cT.shape == (L, N, H)
+    # bidirectional
+    psize = rnn_param_size(1, I, H, True, "gru")
+    params = np.random.rand(psize).astype(np.float32) * 0.1
+    h0 = np.zeros((2, N, H), np.float32)
+    out = mx.nd.RNN(_nd(x), _nd(params), _nd(h0), state_size=H, num_layers=1, mode="gru", bidirectional=True)
+    assert out.shape == (T, N, 2 * H)
+
+
+def test_samplers_moments():
+    mx.random.seed(0)
+    u = mx.nd.uniform(low=2.0, high=4.0, shape=(5000,))
+    assert abs(u.asnumpy().mean() - 3.0) < 0.1
+    n = mx.nd.normal(loc=1.0, scale=2.0, shape=(5000,))
+    assert abs(n.asnumpy().mean() - 1.0) < 0.15
+    assert abs(n.asnumpy().std() - 2.0) < 0.15
+    g = mx.nd.gamma(alpha=3.0, beta=2.0, shape=(5000,))
+    assert abs(g.asnumpy().mean() - 6.0) < 0.4
+    e = mx.nd.exponential(lam=2.0, shape=(5000,))
+    assert abs(e.asnumpy().mean() - 0.5) < 0.1
+    p = mx.nd.poisson(lam=4.0, shape=(5000,))
+    assert abs(p.asnumpy().mean() - 4.0) < 0.3
