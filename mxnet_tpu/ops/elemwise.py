@@ -140,7 +140,23 @@ register("_copy", aliases=("identity", "_identity_with_attr_like_rhs"))(
 register("BlockGrad", aliases=("stop_gradient", "make_no_grad"))(
     lambda attrs, data: jax.lax.stop_gradient(data)
 )
-register("_CrossDeviceCopy", aliases=("_copyto",))(lambda attrs, data: data)
+@register("_CrossDeviceCopy", aliases=("_copyto",), attrs={"__target_ctx__": AttrSpec("str", default="")})
+def _cross_device_copy(attrs, data):
+    """Move data to another device (reference: src/operator/cross_device_copy.cc,
+    executed as CopyFromTo by the executor). Inside a traced graph this lowers
+    to an XLA transfer annotation when the executor stamps ``__target_ctx__``
+    (the PlaceDevice pass analogue); with no target it is the identity copy,
+    matching ``_copyto`` on one device."""
+    target = attrs.get("__target_ctx__") or ""
+    if target:
+        import jax
+
+        from ..context import Context
+
+        name, _, idx = target.partition(":")
+        dev = Context(name, int(idx or 0)).jax_device
+        return jax.device_put(data, dev)
+    return data
 
 
 @register("Cast", attrs={"dtype": AttrSpec("dtype", required=True)}, aliases=("cast",))

@@ -315,6 +315,15 @@ def _topk(attrs, data):
         return top_vals
     if rt == "both":
         return top_vals, top_idx
+    if rt == "mask":
+        # 0/1 mask with ones at top-k positions (reference: ordering_op kRetMask)
+        moved = jnp.moveaxis(vals, ax, -1)
+        _, idx = jax.lax.top_k(moved, k)
+        onehot = jax.nn.one_hot(idx, moved.shape[-1], dtype=data.dtype)
+        mask = jnp.clip(jnp.sum(onehot, axis=-2), 0, 1)
+        return jnp.moveaxis(mask, -1, ax)
+    if rt != "indices":
+        raise MXNetError("topk: unsupported ret_typ %r" % rt)
     return top_idx
 
 

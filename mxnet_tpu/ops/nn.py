@@ -37,9 +37,14 @@ def _fc_names(attrs):
     input_names=_fc_names,
 )
 def _fully_connected(attrs, data, weight, bias=None):
-    """y = x · Wᵀ + b. Batched 2D matmul → single MXU op."""
-    x = data.reshape((data.shape[0], -1)) if data.ndim != 2 else data
-    y = jnp.dot(x, weight.T)
+    """y = x · Wᵀ + b. Batched 2D matmul → single MXU op. With flatten=False
+    the matmul applies over the last axis, keeping leading axes (the later
+    reference semantics the attr advertises)."""
+    if attrs.get("flatten", True):
+        x = data.reshape((data.shape[0], -1)) if data.ndim != 2 else data
+        y = jnp.dot(x, weight.T)
+    else:
+        y = jnp.einsum("...i,oi->...o", data, weight)
     if bias is not None:
         y = y + bias
     return y
@@ -410,27 +415,32 @@ def _softmax_output(attrs, data, label):
 
 def _make_output_op(name, fwd, grad):
     """Regression-output family: forward transform + own backward (reference:
-    regression_output-inl.h)."""
+    regression_output-inl.h). grad_scale is compile-time config baked into the
+    cached closure so the vjp's cotangent pytree matches the primal args
+    exactly (custom_vjp rejects None cotangents for array args)."""
 
-    @jax.custom_vjp
-    def core(data, label, grad_scale):
-        return fwd(data)
+    @functools.lru_cache(maxsize=None)
+    def core_for(grad_scale):
+        @jax.custom_vjp
+        def core(data, label):
+            return fwd(data)
 
-    def core_fwd(data, label, grad_scale):
-        out = fwd(data)
-        return out, (out, label, grad_scale)
+        def core_fwd(data, label):
+            out = fwd(data)
+            return out, (out, label)
 
-    def core_bwd(res, g):
-        out, label, grad_scale = res
-        num_output = max(int(np.prod(out.shape[1:])), 1)
-        d = grad(out, label.reshape(out.shape)) * (grad_scale / num_output)
-        return (d.astype(out.dtype), jnp.zeros_like(label), None)
+        def core_bwd(res, g):
+            out, label = res
+            num_output = max(int(np.prod(out.shape[1:])), 1)
+            d = grad(out, label.reshape(out.shape)) * (grad_scale / num_output)
+            return (d.astype(out.dtype), jnp.zeros_like(label))
 
-    core.defvjp(core_fwd, core_bwd)
+        core.defvjp(core_fwd, core_bwd)
+        return core
 
     @register(name, attrs={"grad_scale": AttrSpec("float", default=1.0)}, input_names=("data", "label"))
-    def op(attrs, data, label, _core=core):
-        return _core(data, label, attrs["grad_scale"])
+    def op(attrs, data, label):
+        return core_for(float(attrs["grad_scale"]))(data, label)
 
     return op
 
@@ -440,21 +450,24 @@ _make_output_op("LogisticRegressionOutput", jax.nn.sigmoid, lambda o, y: o - y)
 _make_output_op("MAERegressionOutput", lambda x: x, lambda o, y: jnp.sign(o - y))
 
 
-@jax.custom_vjp
-def _make_loss_core(data, grad_scale, norm_div):
-    return data
+@functools.lru_cache(maxsize=None)
+def _make_loss_core(grad_scale, norm_div):
+    """grad_scale/norm_div are static config (like the bound MakeLossParam in
+    the reference), so the vjp returns exactly one cotangent for `data`."""
 
+    @jax.custom_vjp
+    def core(data):
+        return data
 
-def _ml_fwd(data, grad_scale, norm_div):
-    return data, (data.shape, data.dtype, grad_scale, norm_div)
+    def ml_fwd(data):
+        return data, None
 
+    def ml_bwd(res, g):
+        # output aliases data, so g's shape/dtype are data's
+        return (jnp.full(jnp.shape(g), grad_scale / norm_div, dtype=g.dtype),)
 
-def _ml_bwd(res, g):
-    shape, dtype, grad_scale, norm_div = res
-    return (jnp.full(shape, grad_scale / norm_div, dtype=dtype), None, None)
-
-
-_make_loss_core.defvjp(_ml_fwd, _ml_bwd)
+    core.defvjp(ml_fwd, ml_bwd)
+    return core
 
 
 @register(
@@ -468,7 +481,7 @@ _make_loss_core.defvjp(_ml_fwd, _ml_bwd)
 def _make_loss(attrs, data):
     """Treat data as a loss: backward emits grad_scale (reference: make_loss.cc)."""
     norm_div = float(data.shape[0]) if attrs["normalization"] == "batch" else 1.0
-    return _make_loss_core(data, attrs["grad_scale"], norm_div)
+    return _make_loss_core(float(attrs["grad_scale"]), norm_div)(data)
 
 
 @functools.lru_cache(maxsize=None)

@@ -181,9 +181,16 @@ def register(
             needs_train_flag=needs_train_flag,
             aliases=aliases,
         )
+        if name in _REGISTRY:
+            raise MXNetError(
+                "duplicate operator registration %r (already %s)"
+                % (name, "canonical" if name in _CANONICAL else "an alias")
+            )
         _CANONICAL[name] = op
         _REGISTRY[name] = op
         for a in aliases:
+            if a in _REGISTRY:
+                raise MXNetError("operator alias %r collides with existing op" % a)
             _REGISTRY[a] = op
         return fn
 

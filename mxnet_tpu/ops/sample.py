@@ -41,34 +41,37 @@ def _reg_sampler(name, attr_extra, draw, aliases=()):
 
 
 _reg_sampler(
-    "uniform",
+    "random_uniform",
     {"low": AttrSpec("float", default=0.0), "high": AttrSpec("float", default=1.0)},
     lambda k, s, d, a: jax.random.uniform(k, s, dtype=d, minval=a["low"], maxval=a["high"]),
-    aliases=("_sample_uniform", "random_uniform"),
+    aliases=("_sample_uniform", "uniform"),
 )
 _reg_sampler(
-    "normal",
+    "random_normal",
     {"loc": AttrSpec("float", default=0.0), "scale": AttrSpec("float", default=1.0)},
     lambda k, s, d, a: a["loc"] + a["scale"] * jax.random.normal(k, s, dtype=d),
-    aliases=("_sample_normal", "random_normal"),
+    aliases=("_sample_normal", "normal"),
 )
+# NOTE: canonical name is random_gamma — the bare name "gamma" is the unary
+# Γ(x) op in elemwise.py, exactly as in the reference (elemwise_unary_op.cc
+# vs sample_op.cc); the registry now rejects such collisions.
 _reg_sampler(
-    "gamma",
+    "random_gamma",
     {"alpha": AttrSpec("float", default=1.0), "beta": AttrSpec("float", default=1.0)},
     lambda k, s, d, a: a["beta"] * jax.random.gamma(k, a["alpha"], s, dtype=d),
     aliases=("_sample_gamma",),
 )
 _reg_sampler(
-    "exponential",
+    "random_exponential",
     {"lam": AttrSpec("float", default=1.0)},
     lambda k, s, d, a: jax.random.exponential(k, s, dtype=d) / a["lam"],
-    aliases=("_sample_exponential",),
+    aliases=("_sample_exponential", "exponential"),
 )
 _reg_sampler(
-    "poisson",
+    "random_poisson",
     {"lam": AttrSpec("float", default=1.0)},
     lambda k, s, d, a: jax.random.poisson(k, a["lam"], s).astype(d),
-    aliases=("_sample_poisson",),
+    aliases=("_sample_poisson", "poisson"),
 )
 
 
@@ -80,10 +83,10 @@ def _neg_binomial(k, s, d, a):
 
 
 _reg_sampler(
-    "negative_binomial",
+    "random_negative_binomial",
     {"k": AttrSpec("int", default=1), "p": AttrSpec("float", default=1.0)},
     _neg_binomial,
-    aliases=("_sample_negbinomial",),
+    aliases=("_sample_negbinomial", "negative_binomial"),
 )
 
 
@@ -99,8 +102,8 @@ def _gen_neg_binomial(k, s, d, a):
 
 
 _reg_sampler(
-    "generalized_negative_binomial",
+    "random_generalized_negative_binomial",
     {"mu": AttrSpec("float", default=1.0), "alpha": AttrSpec("float", default=1.0)},
     _gen_neg_binomial,
-    aliases=("_sample_gennegbinomial",),
+    aliases=("_sample_gennegbinomial", "generalized_negative_binomial"),
 )

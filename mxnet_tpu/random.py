@@ -37,15 +37,8 @@ def uniform(low=0.0, high=1.0, shape=(1,), ctx=None, dtype=np.float32, out=None)
     from .ndarray import imperative_invoke
     from .context import current_context
 
-    return imperative_invoke(
-        "uniform",
-        [],
-        {"low": low, "high": high, "shape": shape, "dtype": dtype},
-        ctx=ctx or current_context(),
-        out=out,
-    )[0] if out is None else imperative_invoke(
-        "uniform", [], {"low": low, "high": high, "shape": shape, "dtype": dtype}, ctx=ctx, out=out
-    )[0]
+    attrs = {"low": low, "high": high, "shape": shape, "dtype": dtype}
+    return imperative_invoke("random_uniform", [], attrs, ctx=ctx or current_context(), out=out)[0]
 
 
 def normal(loc=0.0, scale=1.0, shape=(1,), ctx=None, dtype=np.float32, out=None):
@@ -53,4 +46,4 @@ def normal(loc=0.0, scale=1.0, shape=(1,), ctx=None, dtype=np.float32, out=None)
     from .context import current_context
 
     attrs = {"loc": loc, "scale": scale, "shape": shape, "dtype": dtype}
-    return imperative_invoke("normal", [], attrs, ctx=ctx or current_context(), out=out)[0]
+    return imperative_invoke("random_normal", [], attrs, ctx=ctx or current_context(), out=out)[0]

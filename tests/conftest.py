@@ -3,14 +3,22 @@
 The reference tests multi-device logic on CPU by mapping ctx groups to
 mx.cpu(0)/mx.cpu(1) (SURVEY.md §4 "multi-device-without-GPUs trick"). The JAX
 equivalent is --xla_force_host_platform_device_count: 8 virtual CPU devices,
-so sharding/collective paths compile and run without TPU hardware. Must be set
-before jax is imported anywhere.
+so sharding/collective paths compile and run without TPU hardware.
+
+This image's sitecustomize imports jax at interpreter startup (with
+JAX_PLATFORMS=axon preset), so mutating os.environ["JAX_PLATFORMS"] here is
+too late — the platform must be forced through jax.config before any backend
+is initialized. XLA_FLAGS is still read at CPU-client creation, so the
+virtual-device count can be injected via the environment.
 """
 import os
 
-os.environ["JAX_PLATFORMS"] = "cpu"  # force: the session env presets the TPU platform
 os.environ["MXNET_DEFAULT_CONTEXT"] = "cpu"  # default ctx → virtual CPU devices
 flags = os.environ.get("XLA_FLAGS", "")
 if "xla_force_host_platform_device_count" not in flags:
     os.environ["XLA_FLAGS"] = (flags + " --xla_force_host_platform_device_count=8").strip()
 os.environ.setdefault("JAX_ENABLE_X64", "0")
+
+import jax
+
+jax.config.update("jax_platforms", "cpu")

@@ -1,0 +1,141 @@
+"""Regression tests for the round-2 fixes (ADVICE.md + VERDICT.md weak items)."""
+import io
+import struct
+
+import numpy as np
+import pytest
+
+import mxnet_tpu as mx
+from mxnet_tpu import ndarray as nd
+from mxnet_tpu.base import MXNetError
+
+
+def test_gamma_is_unary_gamma_function():
+    # ADVICE high: `gamma` must be Γ(x), not the sampler (reference keeps them distinct)
+    x = mx.nd.array(np.array([1.0, 2.0, 3.0, 4.0], dtype=np.float32))
+    out = mx.nd.gamma(x).asnumpy()
+    np.testing.assert_allclose(out, [1.0, 1.0, 2.0, 6.0], rtol=1e-5)
+
+
+def test_register_rejects_duplicates():
+    from mxnet_tpu.ops.registry import register
+
+    with pytest.raises(MXNetError):
+        register("gamma")(lambda attrs, x: x)
+    with pytest.raises(MXNetError):
+        register("_totally_new_op_xyz", aliases=("gamma",))(lambda attrs, x: x)
+
+
+def test_params_reference_binary_layout():
+    """The .params byte stream must match the reference NDArray::Save layout
+    (src/ndarray/ndarray.cc:623-645): shape, ctx, type_flag, raw data — no
+    per-array length prefix."""
+    arr = mx.nd.array(np.arange(6, dtype=np.float32).reshape(2, 3))
+    buf = io.BytesIO()
+    nd._write_ndarray(buf, arr)
+    raw = buf.getvalue()
+    expect = (
+        struct.pack("<I", 2)
+        + struct.pack("<II", 2, 3)
+        + struct.pack("<ii", arr.context.device_typeid, arr.context.device_id)
+        + struct.pack("<i", 0)  # float32 type_flag
+        + np.arange(6, dtype=np.float32).tobytes()
+    )
+    assert raw == expect
+    back = nd._read_ndarray(io.BytesIO(raw))
+    np.testing.assert_array_equal(back.asnumpy(), arr.asnumpy())
+
+
+def test_params_file_written_by_reference_layout_loads(tmp_path):
+    """Hand-craft a file in the exact reference format and load it."""
+    fname = str(tmp_path / "ref.params")
+    a = np.random.rand(3, 4).astype(np.float32)
+    b = np.random.randint(0, 10, size=(5,)).astype(np.int32)
+    with open(fname, "wb") as f:
+        f.write(struct.pack("<QQ", 0x112, 0))
+        f.write(struct.pack("<Q", 2))
+        # array 0: float32 on cpu(0)
+        f.write(struct.pack("<I", 2) + struct.pack("<II", 3, 4))
+        f.write(struct.pack("<ii", 1, 0) + struct.pack("<i", 0) + a.tobytes())
+        # array 1: int32
+        f.write(struct.pack("<I", 1) + struct.pack("<I", 5))
+        f.write(struct.pack("<ii", 1, 0) + struct.pack("<i", 4) + b.tobytes())
+        # names
+        f.write(struct.pack("<Q", 2))
+        for name in (b"arg:w", b"arg:b"):
+            f.write(struct.pack("<Q", len(name)) + name)
+    loaded = nd.load(fname)
+    np.testing.assert_allclose(loaded["arg:w"].asnumpy(), a)
+    np.testing.assert_array_equal(loaded["arg:b"].asnumpy(), b)
+
+
+def test_save_load_roundtrip(tmp_path):
+    fname = str(tmp_path / "rt.params")
+    d = {"x": mx.nd.array(np.random.rand(2, 2).astype(np.float32)),
+         "y": mx.nd.array(np.arange(3, dtype=np.float32))}
+    nd.save(fname, d)
+    back = nd.load(fname)
+    for k in d:
+        np.testing.assert_allclose(back[k].asnumpy(), d[k].asnumpy())
+
+
+def test_fullyconnected_flatten_false():
+    data = np.random.rand(2, 3, 4).astype(np.float32)
+    w = np.random.rand(5, 4).astype(np.float32)
+    b = np.random.rand(5).astype(np.float32)
+    out = mx.nd.FullyConnected(
+        data=mx.nd.array(data), weight=mx.nd.array(w), bias=mx.nd.array(b),
+        num_hidden=5, flatten=False,
+    ).asnumpy()
+    np.testing.assert_allclose(out, np.einsum("nti,oi->nto", data, w) + b, rtol=1e-5)
+
+
+def test_topk_mask():
+    x = np.array([[3.0, 1.0, 4.0, 1.5], [0.0, 2.0, -1.0, 5.0]], dtype=np.float32)
+    m = mx.nd.topk(mx.nd.array(x), k=2, ret_typ="mask").asnumpy()
+    np.testing.assert_array_equal(m, [[1, 0, 1, 0], [0, 1, 0, 1]])
+    with pytest.raises(MXNetError):
+        mx.nd.topk(mx.nd.array(x), k=2, ret_typ="bogus")
+
+
+def test_tuple_setitem():
+    a = mx.nd.zeros((3, 4))
+    a[1, 2] = 7.0
+    a[0, 1:3] = 2.0
+    got = a.asnumpy()
+    assert got[1, 2] == 7.0
+    np.testing.assert_array_equal(got[0, 1:3], [2.0, 2.0])
+    assert got.sum() == 11.0
+
+
+def test_regression_output_backward_through_jax():
+    """ADVICE medium: differentiating the custom-vjp output ops must not raise
+    pytree errors, and must produce the reference gradients."""
+    import jax
+    import jax.numpy as jnp
+
+    from mxnet_tpu.ops.registry import get_op
+
+    data = jnp.array([[0.2, -0.5], [1.0, 0.3]], dtype=jnp.float32)
+    label = jnp.array([[0.0, 0.0], [1.0, 1.0]], dtype=jnp.float32)
+
+    for name, ref_grad in [
+        ("LinearRegressionOutput", (data - label) / 2.0),
+        ("MAERegressionOutput", jnp.sign(data - label) / 2.0),
+    ]:
+        op = get_op(name)
+        loss = lambda d: jnp.sum(op.fn({"grad_scale": 1.0}, d, label))
+        g = jax.grad(loss)(data)
+        np.testing.assert_allclose(np.asarray(g), np.asarray(ref_grad), rtol=1e-5)
+
+    ml = get_op("MakeLoss")
+    g = jax.grad(lambda d: jnp.sum(ml.fn(
+        {"grad_scale": 3.0, "normalization": "null", "valid_thresh": 0.0}, d)))(data)
+    np.testing.assert_allclose(np.asarray(g), np.full(data.shape, 3.0))
+
+
+def test_waitall_blocks():
+    a = mx.nd.ones((64, 64))
+    b = mx.nd.dot(a, a)
+    nd.waitall()  # must not raise, and must block on b's buffer
+    assert b.asnumpy()[0, 0] == 64.0
