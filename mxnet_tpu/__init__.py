@@ -14,6 +14,8 @@ __version__ = "0.1.0"
 from . import base
 from .base import MXNetError
 from .context import Context, cpu, gpu, tpu, current_context, num_gpus, num_tpus
+from .attribute import AttrScope
+from . import name
 from . import ndarray
 from . import ndarray as nd
 from . import random
@@ -23,10 +25,12 @@ from . import ops
 __all__ = [
     "MXNetError",
     "Context",
+    "AttrScope",
     "cpu",
     "gpu",
     "tpu",
     "current_context",
+    "name",
     "nd",
     "ndarray",
     "random",

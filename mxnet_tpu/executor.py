@@ -1,0 +1,367 @@
+"""Executor: bound, compiled symbol graphs.
+
+TPU-native redesign of the reference's GraphExecutor
+(src/executor/graph_executor.cc:333 Init, :178 InitFullGraph,
+python/mxnet/executor.py). The reference builds an explicit fwd+bwd nnvm
+graph, plans memory, and pushes per-node engine ops; here the whole graph is
+*traced once* into a single jitted XLA computation — forward via topological
+interpretation of the op registry, backward via ``jax.vjp`` over that same
+trace (SURVEY.md §3.2 TPU mapping: "InitGraph down collapses into trace →
+XLA compile"). Memory planning, fusion, scheduling, and the reference's
+inplace/bulk-exec optimizations are XLA's job.
+
+Semantics kept from the reference:
+  * ``grad_req`` ∈ {write, add, null} per argument (kWriteTo/kAddTo/kNullOp).
+  * aux states (BN moving stats) are threaded functionally through the trace
+    and written back after ``forward`` — never by ``backward`` — matching the
+    FMutateInputs contract.
+  * ``backward`` reuses the forward's PRNG key so stochastic ops (Dropout)
+    see identical masks in both passes, like the reference's cached masks.
+"""
+from __future__ import annotations
+
+import functools
+from typing import Dict, List, Optional
+
+import numpy as np
+
+from .base import MXNetError, np_dtype
+from .context import Context, current_context
+from .ndarray import NDArray, _Chunk, zeros
+from .ops.registry import get_op
+
+__all__ = ["Executor", "bind", "simple_bind"]
+
+
+class _GraphProgram:
+    """The traced interpretation of a Symbol: pure functions over arg/aux
+    tuples, compiled lazily per (is_train, shapes) by jax.jit."""
+
+    def __init__(self, symbol):
+        self.symbol = symbol
+        self.topo = symbol._topo()
+        args, auxs = symbol._classified_variables()
+        self.arg_names = [n.name for n in args]
+        self.aux_names = [n.name for n in auxs]
+        self._arg_index = {n: i for i, n in enumerate(self.arg_names)}
+        self._aux_index = {n: i for i, n in enumerate(self.aux_names)}
+        self.outputs = list(symbol._outputs)
+        self.output_names = symbol.list_outputs()
+        # one stable int per rng-consuming node for fold_in
+        self._rng_ids = {}
+        for node in self.topo:
+            if node.op is not None and get_op(node.op).needs_rng:
+                self._rng_ids[id(node)] = len(self._rng_ids)
+
+    # ---------------------------------------------------------------- tracing
+    def interpret(self, arg_vals, aux_vals, is_train, rng):
+        """Run the graph on jax values. Returns (outputs, new_aux_tuple)."""
+        import jax
+
+        vals = {}
+        new_aux = list(aux_vals)
+        for node in self.topo:
+            if node.is_variable:
+                if node.name in self._arg_index:
+                    vals[(id(node), 0)] = arg_vals[self._arg_index[node.name]]
+                else:
+                    vals[(id(node), 0)] = aux_vals[self._aux_index[node.name]]
+                continue
+            opdef = get_op(node.op)
+            parsed = node.parsed_attrs()
+            n_aux = len(opdef.aux_names(parsed))
+            ins = [vals[(id(inp), oi)] for inp, oi in node.inputs]
+            node_rng = None
+            if opdef.needs_rng:
+                node_rng = jax.random.fold_in(rng, self._rng_ids[id(node)])
+            outs, aux_out = opdef.apply(
+                parsed,
+                ins[: len(ins) - n_aux] if n_aux else ins,
+                aux=ins[len(ins) - n_aux :] if n_aux else [],
+                is_train=is_train,
+                rng=node_rng,
+            )
+            for i, o in enumerate(outs):
+                vals[(id(node), i)] = o
+            if n_aux:
+                for (inp, _), new in zip(node.inputs[len(node.inputs) - n_aux :], aux_out):
+                    if not inp.is_variable:
+                        raise MXNetError(
+                            "aux input of %s must be a variable" % node.name
+                        )
+                    new_aux[self._aux_index[inp.name]] = new
+        outputs = tuple(vals[(id(n), i)] for n, i in self.outputs)
+        return outputs, tuple(new_aux)
+
+    # --------------------------------------------------------------- compiled
+    @functools.lru_cache(maxsize=None)
+    def _fwd(self, is_train):
+        import jax
+
+        def run(args, aux, rng):
+            return self.interpret(args, aux, is_train, rng)
+
+        return jax.jit(run)
+
+    @functools.lru_cache(maxsize=None)
+    def _fwd_bwd(self, with_head_grads):
+        """One XLA computation: forward + full backward (the reference's
+        InitFullGraph fwd+bwd graph, graph_executor.cc:178)."""
+        import jax
+        import jax.numpy as jnp
+
+        def run(args, aux, head_grads, rng):
+            def f(a):
+                outs, new_aux = self.interpret(a, aux, True, rng)
+                return outs, new_aux
+
+            outs, vjp_fn, new_aux = jax.vjp(f, args, has_aux=True)
+            if with_head_grads:
+                cot = tuple(h.astype(o.dtype) for h, o in zip(head_grads, outs))
+            else:
+                # loss-style outputs: custom-vjp loss ops ignore the incoming
+                # cotangent, so ones is the identity head gradient
+                cot = tuple(jnp.ones_like(o) for o in outs)
+            (grads,) = vjp_fn(cot)
+            return outs, grads, new_aux
+
+        return jax.jit(run)
+
+
+class Executor:
+    """A bound computation (reference: python/mxnet/executor.py)."""
+
+    def __init__(self, symbol, ctx: Context, arg_arrays, grad_arrays, grad_req, aux_arrays, program=None):
+        self._symbol = symbol
+        self._ctx = ctx
+        self._prog = program or _GraphProgram(symbol)
+        self.arg_arrays: List[NDArray] = list(arg_arrays)
+        self.grad_arrays: List[Optional[NDArray]] = list(grad_arrays)
+        self.aux_arrays: List[NDArray] = list(aux_arrays)
+        self._grad_req: List[str] = list(grad_req)
+        self.outputs: List[NDArray] = []
+        self.arg_dict: Dict[str, NDArray] = dict(zip(self._prog.arg_names, self.arg_arrays))
+        self.grad_dict: Dict[str, Optional[NDArray]] = dict(zip(self._prog.arg_names, self.grad_arrays))
+        self.aux_dict: Dict[str, NDArray] = dict(zip(self._prog.aux_names, self.aux_arrays))
+        self.output_dict: Dict[str, NDArray] = {}
+        self._last_rng = None
+        self._monitor_callback = None
+
+    # ----------------------------------------------------------------- running
+    def _collect(self):
+        args = tuple(a._jax() for a in self.arg_arrays)
+        aux = tuple(a._jax() for a in self.aux_arrays)
+        return args, aux
+
+    def _next_rng(self):
+        from . import random as _random
+
+        self._last_rng = _random._next_key()
+        return self._last_rng
+
+    def _set_outputs(self, outs):
+        self.outputs = [NDArray(chunk=_Chunk(o, self._ctx), shape=o.shape) for o in outs]
+        self.output_dict = dict(zip(self._prog.output_names, self.outputs))
+        if self._monitor_callback is not None:
+            for name, arr in self.output_dict.items():
+                self._monitor_callback(name, arr)
+        return self.outputs
+
+    def _write_aux(self, new_aux):
+        for arr, new in zip(self.aux_arrays, new_aux):
+            arr._set_jax(new)
+
+    def _apply_grads(self, grads):
+        import jax.numpy as jnp
+
+        for garr, g, req in zip(self.grad_arrays, grads, self._grad_req):
+            if req == "null" or garr is None:
+                continue
+            if req == "add":
+                garr._set_jax(garr._jax() + g.astype(garr.dtype))
+            else:  # write
+                garr._set_jax(g.astype(garr.dtype))
+
+    def forward(self, is_train=False, **kwargs):
+        """Run forward; optional kwargs copy new values into bound args
+        (reference: executor.py forward)."""
+        for k, v in kwargs.items():
+            if k not in self.arg_dict:
+                raise MXNetError("unknown argument %r" % k)
+            self.arg_dict[k][:] = v
+        args, aux = self._collect()
+        rng = self._next_rng()
+        outs, new_aux = self._prog._fwd(bool(is_train))(args, aux, rng)
+        if is_train:
+            self._write_aux(new_aux)
+        return self._set_outputs(outs)
+
+    def backward(self, out_grads=None):
+        """Run backward, accumulating into grad arrays per grad_req. Reuses the
+        forward trace in one fused XLA computation (recompute-style — XLA CSEs
+        shared subexpressions; Module's hot path calls forward_backward which
+        runs this computation exactly once per step)."""
+        args, aux = self._collect()
+        rng = self._last_rng if self._last_rng is not None else self._next_rng()
+        if out_grads is None:
+            head: tuple = ()
+            fn = self._prog._fwd_bwd(False)
+            outs, grads, _ = fn(args, aux, (), rng)
+        else:
+            if isinstance(out_grads, NDArray):
+                out_grads = [out_grads]
+            head = tuple(g._jax() for g in out_grads)
+            if len(head) != len(self._prog.outputs):
+                raise MXNetError(
+                    "backward: expected %d head gradients, got %d"
+                    % (len(self._prog.outputs), len(head))
+                )
+            fn = self._prog._fwd_bwd(True)
+            outs, grads, _ = fn(args, aux, head, rng)
+        self._apply_grads(grads)
+
+    def forward_backward(self, out_grads=None, is_train=True):
+        """Fused fwd+bwd: ONE compiled XLA computation per training step —
+        the TPU-native analogue of the reference's cached-op bulk segments
+        (graph_executor.cc:690 InitOpSegs)."""
+        args, aux = self._collect()
+        rng = self._next_rng()
+        if out_grads is None:
+            fn = self._prog._fwd_bwd(False)
+            outs, grads, new_aux = fn(args, aux, (), rng)
+        else:
+            head = tuple(g._jax() for g in out_grads)
+            fn = self._prog._fwd_bwd(True)
+            outs, grads, new_aux = fn(args, aux, head, rng)
+        self._write_aux(new_aux)
+        self._apply_grads(grads)
+        return self._set_outputs(outs)
+
+    # ------------------------------------------------------------------ misc
+    def copy_params_from(self, arg_params, aux_params=None, allow_extra_params=False):
+        for name, arr in (arg_params or {}).items():
+            if name in self.arg_dict:
+                self.arg_dict[name][:] = arr
+            elif not allow_extra_params:
+                raise MXNetError("Found name %r not in executor arguments" % name)
+        for name, arr in (aux_params or {}).items():
+            if name in self.aux_dict:
+                self.aux_dict[name][:] = arr
+            elif not allow_extra_params:
+                raise MXNetError("Found name %r not in executor aux states" % name)
+
+    def reshape(self, partial_shaping=False, allow_up_sizing=False, **kwargs):
+        """Return a new executor bound to new shapes (reference:
+        executor.py reshape). XLA recompiles per shape — same economics as the
+        reference's executor-per-bucket."""
+        arg_shapes, _, aux_shapes = self._symbol.infer_shape(**kwargs)
+        if arg_shapes is None:
+            raise MXNetError("reshape: insufficient shape info")
+        new_args, new_grads, new_aux = [], [], []
+        for arr, garr, req, shape in zip(self.arg_arrays, self.grad_arrays, self._grad_req, arg_shapes):
+            if tuple(arr.shape) == tuple(shape):
+                new_args.append(arr)
+                new_grads.append(garr)
+            else:
+                new_args.append(zeros(shape, ctx=self._ctx, dtype=arr.dtype))
+                new_grads.append(zeros(shape, ctx=self._ctx, dtype=arr.dtype) if garr is not None else None)
+        for arr, shape in zip(self.aux_arrays, aux_shapes):
+            new_aux.append(arr if tuple(arr.shape) == tuple(shape) else zeros(shape, ctx=self._ctx, dtype=arr.dtype))
+        return Executor(self._symbol, self._ctx, new_args, new_grads, self._grad_req, new_aux, program=self._prog)
+
+    def set_monitor_callback(self, callback):
+        self._monitor_callback = callback
+
+    def debug_str(self):
+        return self._symbol.debug_str()
+
+
+# -------------------------------------------------------------------- binding
+def _normalize_grad_req(grad_req, arg_names):
+    if isinstance(grad_req, str):
+        return [grad_req] * len(arg_names)
+    if isinstance(grad_req, (list, tuple)):
+        if len(grad_req) != len(arg_names):
+            raise MXNetError("grad_req list length mismatch")
+        return list(grad_req)
+    if isinstance(grad_req, dict):
+        return [grad_req.get(n, "null") for n in arg_names]
+    raise TypeError("grad_req must be str/list/dict")
+
+
+def bind(symbol, ctx, args, args_grad=None, grad_req="write", aux_states=None, shared_exec=None):
+    """Bind NDArrays to a symbol's arguments (reference: symbol.py:917 bind →
+    Executor::Bind, graph_executor.cc:936)."""
+    prog = _GraphProgram(symbol) if shared_exec is None else shared_exec._prog
+    if shared_exec is not None and shared_exec._symbol is not symbol:
+        prog = _GraphProgram(symbol)
+    arg_names = prog.arg_names
+    aux_names = prog.aux_names
+    ctx = Context(ctx) if not isinstance(ctx, Context) else ctx
+
+    if isinstance(args, dict):
+        missing = [n for n in arg_names if n not in args]
+        if missing:
+            raise MXNetError("bind: missing arguments %s" % missing)
+        arg_arrays = [args[n] for n in arg_names]
+    else:
+        if len(args) != len(arg_names):
+            raise MXNetError("bind: expected %d args, got %d" % (len(arg_names), len(args)))
+        arg_arrays = list(args)
+
+    reqs = _normalize_grad_req(grad_req, arg_names)
+    if args_grad is None:
+        grad_arrays = [None] * len(arg_names)
+        reqs = ["null"] * len(arg_names)
+    elif isinstance(args_grad, dict):
+        grad_arrays = [args_grad.get(n) for n in arg_names]
+        reqs = [r if g is not None else "null" for r, g in zip(reqs, grad_arrays)]
+    else:
+        grad_arrays = list(args_grad)
+
+    if aux_states is None:
+        aux_arrays = []
+        for n in aux_names:
+            raise MXNetError("bind: missing aux state %r" % n)
+    elif isinstance(aux_states, dict):
+        missing = [n for n in aux_names if n not in aux_states]
+        if missing:
+            raise MXNetError("bind: missing aux states %s" % missing)
+        aux_arrays = [aux_states[n] for n in aux_names]
+    else:
+        aux_arrays = list(aux_states)
+        if len(aux_arrays) != len(aux_names):
+            raise MXNetError("bind: expected %d aux states, got %d" % (len(aux_names), len(aux_arrays)))
+
+    return Executor(symbol, ctx, arg_arrays, grad_arrays, reqs, aux_arrays, program=prog)
+
+
+def simple_bind(symbol, ctx, grad_req="write", type_dict=None, group2ctx=None, shared_exec=None, **kwargs):
+    """Infer shapes/types from kwarg shapes, allocate all arrays, bind
+    (reference: symbol.py:836 simple_bind)."""
+    shape_hints = {k: tuple(v) for k, v in kwargs.items() if v is not None}
+    type_hints = {k: np_dtype(v) for k, v in (type_dict or {}).items()}
+    try:
+        res = symbol._infer_impl(shape_hints, type_hints, partial=False)
+    except MXNetError as e:
+        raise MXNetError("simple_bind failed: %s" % e)
+    arg_shapes, out_shapes, aux_shapes, arg_types, out_types, aux_types = res
+    ctx = Context(ctx) if not isinstance(ctx, Context) else ctx
+
+    arg_names = symbol.list_arguments()
+    reqs = _normalize_grad_req(grad_req, arg_names)
+    arg_arrays = [zeros(s, ctx=ctx, dtype=t) for s, t in zip(arg_shapes, arg_types)]
+    grad_arrays = [
+        zeros(s, ctx=ctx, dtype=t) if r != "null" else None
+        for s, t, r in zip(arg_shapes, arg_types, reqs)
+    ]
+    aux_arrays = [zeros(s, ctx=ctx, dtype=t) for s, t in zip(aux_shapes, aux_types)]
+    return bind(
+        symbol,
+        ctx,
+        arg_arrays,
+        args_grad=grad_arrays,
+        grad_req=reqs,
+        aux_states=aux_arrays,
+        shared_exec=shared_exec,
+    )

@@ -305,11 +305,12 @@ def _topk(attrs, data):
     ax = data.ndim - 1 if ax in (None, "None") else int(ax) % data.ndim
     k = attrs["k"]
     vals = data if not attrs["is_ascend"] else -data
-    top_vals, top_idx = jax.lax.top_k(jnp.moveaxis(vals, ax, -1), k)
+    moved = jnp.moveaxis(vals, ax, -1)
+    top_vals, raw_idx = jax.lax.top_k(moved, k)
     if attrs["is_ascend"]:
         top_vals = -top_vals
     top_vals = jnp.moveaxis(top_vals, -1, ax)
-    top_idx = jnp.moveaxis(top_idx, -1, ax).astype(jnp.float32)
+    top_idx = jnp.moveaxis(raw_idx, -1, ax).astype(jnp.float32)
     rt = attrs["ret_typ"]
     if rt == "value":
         return top_vals
@@ -317,9 +318,7 @@ def _topk(attrs, data):
         return top_vals, top_idx
     if rt == "mask":
         # 0/1 mask with ones at top-k positions (reference: ordering_op kRetMask)
-        moved = jnp.moveaxis(vals, ax, -1)
-        _, idx = jax.lax.top_k(moved, k)
-        onehot = jax.nn.one_hot(idx, moved.shape[-1], dtype=data.dtype)
+        onehot = jax.nn.one_hot(raw_idx, moved.shape[-1], dtype=data.dtype)
         mask = jnp.clip(jnp.sum(onehot, axis=-2), 0, 1)
         return jnp.moveaxis(mask, -1, ax)
     if rt != "indices":

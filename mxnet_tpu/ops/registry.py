@@ -186,11 +186,12 @@ def register(
                 "duplicate operator registration %r (already %s)"
                 % (name, "canonical" if name in _CANONICAL else "an alias")
             )
-        _CANONICAL[name] = op
-        _REGISTRY[name] = op
         for a in aliases:
             if a in _REGISTRY:
                 raise MXNetError("operator alias %r collides with existing op" % a)
+        _CANONICAL[name] = op
+        _REGISTRY[name] = op
+        for a in aliases:
             _REGISTRY[a] = op
         return fn
 

@@ -1,0 +1,161 @@
+"""Parameter-shape inference rules.
+
+The reference's ``InferShape`` pass runs bidirectionally so ``simple_bind``
+can deduce every weight shape from just the data shape
+(/root/reference/src/executor/graph_executor.cc:423, per-op InferShape
+functions e.g. fully_connected-inl.h). In the TPU-native design, forward
+shape inference comes free from ``jax.eval_shape`` over the op function; the
+only genuinely backward-flowing facts are *parameter* shapes (weights, biases,
+norm stats, labels), captured here as per-op rules.
+
+Each rule receives the parsed attrs and the list of currently-known input
+shapes (``None`` = unknown), ordered ``input_names + aux_names``, and returns
+the list with any deducible entries filled in.
+"""
+from __future__ import annotations
+
+import numpy as np
+
+from .rnn import rnn_param_size
+
+RULES = {}
+
+
+def rule(name):
+    def _r(fn):
+        RULES[name] = fn
+        return fn
+
+    return _r
+
+
+def _prod(xs):
+    p = 1
+    for x in xs:
+        p *= int(x)
+    return p
+
+
+@rule("FullyConnected")
+def _fc(attrs, shapes):
+    data = shapes[0]
+    if data is not None:
+        nh = attrs["num_hidden"]
+        d = _prod(data[1:]) if attrs.get("flatten", True) else data[-1]
+        if shapes[1] is None:
+            shapes[1] = (nh, d)
+        if len(shapes) > 2 and shapes[2] is None:
+            shapes[2] = (nh,)
+    return shapes
+
+
+@rule("Convolution")
+def _conv(attrs, shapes):
+    data = shapes[0]
+    if data is not None:
+        nf, g = attrs["num_filter"], attrs.get("num_group", 1)
+        if shapes[1] is None:
+            shapes[1] = (nf, data[1] // g) + tuple(attrs["kernel"])
+        if len(shapes) > 2 and shapes[2] is None:
+            shapes[2] = (nf,)
+    return shapes
+
+
+@rule("Deconvolution")
+def _deconv(attrs, shapes):
+    data = shapes[0]
+    if data is not None:
+        nf, g = attrs["num_filter"], attrs.get("num_group", 1)
+        if shapes[1] is None:
+            shapes[1] = (data[1], nf // g) + tuple(attrs["kernel"])
+        if len(shapes) > 2 and shapes[2] is None:
+            shapes[2] = (nf,)
+    return shapes
+
+
+@rule("BatchNorm")
+def _bn(attrs, shapes):
+    data = shapes[0]
+    if data is not None:
+        c = (data[1],)
+        for i in range(1, 5):  # gamma, beta, moving_mean, moving_var
+            if shapes[i] is None:
+                shapes[i] = c
+    return shapes
+
+
+@rule("InstanceNorm")
+def _in(attrs, shapes):
+    data = shapes[0]
+    if data is not None:
+        for i in (1, 2):
+            if shapes[i] is None:
+                shapes[i] = (data[1],)
+    return shapes
+
+
+@rule("LeakyReLU")
+def _lrelu(attrs, shapes):
+    data = shapes[0]
+    if data is not None and len(shapes) > 1 and shapes[1] is None:
+        shapes[1] = (data[1],)
+    return shapes
+
+
+@rule("Embedding")
+def _embedding(attrs, shapes):
+    if shapes[1] is None:
+        shapes[1] = (attrs["input_dim"], attrs["output_dim"])
+    return shapes
+
+
+@rule("RNN")
+def _rnn_shapes(attrs, shapes):
+    data = shapes[0]
+    if data is not None:
+        T, N, I = data
+        H, L = attrs["state_size"], attrs["num_layers"]
+        d = 2 if attrs.get("bidirectional") else 1
+        if shapes[1] is None:
+            shapes[1] = (rnn_param_size(L, I, H, attrs.get("bidirectional", False), attrs["mode"]),)
+        if shapes[2] is None:
+            shapes[2] = (L * d, N, H)
+        if len(shapes) > 3 and shapes[3] is None:
+            shapes[3] = (L * d, N, H)
+    return shapes
+
+
+@rule("SoftmaxOutput")
+def _softmax_out(attrs, shapes):
+    data = shapes[0]
+    if data is not None and shapes[1] is None:
+        if attrs.get("multi_output") and len(data) > 2:
+            shapes[1] = (data[0],) + tuple(data[2:])
+        elif attrs.get("preserve_shape"):
+            shapes[1] = tuple(data[:-1])
+        else:
+            shapes[1] = (data[0],)
+    return shapes
+
+
+def _label_like_data(attrs, shapes):
+    if shapes[0] is not None and shapes[1] is None:
+        shapes[1] = tuple(shapes[0])
+    return shapes
+
+
+for _n in ("LinearRegressionOutput", "LogisticRegressionOutput", "MAERegressionOutput"):
+    RULES[_n] = _label_like_data
+
+
+@rule("SVMOutput")
+def _svm_out(attrs, shapes):
+    data = shapes[0]
+    if data is not None and shapes[1] is None:
+        shapes[1] = (data[0],)
+    return shapes
+
+
+@rule("IdentityAttachKLSparseReg")
+def _klreg(attrs, shapes):
+    return shapes

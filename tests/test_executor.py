@@ -1,0 +1,215 @@
+"""Executor tests: bind/simple_bind, fwd/bwd numerics, grad_req, aux states.
+
+Modeled on the reference's tests/python/unittest/test_executor.py
+(bind correctness against numpy, grad accumulation, reshape)."""
+import numpy as np
+import pytest
+
+import mxnet_tpu as mx
+
+
+def _rand(*shape):
+    return np.random.RandomState(0).uniform(-1, 1, shape).astype(np.float32)
+
+
+def test_bind_add_mul_backward():
+    rng = np.random.RandomState(3)
+    a = mx.sym.Variable("a")
+    b = mx.sym.Variable("b")
+    c = a * b + a
+    an, bn = rng.uniform(-1, 1, (4, 5)).astype("f"), rng.uniform(-1, 1, (4, 5)).astype("f")
+    ga = mx.nd.zeros((4, 5))
+    gb = mx.nd.zeros((4, 5))
+    ex = c.bind(
+        mx.cpu(),
+        {"a": mx.nd.array(an), "b": mx.nd.array(bn)},
+        args_grad={"a": ga, "b": gb},
+    )
+    out = ex.forward(is_train=True)
+    assert np.allclose(out[0].asnumpy(), an * bn + an, atol=1e-6)
+    head = np.ones((4, 5), dtype="f") * 2.0
+    ex.backward(mx.nd.array(head))
+    assert np.allclose(ga.asnumpy(), head * (bn + 1), atol=1e-6)
+    assert np.allclose(gb.asnumpy(), head * an, atol=1e-6)
+
+
+def test_grad_req_add_accumulates():
+    a = mx.sym.Variable("a")
+    out = a * 3.0
+    ga = mx.nd.zeros((2, 2))
+    ex = out.bind(mx.cpu(), {"a": mx.nd.ones((2, 2))}, args_grad={"a": ga}, grad_req="add")
+    ex.forward(is_train=True)
+    ex.backward(mx.nd.ones((2, 2)))
+    ex.backward(mx.nd.ones((2, 2)))
+    assert np.allclose(ga.asnumpy(), 6.0 * np.ones((2, 2)))
+
+
+def test_grad_req_null_skips():
+    a = mx.sym.Variable("a")
+    b = mx.sym.Variable("b")
+    out = a * b
+    ga = mx.nd.zeros((2,))
+    gb = mx.nd.zeros((2,))
+    ex = out.bind(
+        mx.cpu(),
+        {"a": mx.nd.ones((2,)), "b": mx.nd.ones((2,))},
+        args_grad={"a": ga, "b": gb},
+        grad_req={"a": "write", "b": "null"},
+    )
+    ex.forward(is_train=True)
+    ex.backward(mx.nd.ones((2,)))
+    assert np.allclose(ga.asnumpy(), 1.0)
+    assert np.allclose(gb.asnumpy(), 0.0)
+
+
+def test_simple_bind_allocates_and_infers():
+    data = mx.sym.Variable("data")
+    fc = mx.sym.FullyConnected(data=data, num_hidden=8, name="fc")
+    sm = mx.sym.SoftmaxOutput(data=fc, name="softmax")
+    ex = sm.simple_bind(ctx=mx.cpu(), data=(16, 30))
+    assert ex.arg_dict["fc_weight"].shape == (8, 30)
+    assert ex.arg_dict["softmax_label"].shape == (16,)
+    assert ex.grad_dict["fc_weight"].shape == (8, 30)
+    out = ex.forward(is_train=False)
+    assert out[0].shape == (16, 8)
+    assert np.allclose(out[0].asnumpy().sum(axis=1), 1.0, atol=1e-5)
+
+
+def test_softmax_output_backward_semantics():
+    """SoftmaxOutput backward = (p - onehot) regardless of head gradient."""
+    data = mx.sym.Variable("data")
+    sm = mx.sym.SoftmaxOutput(data=data, name="softmax")
+    x = _rand(3, 4)
+    label = np.array([0, 1, 3], dtype="f")
+    gd = mx.nd.zeros((3, 4))
+    ex = sm.bind(
+        mx.cpu(),
+        {"data": mx.nd.array(x), "softmax_label": mx.nd.array(label)},
+        args_grad={"data": gd},
+    )
+    out = ex.forward(is_train=True)
+    ex.backward()
+    p = np.exp(x) / np.exp(x).sum(axis=1, keepdims=True)
+    onehot = np.eye(4, dtype="f")[label.astype(int)]
+    assert np.allclose(out[0].asnumpy(), p, atol=1e-5)
+    assert np.allclose(gd.asnumpy(), p - onehot, atol=1e-5)
+
+
+def test_batchnorm_aux_updated_only_in_forward_train():
+    data = mx.sym.Variable("data")
+    bn = mx.sym.BatchNorm(data=data, momentum=0.5, name="bn")
+    ex = bn.simple_bind(ctx=mx.cpu(), data=(8, 3))
+    ex.arg_dict["data"][:] = _rand(8, 3) + 2.0
+    ex.arg_dict["bn_gamma"][:] = 1.0
+    mm0 = ex.aux_dict["bn_moving_mean"].asnumpy().copy()
+    ex.forward(is_train=False)
+    assert np.allclose(ex.aux_dict["bn_moving_mean"].asnumpy(), mm0)
+    ex.forward(is_train=True)
+    mm1 = ex.aux_dict["bn_moving_mean"].asnumpy().copy()
+    assert not np.allclose(mm1, mm0)
+    batch_mean = ex.arg_dict["data"].asnumpy().mean(axis=0)
+    assert np.allclose(mm1, 0.5 * mm0 + 0.5 * batch_mean, atol=1e-5)
+    # backward must not touch aux again
+    ex.backward()
+    assert np.allclose(ex.aux_dict["bn_moving_mean"].asnumpy(), mm1)
+
+
+def test_forward_backward_fused_matches_separate():
+    data = mx.sym.Variable("data")
+    fc = mx.sym.FullyConnected(data=data, num_hidden=4, name="fc")
+    sm = mx.sym.SoftmaxOutput(data=fc, name="softmax")
+    x = _rand(6, 5)
+    lab = np.array([0, 1, 2, 3, 0, 1], dtype="f")
+
+    def build():
+        ex = sm.simple_bind(ctx=mx.cpu(), data=(6, 5))
+        ex.arg_dict["data"][:] = x
+        ex.arg_dict["fc_weight"][:] = _rand(4, 5)
+        ex.arg_dict["softmax_label"][:] = lab
+        return ex
+
+    e1, e2 = build(), build()
+    e1.forward(is_train=True)
+    e1.backward()
+    e2.forward_backward()
+    assert np.allclose(e1.outputs[0].asnumpy(), e2.outputs[0].asnumpy(), atol=1e-6)
+    assert np.allclose(
+        e1.grad_dict["fc_weight"].asnumpy(), e2.grad_dict["fc_weight"].asnumpy(), atol=1e-6
+    )
+
+
+def test_executor_forward_kwargs_update():
+    a = mx.sym.Variable("a")
+    out = a * 2.0
+    ex = out.bind(mx.cpu(), {"a": mx.nd.zeros((2, 2))})
+    res = ex.forward(a=np.full((2, 2), 3.0, dtype="f"))
+    assert np.allclose(res[0].asnumpy(), 6.0)
+
+
+def test_executor_reshape():
+    data = mx.sym.Variable("data")
+    fc = mx.sym.FullyConnected(data=data, num_hidden=4, name="fc")
+    ex = fc.simple_bind(ctx=mx.cpu(), data=(8, 10))
+    w = ex.arg_dict["fc_weight"]
+    w[:] = _rand(4, 10)
+    ex2 = ex.reshape(data=(2, 10))
+    assert ex2.arg_dict["data"].shape == (2, 10)
+    # weight shape unchanged → same array shared
+    assert ex2.arg_dict["fc_weight"].shape == (4, 10)
+    x = _rand(2, 10)
+    ex2.arg_dict["data"][:] = x
+    out = ex2.forward()
+    assert np.allclose(out[0].asnumpy(), x @ w.asnumpy().T, atol=1e-5)
+
+
+def test_executor_copy_params_from():
+    data = mx.sym.Variable("data")
+    fc = mx.sym.FullyConnected(data=data, num_hidden=3, no_bias=True, name="fc")
+    ex = fc.simple_bind(ctx=mx.cpu(), data=(2, 4))
+    w = _rand(3, 4)
+    ex.copy_params_from({"fc_weight": mx.nd.array(w)})
+    assert np.allclose(ex.arg_dict["fc_weight"].asnumpy(), w)
+    with pytest.raises(mx.MXNetError):
+        ex.copy_params_from({"bogus": mx.nd.zeros((1,))})
+
+
+def test_dropout_rng_consistent_between_fwd_bwd():
+    data = mx.sym.Variable("data")
+    d = mx.sym.Dropout(data=data, p=0.5, name="drop")
+    x = np.ones((100,), dtype="f")
+    gd = mx.nd.zeros((100,))
+    ex = d.bind(mx.cpu(), {"data": mx.nd.array(x)}, args_grad={"data": gd})
+    out = ex.forward(is_train=True)
+    mask_fwd = out[0].asnumpy() != 0
+    ex.backward(mx.nd.ones((100,)))
+    mask_bwd = gd.asnumpy() != 0
+    assert (mask_fwd == mask_bwd).all()
+
+
+def test_multi_output_executor():
+    data = mx.sym.Variable("data")
+    parts = mx.sym.SliceChannel(data=data, num_outputs=2, axis=1, name="sl")
+    g = mx.sym.Group([parts[0] * 2.0, parts[1] + 1.0])
+    x = _rand(3, 4)
+    ex = g.bind(mx.cpu(), {"data": mx.nd.array(x)})
+    outs = ex.forward()
+    assert len(outs) == 2
+    assert np.allclose(outs[0].asnumpy(), x[:, :2] * 2.0, atol=1e-6)
+    assert np.allclose(outs[1].asnumpy(), x[:, 2:] + 1.0, atol=1e-6)
+
+
+def test_rnn_symbol_bind():
+    data = mx.sym.Variable("data")
+    rnn = mx.sym.RNN(
+        data=data, state_size=6, num_layers=1, mode="lstm", name="lstm", state_outputs=True
+    )
+    arg_shapes, out_shapes, _ = rnn.infer_shape(data=(7, 2, 5))
+    d = dict(zip(rnn.list_arguments(), arg_shapes))
+    assert d["lstm_state"] == (1, 2, 6)
+    assert out_shapes[0] == (7, 2, 6)
+    ex = rnn.simple_bind(ctx=mx.cpu(), data=(7, 2, 5))
+    ex.arg_dict["data"][:] = _rand(7, 2, 5)
+    ex.arg_dict["lstm_parameters"][:] = _rand(*d["lstm_parameters"]) * 0.1
+    outs = ex.forward(is_train=True)
+    assert outs[0].shape == (7, 2, 6)
+    assert outs[1].shape == (1, 2, 6)

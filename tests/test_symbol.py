@@ -1,0 +1,203 @@
+"""Symbol composition / inference / JSON tests.
+
+Modeled on the reference's tests/python/unittest/test_symbol.py and
+test_infer_shape.py (composition, list_arguments, infer_shape chains,
+attr handling, internals, save/load)."""
+import json
+
+import numpy as np
+import pytest
+
+import mxnet_tpu as mx
+
+
+def mlp_two_layers():
+    data = mx.sym.Variable("data")
+    net = mx.sym.FullyConnected(data=data, name="fc1", num_hidden=10)
+    net = mx.sym.Activation(data=net, act_type="relu", name="relu1")
+    net = mx.sym.FullyConnected(data=net, name="fc2", num_hidden=100)
+    return net
+
+
+def test_symbol_basic_compose():
+    net = mlp_two_layers()
+    assert net.list_arguments() == [
+        "data", "fc1_weight", "fc1_bias", "fc2_weight", "fc2_bias",
+    ]
+    assert net.list_outputs() == ["fc2_output"]
+    assert net.name == "fc2"
+
+
+def test_symbol_infer_shape_mlp():
+    net = mlp_two_layers()
+    arg_shapes, out_shapes, aux_shapes = net.infer_shape(data=(32, 200))
+    assert arg_shapes == [(32, 200), (10, 200), (10,), (100, 10), (100,)]
+    assert out_shapes == [(32, 100)]
+    assert aux_shapes == []
+
+
+def test_symbol_infer_shape_underdetermined():
+    net = mlp_two_layers()
+    arg, out, aux = net.infer_shape()
+    assert arg is None and out is None and aux is None
+
+
+def test_symbol_infer_shape_partial():
+    data = mx.sym.Variable("data")
+    prev = mx.sym.Variable("prev")
+    net = mx.sym.FullyConnected(data=data, name="fc1", num_hidden=128)
+    net2 = mx.sym.FullyConnected(data=prev, name="fc2", num_hidden=128)
+    out = net + net2
+    arg_shapes, _, _ = out.infer_shape_partial(data=(10, 64))
+    args = out.list_arguments()
+    d = dict(zip(args, arg_shapes))
+    assert d["fc1_weight"] == (128, 64)
+    assert d["prev"] is None
+    assert d["fc2_weight"] is None
+
+
+def test_symbol_infer_conv_chain():
+    data = mx.sym.Variable("data")
+    c = mx.sym.Convolution(data=data, kernel=(3, 3), num_filter=16, pad=(1, 1), name="c")
+    b = mx.sym.BatchNorm(data=c, name="b")
+    p = mx.sym.Pooling(data=b, kernel=(2, 2), stride=(2, 2), pool_type="max")
+    arg_shapes, out_shapes, aux_shapes = p.infer_shape(data=(2, 3, 32, 32))
+    d = dict(zip(p.list_arguments(), arg_shapes))
+    assert d["c_weight"] == (16, 3, 3, 3)
+    assert d["c_bias"] == (16,)
+    assert d["b_gamma"] == (16,)
+    assert out_shapes == [(2, 16, 16, 16)]
+    assert aux_shapes == [(16,), (16,)]
+    assert p.list_auxiliary_states() == ["b_moving_mean", "b_moving_var"]
+
+
+def test_symbol_infer_type():
+    data = mx.sym.Variable("data")
+    fc = mx.sym.FullyConnected(data=data, num_hidden=4, name="fc")
+    arg_types, out_types, _ = fc.infer_type(data=np.float32)
+    assert all(t == np.dtype(np.float32) for t in arg_types)
+    assert out_types == [np.dtype(np.float32)]
+
+
+def test_symbol_group_and_getitem():
+    data = mx.sym.Variable("data")
+    fc1 = mx.sym.FullyConnected(data=data, num_hidden=4, name="fc1")
+    fc2 = mx.sym.FullyConnected(data=data, num_hidden=8, name="fc2")
+    g = mx.sym.Group([fc1, fc2])
+    assert g.list_outputs() == ["fc1_output", "fc2_output"]
+    assert len(g) == 2
+    sub = g["fc2_output"]
+    assert sub.list_outputs() == ["fc2_output"]
+    with pytest.raises(mx.MXNetError):
+        g["nope"]
+
+
+def test_symbol_internals():
+    net = mlp_two_layers()
+    internals = net.get_internals()
+    names = internals.list_outputs()
+    assert "fc1_output" in names and "relu1_output" in names
+    fc1 = internals["fc1_output"]
+    assert fc1.list_arguments() == ["data", "fc1_weight", "fc1_bias"]
+
+
+def test_symbol_arithmetic_and_scalar():
+    a = mx.sym.Variable("a")
+    b = mx.sym.Variable("b")
+    c = (a + b) * 2.0 - a / b + (a ** 2)
+    ex = c.bind(mx.cpu(), {"a": mx.nd.array([4.0]), "b": mx.nd.array([2.0])})
+    out = ex.forward()
+    # (4+2)*2 - 4/2 + 16 = 26
+    assert np.allclose(out[0].asnumpy(), [26.0])
+
+
+def test_symbol_attr_and_scope():
+    data = mx.sym.Variable("data", shape=(3, 4), lr_mult=2.0)
+    assert data.attr("__shape__") == "(3, 4)"
+    assert data.attr("__lr_mult__") == "2.0"
+    with mx.AttrScope(ctx_group="dev1"):
+        fc = mx.sym.FullyConnected(data=data, num_hidden=3, name="fc")
+    assert fc.attr("__ctx_group__") == "dev1"
+    # shape attr participates in inference
+    arg_shapes, out_shapes, _ = data.infer_shape()
+    assert arg_shapes == [(3, 4)]
+
+
+def test_symbol_variable_shape_used_in_bind():
+    data = mx.sym.Variable("data")
+    fc = mx.sym.FullyConnected(data=data, num_hidden=3, name="fc")
+    arg_shapes, out_shapes, _ = fc.infer_shape(data=(5, 7))
+    assert out_shapes == [(5, 3)]
+
+
+def test_symbol_json_roundtrip():
+    net = mlp_two_layers()
+    js = net.tojson()
+    graph = json.loads(js)
+    assert "nodes" in graph and "arg_nodes" in graph and "heads" in graph
+    assert "node_row_ptr" in graph
+    net2 = mx.sym.load_json(js)
+    assert net2.list_arguments() == net.list_arguments()
+    assert net2.list_outputs() == net.list_outputs()
+    a1, o1, _ = net.infer_shape(data=(4, 6))
+    a2, o2, _ = net2.infer_shape(data=(4, 6))
+    assert a1 == a2 and o1 == o2
+
+
+def test_symbol_json_file_roundtrip(tmp_path):
+    net = mlp_two_layers()
+    fname = str(tmp_path / "net-symbol.json")
+    net.save(fname)
+    net2 = mx.sym.load(fname)
+    assert net2.list_outputs() == ["fc2_output"]
+
+
+def test_symbol_json_attr_stringified():
+    data = mx.sym.Variable("data")
+    c = mx.sym.Convolution(data=data, kernel=(3, 3), num_filter=4, stride=(2, 2), name="c")
+    graph = json.loads(c.tojson())
+    node = [n for n in graph["nodes"] if n["name"] == "c"][0]
+    assert node["attr"]["kernel"] == "(3, 3)"
+    # attrs parse back identically
+    c2 = mx.sym.load_json(c.tojson())
+    a1, o1, _ = c.infer_shape(data=(1, 2, 8, 8))
+    a2, o2, _ = c2.infer_shape(data=(1, 2, 8, 8))
+    assert o1 == o2 == [(1, 4, 3, 3)]
+
+
+def test_symbol_multi_output_indexing():
+    data = mx.sym.Variable("data")
+    sliced = mx.sym.SliceChannel(data=data, num_outputs=3, name="slice")
+    assert len(sliced) == 3
+    assert sliced.list_outputs() == ["slice_output0", "slice_output1", "slice_output2"]
+    one = sliced[1]
+    ex = one.bind(mx.cpu(), {"data": mx.nd.array(np.arange(6).reshape(2, 3).astype("f"))})
+    out = ex.forward()
+    assert np.allclose(out[0].asnumpy(), [[1.0], [4.0]])
+
+
+def test_symbol_variadic_concat():
+    a = mx.sym.Variable("a")
+    b = mx.sym.Variable("b")
+    c = mx.sym.Concat(a, b, dim=1, name="cat")
+    arg_shapes, out_shapes, _ = c.infer_shape(a=(2, 3), b=(2, 5))
+    assert out_shapes == [(2, 8)]
+
+
+def test_symbol_name_manager_unique():
+    with mx.name.NameManager():
+        f1 = mx.sym.FullyConnected(data=mx.sym.Variable("x"), num_hidden=2)
+        f2 = mx.sym.FullyConnected(data=mx.sym.Variable("y"), num_hidden=2)
+        assert f1.name != f2.name
+    with mx.name.Prefix("net_"):
+        f3 = mx.sym.FullyConnected(data=mx.sym.Variable("z"), num_hidden=2)
+        assert f3.name.startswith("net_")
+
+
+def test_symbol_deep_graph_no_recursion():
+    x = mx.sym.Variable("x")
+    net = x
+    for _ in range(2000):
+        net = net + 1.0
+    assert len(net.list_arguments()) == 1
+    assert net.tojson()
