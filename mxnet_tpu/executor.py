@@ -20,7 +20,7 @@ Semantics kept from the reference:
 """
 from __future__ import annotations
 
-import functools
+
 from typing import Dict, List, Optional
 
 import numpy as np
@@ -52,6 +52,9 @@ class _GraphProgram:
         for node in self.topo:
             if node.op is not None and get_op(node.op).needs_rng:
                 self._rng_ids[id(node)] = len(self._rng_ids)
+        # per-instance jit cache (an lru_cache on the methods would key a
+        # class-level cache on self and leak every program + XLA executable)
+        self._jit_cache = {}
 
     # ---------------------------------------------------------------- tracing
     def interpret(self, arg_vals, aux_vals, is_train, rng):
@@ -94,16 +97,24 @@ class _GraphProgram:
         return outputs, tuple(new_aux)
 
     # --------------------------------------------------------------- compiled
-    @functools.lru_cache(maxsize=None)
     def _fwd(self, is_train):
+        key = ("fwd", is_train)
+        if key in self._jit_cache:
+            return self._jit_cache[key]
         import jax
 
         def run(args, aux, rng):
             return self.interpret(args, aux, is_train, rng)
 
-        return jax.jit(run)
+        self._jit_cache[key] = jax.jit(run)
+        return self._jit_cache[key]
 
-    @functools.lru_cache(maxsize=None)
+    def _fwd_bwd_cached(self, with_head_grads):
+        key = ("fwd_bwd", with_head_grads)
+        if key not in self._jit_cache:
+            self._jit_cache[key] = self._fwd_bwd(with_head_grads)
+        return self._jit_cache[key]
+
     def _fwd_bwd(self, with_head_grads):
         """One XLA computation: forward + full backward (the reference's
         InitFullGraph fwd+bwd graph, graph_executor.cc:178)."""
@@ -205,7 +216,7 @@ class Executor:
         rng = self._last_rng if self._last_rng is not None else self._next_rng()
         if out_grads is None:
             head: tuple = ()
-            fn = self._prog._fwd_bwd(False)
+            fn = self._prog._fwd_bwd_cached(False)
             outs, grads, _ = fn(args, aux, (), rng)
         else:
             if isinstance(out_grads, NDArray):
@@ -216,7 +227,7 @@ class Executor:
                     "backward: expected %d head gradients, got %d"
                     % (len(self._prog.outputs), len(head))
                 )
-            fn = self._prog._fwd_bwd(True)
+            fn = self._prog._fwd_bwd_cached(True)
             outs, grads, _ = fn(args, aux, head, rng)
         self._apply_grads(grads)
 
@@ -227,11 +238,11 @@ class Executor:
         args, aux = self._collect()
         rng = self._next_rng()
         if out_grads is None:
-            fn = self._prog._fwd_bwd(False)
+            fn = self._prog._fwd_bwd_cached(False)
             outs, grads, new_aux = fn(args, aux, (), rng)
         else:
             head = tuple(g._jax() for g in out_grads)
-            fn = self._prog._fwd_bwd(True)
+            fn = self._prog._fwd_bwd_cached(True)
             outs, grads, new_aux = fn(args, aux, head, rng)
         self._write_aux(new_aux)
         self._apply_grads(grads)
@@ -253,20 +264,46 @@ class Executor:
     def reshape(self, partial_shaping=False, allow_up_sizing=False, **kwargs):
         """Return a new executor bound to new shapes (reference:
         executor.py reshape). XLA recompiles per shape — same economics as the
-        reference's executor-per-bucket."""
-        arg_shapes, _, aux_shapes = self._symbol.infer_shape(**kwargs)
-        if arg_shapes is None:
-            raise MXNetError("reshape: insufficient shape info")
-        new_args, new_grads, new_aux = [], [], []
-        for arr, garr, req, shape in zip(self.arg_arrays, self.grad_arrays, self._grad_req, arg_shapes):
+        reference's executor-per-bucket. ``partial_shaping`` keeps old shapes
+        for arguments the new hints leave undetermined; without
+        ``allow_up_sizing`` an argument may not grow."""
+        if partial_shaping:
+            arg_shapes, _, aux_shapes = self._symbol.infer_shape_partial(**kwargs)
+        else:
+            arg_shapes, _, aux_shapes = self._symbol.infer_shape(**kwargs)
+            if arg_shapes is None:
+                raise MXNetError(
+                    "reshape: insufficient shape info (pass partial_shaping=True "
+                    "to keep old shapes for undetermined arguments)"
+                )
+
+        def _renew(arr, shape, name):
+            if shape is None:
+                if not partial_shaping:
+                    raise MXNetError("reshape: shape of %r undetermined" % name)
+                return arr, False
             if tuple(arr.shape) == tuple(shape):
-                new_args.append(arr)
-                new_grads.append(garr)
+                return arr, False
+            new_size = int(np.prod(shape))
+            if new_size > arr.size and not allow_up_sizing:
+                raise MXNetError(
+                    "reshape: new shape %s of %r is larger than original %s; pass "
+                    "allow_up_sizing=True to permit reallocation" % (shape, name, arr.shape)
+                )
+            return zeros(shape, ctx=self._ctx, dtype=arr.dtype), True
+
+        new_args, new_grads, new_aux = [], [], []
+        for name, arr, garr, shape in zip(
+            self._prog.arg_names, self.arg_arrays, self.grad_arrays, arg_shapes
+        ):
+            na, changed = _renew(arr, shape, name)
+            new_args.append(na)
+            if garr is None:
+                new_grads.append(None)
             else:
-                new_args.append(zeros(shape, ctx=self._ctx, dtype=arr.dtype))
-                new_grads.append(zeros(shape, ctx=self._ctx, dtype=arr.dtype) if garr is not None else None)
-        for arr, shape in zip(self.aux_arrays, aux_shapes):
-            new_aux.append(arr if tuple(arr.shape) == tuple(shape) else zeros(shape, ctx=self._ctx, dtype=arr.dtype))
+                new_grads.append(zeros(na.shape, ctx=self._ctx, dtype=garr.dtype) if changed else garr)
+        for name, arr, shape in zip(self._prog.aux_names, self.aux_arrays, aux_shapes):
+            new_aux.append(_renew(arr, shape, name)[0])
         return Executor(self._symbol, self._ctx, new_args, new_grads, self._grad_req, new_aux, program=self._prog)
 
     def set_monitor_callback(self, callback):

@@ -1,0 +1,188 @@
+"""KVStore: key-value parameter synchronization.
+
+Counterpart of the reference's KVStore stack (include/mxnet/kvstore.h:26-303,
+src/kvstore/kvstore_local.h:22, python/mxnet/kvstore.py). Semantics kept:
+``push`` reduces (sums) the per-device values of a key, then either applies the
+updater (the optimizer) to the stored weight or replaces it; ``pull``
+broadcasts the stored weight to every requested output
+(kvstore_local.h:50-88).
+
+Types:
+  * ``local`` / ``device`` — single-process multi-device aggregation. On this
+    backend both reduce on the source devices (XLA handles placement); the
+    cpu-pinned-vs-gpu distinction of the reference's CommCPU/CommDevice
+    (comm.h:61,200) is a no-op under PJRT unified memory management.
+  * ``dist_tpu_sync`` (and the reference spellings ``dist_sync`` /
+    ``dist_device_sync``) — SPMD data parallelism over a JAX mesh: Push's
+    reduce becomes an all-reduce across chips riding ICI, rank/size come from
+    the JAX runtime (SURVEY.md §2.4 TPU-native plan). The ps-lite
+    server/scheduler roles are gone — in SPMD every process runs the same
+    program, so the "server side" IS the local update.
+"""
+from __future__ import annotations
+
+import pickle
+from typing import Dict, List, Optional
+
+from .base import MXNetError
+from . import ndarray as nd
+from . import optimizer as opt
+from .ndarray import NDArray
+
+__all__ = ["KVStore", "create"]
+
+
+class KVStore:
+    """(reference: python/mxnet/kvstore.py)"""
+
+    def __init__(self, type_name: str):
+        self._type = type_name
+        self._store: Dict = {}
+        self._updater: Optional[opt.Updater] = None
+        self._optimizer = None
+
+    # ------------------------------------------------------------------ meta
+    @property
+    def type(self) -> str:
+        return self._type
+
+    @property
+    def rank(self) -> int:
+        """(reference: kvstore.h get_rank → jax.process_index)"""
+        if "dist" in self._type:
+            import jax
+
+            return jax.process_index()
+        return 0
+
+    @property
+    def num_workers(self) -> int:
+        """(reference: kvstore.h get_group_size → jax.process_count)"""
+        if "dist" in self._type:
+            import jax
+
+            return jax.process_count()
+        return 1
+
+    # ------------------------------------------------------------------- api
+    def init(self, key, value):
+        """(reference: kvstore_local.h:40 Init)"""
+        keys, values = _key_value(key, value)
+        for k, v in zip(keys, values):
+            if k in self._store:
+                raise MXNetError("duplicate init of key %s" % k)
+            self._store[k] = v.copy()
+
+    def push(self, key, value, priority=0):
+        """Reduce values per key; apply updater or replace
+        (reference: kvstore_local.h:50 Push). priority is accepted for API
+        parity — XLA's async dispatch orders work by data dependency, the job
+        the reference's priority queues did by hand."""
+        keys, grouped = _group_kv(key, value)
+        for k, vals in zip(keys, grouped):
+            merged = self._reduce(vals)
+            if k not in self._store:
+                raise MXNetError("key %s has not been inited" % k)
+            if self._updater is not None:
+                self._updater(k, merged, self._store[k])
+            else:
+                self._store[k] = merged
+
+    def pull(self, key, out=None, priority=0):
+        """Broadcast stored weight to outputs (reference: kvstore_local.h:75)."""
+        assert out is not None
+        keys, grouped = _group_kv(key, out)
+        for k, outs in zip(keys, grouped):
+            if k not in self._store:
+                raise MXNetError("key %s has not been inited" % k)
+            local = self._store[k]
+            for o in outs:
+                o[:] = local
+
+    def _reduce(self, vals: List[NDArray]) -> NDArray:
+        if len(vals) == 1:
+            merged = vals[0].copy()
+        else:
+            # tree-free single fused sum: one XLA add chain, fused on-device
+            # (reference: comm.h ReduceSumCPU / CommDevice::Reduce)
+            merged = nd.add_n(*vals, num_args=len(vals))
+        if "dist" in self._type:
+            merged = self._allreduce(merged)
+        return merged
+
+    def _allreduce(self, arr: NDArray) -> NDArray:
+        """Cross-process all-reduce for dist_tpu_sync over DCN/ICI."""
+        import jax
+
+        if jax.process_count() == 1:
+            return arr
+        import jax.numpy as jnp
+        from jax.experimental.multihost_utils import process_allgather
+
+        gathered = process_allgather(arr._jax())
+        summed = jnp.sum(gathered, axis=0)
+        return NDArray(summed, ctx=arr.context)
+
+    # -------------------------------------------------------------- optimizer
+    def set_optimizer(self, optimizer):
+        """(reference: kvstore.py:232 set_optimizer; in dist mode the reference
+        pickles the optimizer to the servers — SPMD has no servers, the updater
+        runs in-process on every worker over all-reduced gradients.)"""
+        self._optimizer = optimizer
+        self._set_updater(opt.get_updater(optimizer))
+
+    def _set_updater(self, updater):
+        self._updater = updater
+
+    def _barrier(self):
+        """(reference: kvstore.h Barrier) — collective barrier across workers."""
+        if "dist" in self._type:
+            import jax
+
+            if jax.process_count() > 1:
+                from jax.experimental.multihost_utils import sync_global_devices
+
+                sync_global_devices("mxnet_tpu_kvstore_barrier")
+
+    def save_optimizer_states(self, fname):
+        assert self._updater is not None, "Cannot save states for distributed training"
+        with open(fname, "wb") as fout:
+            fout.write(self._updater.get_states())
+
+    def load_optimizer_states(self, fname):
+        assert self._updater is not None, "Cannot load states for distributed training"
+        with open(fname, "rb") as fin:
+            self._updater.set_states(fin.read())
+
+
+def _key_value(key, value):
+    if isinstance(key, (list, tuple)):
+        assert isinstance(value, (list, tuple)) and len(key) == len(value)
+        return list(key), list(value)
+    return [key], [value]
+
+
+def _group_kv(key, value):
+    """Group possibly-duplicate keys with per-device value lists
+    (reference: kvstore_local.h:95 GroupKVPairs)."""
+    if isinstance(key, (list, tuple)):
+        if len(key) and isinstance(value, (list, tuple)) and len(value) == len(key) and not isinstance(value[0], (list, tuple)):
+            return list(key), [[v] for v in value]
+        assert len(key) == len(value)
+        return list(key), [list(v) if isinstance(v, (list, tuple)) else [v] for v in value]
+    if isinstance(value, (list, tuple)):
+        return [key], [list(value)]
+    return [key], [[value]]
+
+
+def create(name="local") -> KVStore:
+    """Create a KVStore (reference: kvstore.py create / kvstore.cc:17-45).
+    ``dist_sync``/``dist_device_sync`` map onto ``dist_tpu_sync`` — the SPMD
+    collective design replaces the parameter-server topology."""
+    if not isinstance(name, str):
+        raise TypeError("name must be a string")
+    known = ("local", "device", "local_allreduce_cpu", "local_allreduce_device",
+             "dist_tpu_sync", "dist_sync", "dist_device_sync", "dist_async")
+    if name not in known:
+        raise MXNetError("unknown KVStore type %r (known: %s)" % (name, known))
+    return KVStore(name)

@@ -1,0 +1,83 @@
+"""KVStore/updater plumbing for the Module layer.
+
+Counterpart of the reference's python/mxnet/model.py:40-116 (_create_kvstore,
+_initialize_kvstore, _update_params_on_kvstore, _update_params) — the glue
+deciding where the optimizer runs and moving gradients through the store.
+"""
+from __future__ import annotations
+
+from . import kvstore as kvs
+from .base import MXNetError
+
+__all__ = [
+    "create_kvstore",
+    "initialize_kvstore",
+    "update_params_on_kvstore",
+    "update_params",
+]
+
+
+def create_kvstore(kvstore, num_device, arg_params):
+    """Decide kvstore + update_on_kvstore (reference: model.py:40 _create_kvstore)."""
+    update_on_kvstore = True
+    if kvstore is None:
+        kv = None
+    elif isinstance(kvstore, kvs.KVStore):
+        kv = kvstore
+    elif isinstance(kvstore, str):
+        if num_device == 1 and "dist" not in kvstore:
+            # one device: updater runs directly on the bound arrays
+            kv = None
+        else:
+            kv = kvs.create(kvstore)
+            if kvstore == "local":
+                # same heuristic as the reference: big arrays → update on store
+                max_size = max(np_prod(param.shape) for param in arg_params.values())
+                if max_size < 1024 * 1024 * 16:
+                    update_on_kvstore = False
+    else:
+        raise TypeError("kvstore must be KVStore, str or None")
+    if kv is None:
+        update_on_kvstore = False
+    return kv, update_on_kvstore
+
+
+def np_prod(shape):
+    p = 1
+    for s in shape:
+        p *= int(s)
+    return p
+
+
+def initialize_kvstore(kvstore, param_arrays, arg_params, param_names, update_on_kvstore):
+    """(reference: model.py _initialize_kvstore)"""
+    for idx, param_on_devs in enumerate(param_arrays):
+        kvstore.init(idx, arg_params[param_names[idx]])
+        if update_on_kvstore:
+            kvstore.pull(idx, param_on_devs, priority=-idx)
+
+
+def update_params_on_kvstore(param_arrays, grad_arrays, kvstore):
+    """(reference: model.py:88 _update_params_on_kvstore) — push grads (store
+    reduces + runs the optimizer), pull fresh weights back to every device."""
+    for index, pair in enumerate(zip(param_arrays, grad_arrays)):
+        arg_list, grad_list = pair
+        if grad_list[0] is None:
+            continue
+        kvstore.push(index, grad_list, priority=-index)
+        kvstore.pull(index, arg_list, priority=-index)
+
+
+def update_params(param_arrays, grad_arrays, updater, num_device, kvstore=None):
+    """(reference: model.py:99 _update_params) — optionally reduce via kvstore,
+    then run the updater per device copy."""
+    for index, pair in enumerate(zip(param_arrays, grad_arrays)):
+        arg_list, grad_list = pair
+        if grad_list[0] is None:
+            continue
+        if kvstore:
+            kvstore.push(index, grad_list, priority=-index)
+            kvstore.pull(index, grad_list, priority=-index)
+        for k, p, g in zip(range(len(arg_list)), arg_list, grad_list):
+            # use a unique integer key per (param, device) for updater state
+            updater(index * num_device + k, g, p)

@@ -1,0 +1,11 @@
+"""Module API: high-level training interface.
+
+Counterpart of the reference's python/mxnet/module/ package (BaseModule
+base_module.py:79, Module module.py:22, BucketingModule, SequentialModule).
+"""
+from .base_module import BaseModule, BatchEndParam
+from .module import Module
+from .bucketing_module import BucketingModule
+from .sequential_module import SequentialModule
+
+__all__ = ["BaseModule", "BatchEndParam", "Module", "BucketingModule", "SequentialModule"]

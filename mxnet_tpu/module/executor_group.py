@@ -1,0 +1,266 @@
+"""DataParallelExecutorGroup: per-device executor management.
+
+Counterpart of the reference's python/mxnet/module/executor_group.py:77
+(decide_slices :207, bind_exec :270, forward :355, backward :481,
+update_metric :511). One executor per context shares a single traced
+_GraphProgram, so XLA compiles the step once per shape and dispatches it on
+each device; gradient reduction across devices happens in the KVStore layer
+(or the local updater path), as in the reference. The single-device case —
+the common one on TPU, where *mesh* parallelism supersedes device lists
+(see parallel/) — has zero slicing overhead.
+"""
+from __future__ import annotations
+
+from typing import List, Optional
+
+import numpy as np
+
+from ..base import MXNetError
+from ..context import Context
+from ..executor import _GraphProgram, simple_bind
+from .. import ndarray as nd
+from ..ndarray import NDArray, zeros
+
+
+def _split_input_slice(batch_size, work_load_list):
+    """Batch index ranges per device (reference: executor_group.py:207
+    decide_slices / mxnet.executor_manager._split_input_slice)."""
+    total = sum(work_load_list)
+    if batch_size < len(work_load_list):
+        raise MXNetError("batch size must be >= number of devices")
+    slices = []
+    start = 0
+    for i, w in enumerate(work_load_list):
+        if i == len(work_load_list) - 1:
+            stop = batch_size
+        else:
+            stop = start + int(round(batch_size * w / total))
+        slices.append(slice(start, stop))
+        start = stop
+    return slices
+
+
+class DataParallelExecutorGroup:
+    """(reference: executor_group.py:77)"""
+
+    def __init__(
+        self,
+        symbol,
+        contexts: List[Context],
+        workload,
+        data_shapes,
+        label_shapes,
+        param_names,
+        for_training,
+        inputs_need_grad,
+        shared_group=None,
+        logger=None,
+        fixed_param_names=None,
+        grad_req="write",
+    ):
+        self.symbol = symbol
+        self.contexts = contexts
+        self.workload = workload or [1] * len(contexts)
+        self.data_shapes = list(data_shapes)
+        self.label_shapes = list(label_shapes) if label_shapes else None
+        self.param_names = list(param_names)
+        self.for_training = for_training
+        self.inputs_need_grad = inputs_need_grad
+        self.fixed_param_names = set(fixed_param_names or [])
+
+        self.arg_names = symbol.list_arguments()
+        self.aux_names = symbol.list_auxiliary_states()
+        self.data_names = [d.name if hasattr(d, "name") else d[0] for d in self.data_shapes]
+        self.label_names = (
+            [l.name if hasattr(l, "name") else l[0] for l in self.label_shapes]
+            if self.label_shapes
+            else []
+        )
+
+        batch_axis = 0
+        self.batch_size = (self.data_shapes[0].shape if hasattr(self.data_shapes[0], "shape") else self.data_shapes[0][1])[batch_axis]
+        self.slices = _split_input_slice(self.batch_size, self.workload)
+
+        # per-arg grad_req (params fixed → null; data per inputs_need_grad)
+        self.grad_req = {}
+        for name in self.arg_names:
+            if name in self.param_names:
+                self.grad_req[name] = (
+                    "null" if (not for_training or name in self.fixed_param_names) else grad_req
+                )
+            elif name in self.data_names:
+                self.grad_req[name] = grad_req if inputs_need_grad else "null"
+            else:  # labels
+                self.grad_req[name] = "null"
+
+        self.execs = []
+        self._bind_execs(shared_group)
+
+        # param_arrays[i] = list over devices of the NDArray for param i
+        self.param_arrays = [
+            [e.arg_dict[name] for e in self.execs] for name in self.param_names
+        ]
+        self.grad_arrays = [
+            [e.grad_dict[name] for e in self.execs] for name in self.param_names
+        ]
+        self.aux_arrays = [[e.aux_dict[name] for e in self.execs] for name in self.aux_names]
+        self.data_arrays = [[e.arg_dict[name] for e in self.execs] for name in self.data_names]
+        self.label_arrays = [[e.arg_dict[name] for e in self.execs] for name in self.label_names]
+        self.input_grad_arrays = (
+            [[e.grad_dict[name] for e in self.execs] for name in self.data_names]
+            if inputs_need_grad
+            else []
+        )
+
+    def _bind_execs(self, shared_group):
+        name2shape = {}
+        for d in self.data_shapes:
+            name2shape[d.name if hasattr(d, "name") else d[0]] = tuple(
+                d.shape if hasattr(d, "shape") else d[1]
+            )
+        for l in self.label_shapes or []:
+            name2shape[l.name if hasattr(l, "name") else l[0]] = tuple(
+                l.shape if hasattr(l, "shape") else l[1]
+            )
+        for i, (ctx, slc) in enumerate(zip(self.contexts, self.slices)):
+            dev_shapes = {}
+            for name, shape in name2shape.items():
+                n = slc.stop - slc.start
+                dev_shapes[name] = (n,) + shape[1:]
+            shared = None
+            if i > 0:
+                shared = _SharedProgramCarrier(self.execs[0]._prog, self.symbol)
+            if shared_group is None:
+                ex = simple_bind(
+                    self.symbol, ctx, grad_req=self.grad_req, shared_exec=shared, **dev_shapes
+                )
+            else:
+                # bucketing path: every bucket's executor binds the SAME
+                # parameter/grad/aux NDArrays as the shared (default-bucket)
+                # module, so an update through any bucket updates all — the
+                # reference's shared_exec memory sharing made literal
+                # (graph_executor.cc:348-351)
+                ex = self._bind_shared(shared_group, i, ctx, dev_shapes)
+            self.execs.append(ex)
+
+    def _bind_shared(self, shared_group, dev_i, ctx, dev_shapes):
+        from ..executor import bind as _bind
+
+        shared_ex = shared_group.execs[dev_i]
+        arg_shapes, _, aux_shapes = self.symbol.infer_shape(**dev_shapes)
+        if arg_shapes is None:
+            raise MXNetError("bind (shared): insufficient shape info")
+        args, grads, reqs = [], [], []
+        for name, shape in zip(self.arg_names, arg_shapes):
+            req = self.grad_req[name]
+            if name in shared_ex.arg_dict and tuple(shared_ex.arg_dict[name].shape) == tuple(shape):
+                args.append(shared_ex.arg_dict[name])
+                grads.append(shared_ex.grad_dict.get(name) if req != "null" else None)
+            else:
+                args.append(zeros(shape, ctx=ctx))
+                grads.append(zeros(shape, ctx=ctx) if req != "null" else None)
+            reqs.append(req if grads[-1] is not None else "null")
+        auxs = []
+        for name, shape in zip(self.aux_names, aux_shapes):
+            if name in shared_ex.aux_dict and tuple(shared_ex.aux_dict[name].shape) == tuple(shape):
+                auxs.append(shared_ex.aux_dict[name])
+            else:
+                auxs.append(zeros(shape, ctx=ctx))
+        return _bind(self.symbol, ctx, args, args_grad=grads, grad_req=reqs, aux_states=auxs)
+
+    # -------------------------------------------------------------- dataflow
+    def _load_slices(self, arrays_per_name, batch_arrays):
+        """Copy sliced batch rows into each device's bound array
+        (reference: executor_group.py _load_data/_load_general)."""
+        if batch_arrays is None:
+            return
+        for name_idx, dev_arrays in enumerate(arrays_per_name):
+            src = batch_arrays[name_idx]
+            src_np = None
+            for dev_i, dst in enumerate(dev_arrays):
+                slc = self.slices[dev_i]
+                if len(self.contexts) == 1:
+                    if isinstance(src, NDArray):
+                        dst[:] = src
+                    else:
+                        dst[:] = np.asarray(src)
+                else:
+                    if src_np is None:
+                        src_np = src.asnumpy() if isinstance(src, NDArray) else np.asarray(src)
+                    dst[:] = src_np[slc]
+
+    def load_data_label(self, data_batch):
+        self._load_slices(self.data_arrays, data_batch.data)
+        if self.label_arrays and data_batch.label is not None:
+            self._load_slices(self.label_arrays, data_batch.label)
+
+    def forward(self, data_batch, is_train=None):
+        """(reference: executor_group.py:355)"""
+        self.load_data_label(data_batch)
+        if is_train is None:
+            is_train = self.for_training
+        for ex in self.execs:
+            ex.forward(is_train=is_train)
+
+    def backward(self, out_grads=None):
+        """(reference: executor_group.py:481)"""
+        assert self.for_training, "re-bind with for_training=True to run backward"
+        for i, ex in enumerate(self.execs):
+            if out_grads is None:
+                ex.backward()
+            else:
+                dev_grads = []
+                for g in out_grads:
+                    if len(self.contexts) == 1:
+                        dev_grads.append(g)
+                    else:
+                        dev_grads.append(g[self.slices[i].start : self.slices[i].stop])
+                ex.backward(dev_grads)
+
+    def forward_backward(self, data_batch):
+        """Fused per-device fwd+bwd: one XLA computation per device per step."""
+        self.load_data_label(data_batch)
+        for ex in self.execs:
+            ex.forward_backward()
+
+    def get_outputs(self, merge_multi_context=True):
+        outputs = [[ex.outputs[i] for ex in self.execs] for i in range(len(self.execs[0].outputs))]
+        if merge_multi_context:
+            return [outs[0] if len(outs) == 1 else nd.concatenate(outs, axis=0) for outs in outputs]
+        return outputs
+
+    def get_input_grads(self, merge_multi_context=True):
+        assert self.inputs_need_grad
+        grads = [list(dev) for dev in self.input_grad_arrays]
+        if merge_multi_context:
+            return [g[0] if len(g) == 1 else nd.concatenate(g, axis=0) for g in grads]
+        return grads
+
+    def update_metric(self, eval_metric, labels):
+        """(reference: executor_group.py:511)"""
+        outputs = self.get_outputs(merge_multi_context=True)
+        eval_metric.update(labels, outputs)
+
+    # ---------------------------------------------------------------- params
+    def set_params(self, arg_params, aux_params):
+        for ex in self.execs:
+            ex.copy_params_from(arg_params, aux_params, allow_extra_params=True)
+
+    def get_params(self, arg_params, aux_params):
+        """Copy device-0 values out (devices hold identical params)."""
+        for i, name in enumerate(self.param_names):
+            arg_params[name] = self.param_arrays[i][0].copy()
+        for i, name in enumerate(self.aux_names):
+            aux_params[name] = self.aux_arrays[i][0].copy()
+
+    def install_monitor(self, mon):
+        for ex in self.execs:
+            mon.install(ex)
+
+
+class _SharedProgramCarrier:
+    """Minimal shared_exec stand-in carrying a _GraphProgram into bind()."""
+
+    def __init__(self, prog, symbol):
+        self._prog = prog
+        self._symbol = symbol

@@ -1,0 +1,396 @@
+"""Module: symbol-backed training module.
+
+Counterpart of the reference's python/mxnet/module/module.py:22. Binding
+creates a DataParallelExecutorGroup (one fused-XLA executor per context);
+``update()`` runs the optimizer through a KVStore (local/device/dist_tpu_sync)
+or a local updater loop, mirroring model.py:99-116 _update_params.
+"""
+from __future__ import annotations
+
+import logging
+
+import numpy as np
+
+from .. import ndarray as nd
+from .. import optimizer as opt
+from ..base import MXNetError
+from ..context import Context, cpu, current_context
+from ..initializer import InitDesc, Uniform
+from ..ndarray import NDArray, zeros
+from .base_module import BaseModule
+from .executor_group import DataParallelExecutorGroup
+
+
+class Module(BaseModule):
+    """(reference: module.py:22)"""
+
+    def __init__(
+        self,
+        symbol,
+        data_names=("data",),
+        label_names=("softmax_label",),
+        logger=logging,
+        context=None,
+        work_load_list=None,
+        fixed_param_names=None,
+    ):
+        super().__init__(logger=logger)
+        if context is None:
+            context = current_context()
+        if isinstance(context, Context):
+            context = [context]
+        self._context = context
+        self._work_load_list = work_load_list
+
+        self._symbol = symbol
+        arg_names = symbol.list_arguments()
+        self._data_names = list(data_names) if data_names else []
+        self._label_names = list(label_names) if label_names else []
+        for name in self._data_names:
+            if name not in arg_names:
+                raise MXNetError("data name %r not an argument of the symbol" % name)
+        self._label_names = [n for n in self._label_names if n in arg_names]
+        self._param_names = [
+            n for n in arg_names if n not in self._data_names and n not in self._label_names
+        ]
+        self._aux_names = symbol.list_auxiliary_states()
+        self._fixed_param_names = list(fixed_param_names or [])
+        self._output_names = symbol.list_outputs()
+
+        self._arg_params = None
+        self._aux_params = None
+        self._params_dirty = False
+
+        self._optimizer = None
+        self._kvstore = None
+        self._update_on_kvstore = None
+        self._updater = None
+        self._exec_group = None
+        self._preload_opt_states = None
+
+    # ------------------------------------------------------------ properties
+    @property
+    def data_names(self):
+        return self._data_names
+
+    @property
+    def label_names(self):
+        return self._label_names
+
+    @property
+    def output_names(self):
+        return self._output_names
+
+    @property
+    def data_shapes(self):
+        assert self.binded
+        return self._data_shapes
+
+    @property
+    def label_shapes(self):
+        assert self.binded
+        return self._label_shapes
+
+    @property
+    def output_shapes(self):
+        assert self.binded
+        outs = self._exec_group.get_outputs()
+        return list(zip(self._output_names, [o.shape for o in outs])) if outs else []
+
+    # ---------------------------------------------------------------- params
+    def get_params(self):
+        assert self.binded and self.params_initialized
+        if self._params_dirty:
+            self._sync_params_from_devices()
+        return (self._arg_params, self._aux_params)
+
+    def _sync_params_from_devices(self):
+        self._exec_group.get_params(self._arg_params, self._aux_params)
+        self._params_dirty = False
+
+    def init_params(self, initializer=None, arg_params=None, aux_params=None, allow_missing=False, force_init=False):
+        """(reference: module.py init_params)"""
+        if self.params_initialized and not force_init:
+            return
+        assert self.binded, "call bind before initializing the parameters"
+        if initializer is None:
+            initializer = Uniform(0.01)
+
+        if self._arg_params is None:
+            self._arg_params = {
+                name: zeros(arr[0].shape, dtype=arr[0].dtype)
+                for name, arr in zip(self._param_names, self._exec_group.param_arrays)
+            }
+        if self._aux_params is None:
+            self._aux_params = {
+                name: zeros(arr[0].shape, dtype=arr[0].dtype)
+                for name, arr in zip(self._aux_names, self._exec_group.aux_arrays)
+            }
+
+        attrs = self._symbol.attr_dict()
+
+        def _impl(name, arr, cache):
+            if cache is not None and name in cache:
+                cache_arr = cache[name]
+                if cache_arr is not arr:
+                    arr[:] = cache_arr
+            else:
+                if not allow_missing and cache is not None:
+                    raise RuntimeError("%s is not presented" % name)
+                if initializer is not None:
+                    initializer(InitDesc(name, attrs.get(name, None)), arr)
+
+        for name, arr in sorted(self._arg_params.items()):
+            _impl(name, arr, arg_params)
+        for name, arr in sorted(self._aux_params.items()):
+            _impl(name, arr, aux_params)
+
+        self.params_initialized = True
+        self._params_dirty = False
+        self._exec_group.set_params(self._arg_params, self._aux_params)
+
+    def set_params(self, arg_params, aux_params, allow_missing=False, force_init=True):
+        if not allow_missing:
+            self.init_params(
+                initializer=None,
+                arg_params=arg_params,
+                aux_params=aux_params,
+                allow_missing=allow_missing,
+                force_init=force_init,
+            )
+            return
+        if self.params_initialized and not force_init:
+            return
+        self._exec_group.set_params(arg_params, aux_params)
+        self._params_dirty = True
+        self.params_initialized = True
+
+    # --------------------------------------------------------------- binding
+    def bind(self, data_shapes, label_shapes=None, for_training=True, inputs_need_grad=False, force_rebind=False, shared_module=None, grad_req="write"):
+        """(reference: module.py bind)"""
+        if force_rebind:
+            self._reset_bind()
+        if self.binded:
+            self.logger.warning("Already binded, ignoring bind()")
+            return
+
+        self.for_training = for_training
+        self.inputs_need_grad = inputs_need_grad
+        self.binded = True
+
+        if not for_training:
+            assert not inputs_need_grad
+
+        self._data_shapes = self._normalize_shapes(data_shapes)
+        self._label_shapes = self._normalize_shapes(label_shapes) if label_shapes else None
+
+        shared_group = None
+        if shared_module is not None:
+            assert isinstance(shared_module, Module) and shared_module.binded and shared_module.params_initialized
+            shared_group = shared_module._exec_group
+
+        self._exec_group = DataParallelExecutorGroup(
+            self._symbol,
+            self._context,
+            self._work_load_list,
+            self._data_shapes,
+            self._label_shapes,
+            self._param_names,
+            for_training,
+            inputs_need_grad,
+            shared_group=shared_group,
+            logger=self.logger,
+            fixed_param_names=self._fixed_param_names,
+            grad_req=grad_req,
+        )
+        if shared_module is not None:
+            self.params_initialized = True
+            self._arg_params = shared_module._arg_params
+            self._aux_params = shared_module._aux_params
+        elif self.params_initialized:
+            # force rebind after params exist: push them to the new executors
+            self._exec_group.set_params(self._arg_params, self._aux_params)
+
+    @staticmethod
+    def _normalize_shapes(shapes):
+        from ..io import DataDesc
+
+        out = []
+        for s in shapes:
+            if isinstance(s, DataDesc):
+                out.append(s)
+            elif isinstance(s, tuple) and len(s) == 2:
+                out.append(DataDesc(s[0], s[1]))
+            else:
+                out.append(DataDesc(s.name, s.shape, getattr(s, "dtype", np.float32)))
+        return out
+
+    def _reset_bind(self):
+        self.binded = False
+        self._exec_group = None
+
+    # -------------------------------------------------------------- optimizer
+    def init_optimizer(self, kvstore="local", optimizer="sgd", optimizer_params=(("learning_rate", 0.01),), force_init=False):
+        """(reference: module.py:432 + model.py:40-77 _create_kvstore)"""
+        assert self.binded and self.params_initialized
+        if self.optimizer_initialized and not force_init:
+            self.logger.warning("optimizer already initialized, ignoring...")
+            return
+
+        from ..kvstore_helper import create_kvstore
+
+        kvstore_obj, update_on_kvstore = create_kvstore(
+            kvstore, len(self._context), self._arg_params
+        )
+
+        batch_size = self._exec_group.batch_size
+        if kvstore_obj and "dist" in kvstore_obj.type and "_sync" in kvstore_obj.type:
+            batch_size *= kvstore_obj.num_workers
+        rescale_grad = 1.0 / batch_size
+
+        if isinstance(optimizer, str):
+            idx2name = {}
+            if update_on_kvstore:
+                idx2name.update(enumerate(self._param_names))
+            else:
+                for k in range(len(self._context)):
+                    idx2name.update(
+                        {i * len(self._context) + k: n for i, n in enumerate(self._param_names)}
+                    )
+            optimizer_params = dict(optimizer_params)
+            if "rescale_grad" not in optimizer_params:
+                optimizer_params["rescale_grad"] = rescale_grad
+            optimizer = opt.create(optimizer, sym=self.symbol, param_idx2name=idx2name, **optimizer_params)
+        else:
+            assert isinstance(optimizer, opt.Optimizer)
+
+        self._optimizer = optimizer
+        self._kvstore = kvstore_obj
+        self._update_on_kvstore = update_on_kvstore
+        self._updater = None
+
+        if kvstore_obj:
+            # copy initialized params into the store; updates flow through it
+            from ..kvstore_helper import initialize_kvstore
+
+            initialize_kvstore(
+                kvstore=kvstore_obj,
+                param_arrays=self._exec_group.param_arrays,
+                arg_params=self._arg_params,
+                param_names=self._param_names,
+                update_on_kvstore=update_on_kvstore,
+            )
+        if update_on_kvstore:
+            kvstore_obj.set_optimizer(self._optimizer)
+        else:
+            self._updater = opt.get_updater(optimizer)
+
+        self.optimizer_initialized = True
+
+        if self._preload_opt_states is not None:
+            self.load_optimizer_states(self._preload_opt_states)
+            self._preload_opt_states = None
+
+    def borrow_optimizer(self, shared_module):
+        """Share optimizer/updater with another module (reference:
+        module.py borrow_optimizer, used by BucketingModule)."""
+        assert shared_module.optimizer_initialized
+        self._optimizer = shared_module._optimizer
+        self._kvstore = shared_module._kvstore
+        self._update_on_kvstore = shared_module._update_on_kvstore
+        self._updater = shared_module._updater
+        self.optimizer_initialized = True
+
+    # ------------------------------------------------------------- train step
+    def forward(self, data_batch, is_train=None):
+        assert self.binded and self.params_initialized
+        self._exec_group.forward(data_batch, is_train)
+
+    def backward(self, out_grads=None):
+        assert self.binded and self.params_initialized
+        self._exec_group.backward(out_grads=out_grads)
+
+    def forward_backward(self, data_batch):
+        """Fused per-device step — ONE XLA computation per device."""
+        assert self.binded and self.params_initialized
+        self._exec_group.forward_backward(data_batch)
+
+    def update(self):
+        """(reference: module.py update → model.py _update_params[_on_kvstore])"""
+        assert self.binded and self.params_initialized and self.optimizer_initialized
+        self._params_dirty = True
+        if self._update_on_kvstore:
+            from ..kvstore_helper import update_params_on_kvstore
+
+            update_params_on_kvstore(
+                self._exec_group.param_arrays, self._exec_group.grad_arrays, self._kvstore
+            )
+        else:
+            from ..kvstore_helper import update_params
+
+            update_params(
+                self._exec_group.param_arrays,
+                self._exec_group.grad_arrays,
+                updater=self._updater,
+                num_device=len(self._context),
+                kvstore=self._kvstore,
+            )
+
+    def get_outputs(self, merge_multi_context=True):
+        assert self.binded and self.params_initialized
+        return self._exec_group.get_outputs(merge_multi_context=merge_multi_context)
+
+    def get_input_grads(self, merge_multi_context=True):
+        assert self.binded and self.params_initialized and self.inputs_need_grad
+        return self._exec_group.get_input_grads(merge_multi_context=merge_multi_context)
+
+    def update_metric(self, eval_metric, labels):
+        self._exec_group.update_metric(eval_metric, labels)
+
+    def install_monitor(self, mon):
+        assert self.binded
+        self._exec_group.install_monitor(mon)
+
+    # ----------------------------------------------------------- persistence
+    def save_optimizer_states(self, fname):
+        assert self.optimizer_initialized
+        if self._update_on_kvstore:
+            with open(fname, "wb") as f:
+                f.write(self._kvstore._updater.get_states())
+        else:
+            with open(fname, "wb") as f:
+                f.write(self._updater.get_states())
+
+    def load_optimizer_states(self, fname):
+        assert self.optimizer_initialized
+        with open(fname, "rb") as f:
+            states = f.read()
+        if self._update_on_kvstore:
+            self._kvstore._updater.set_states(states)
+        else:
+            self._updater.set_states(states)
+
+    def save_checkpoint(self, prefix, epoch, save_optimizer_states=False):
+        """(reference: module.py save_checkpoint)"""
+        self._symbol.save("%s-symbol.json" % prefix)
+        param_name = "%s-%04d.params" % (prefix, epoch)
+        self.save_params(param_name)
+        self.logger.info('Saved checkpoint to "%s"', param_name)
+        if save_optimizer_states:
+            state_name = "%s-%04d.states" % (prefix, epoch)
+            self.save_optimizer_states(state_name)
+            self.logger.info('Saved optimizer state to "%s"', state_name)
+
+    @staticmethod
+    def load(prefix, epoch, load_optimizer_states=False, **kwargs):
+        """(reference: module.py:96)"""
+        from ..model import load_checkpoint
+
+        sym, args, auxs = load_checkpoint(prefix, epoch)
+        mod = Module(symbol=sym, **kwargs)
+        mod._arg_params = args
+        mod._aux_params = auxs
+        mod.params_initialized = True
+        if load_optimizer_states:
+            mod._preload_opt_states = "%s-%04d.states" % (prefix, epoch)
+        return mod

@@ -1,0 +1,422 @@
+"""Optimizers.
+
+Counterpart of the reference's python/mxnet/optimizer.py (Optimizer registry
+:10-, SGD :279, Adam :451, get_updater). Each ``update(index, weight, grad,
+state)`` lowers to ONE fused update op from ``ops/optimizer_ops.py`` where the
+reference has a device kernel (sgd/sgd_mom/adam/rmsprop), so XLA fuses
+rescale+clip+wd+update into a single HBM pass per weight — the reference's
+device-side kvstore-updater path, TPU-native.
+"""
+from __future__ import annotations
+
+import math
+from typing import Optional
+
+import numpy as np
+
+from .base import MXNetError
+from . import ndarray as nd
+from .ndarray import NDArray, imperative_invoke, zeros
+
+__all__ = [
+    "Optimizer",
+    "SGD",
+    "NAG",
+    "SGLD",
+    "DCASGD",
+    "Adam",
+    "AdaGrad",
+    "RMSProp",
+    "AdaDelta",
+    "Test",
+    "create",
+    "register",
+    "get_updater",
+    "Updater",
+]
+
+
+class Optimizer:
+    """Base optimizer with the reference's registry / lr&wd-mult machinery."""
+
+    opt_registry = {}
+
+    @staticmethod
+    def register(klass):
+        name = klass.__name__.lower()
+        Optimizer.opt_registry[name] = klass
+        return klass
+
+    @staticmethod
+    def create_optimizer(name, **kwargs):
+        if name.lower() not in Optimizer.opt_registry:
+            raise ValueError("Cannot find optimizer %s" % name)
+        return Optimizer.opt_registry[name.lower()](**kwargs)
+
+    def __init__(
+        self,
+        rescale_grad=1.0,
+        param_idx2name=None,
+        wd=0.0,
+        clip_gradient=None,
+        learning_rate=0.01,
+        lr_scheduler=None,
+        sym=None,
+        begin_num_update=0,
+    ):
+        self.rescale_grad = rescale_grad
+        self.lr = learning_rate
+        self.lr_scheduler = lr_scheduler
+        if lr_scheduler is not None:
+            self.lr_scheduler.base_lr = learning_rate
+        self.wd = wd
+        self.lr_mult = {}
+        self.wd_mult = {}
+        self.begin_num_update = begin_num_update
+        self.num_update = begin_num_update
+        self._index_update_count = {}
+        self.clip_gradient = clip_gradient
+        if param_idx2name is None:
+            param_idx2name = {}
+        if not isinstance(param_idx2name, dict):
+            raise TypeError("param_idx2name should be a dict of param indexes to names.")
+        self.idx2name = param_idx2name.copy()
+        self.sym = sym
+        self.set_lr_mult({})
+        self.set_wd_mult({})
+
+    # ------------------------------------------------------------- state mgmt
+    def create_state(self, index, weight):
+        return None
+
+    def update(self, index, weight, grad, state):
+        raise NotImplementedError()
+
+    # ----------------------------------------------------------------- mults
+    def set_lr_mult(self, args_lr_mult):
+        """Per-param lr multipliers; symbol ``__lr_mult__`` attrs feed in too
+        (reference: optimizer.py set_lr_mult)."""
+        self.lr_mult = {}
+        if self.sym is not None:
+            attr = self.sym.attr_dict()
+            for name in self.sym.list_arguments():
+                if name in attr and "__lr_mult__" in attr[name]:
+                    self.lr_mult[name] = float(attr[name]["__lr_mult__"])
+        self.lr_mult.update(args_lr_mult)
+
+    def set_wd_mult(self, args_wd_mult):
+        """Per-param wd multipliers; bias/gamma/beta default to wd 0 like the
+        reference (no weight decay on 1-d params)."""
+        self.wd_mult = {}
+        for n in self.idx2name.values():
+            if not (n.endswith("_weight") or n.endswith("_gamma")):
+                self.wd_mult[n] = 0.0
+        if self.sym is not None:
+            attr = self.sym.attr_dict()
+            for name in self.sym.list_arguments():
+                if name in attr and "__wd_mult__" in attr[name]:
+                    self.wd_mult[name] = float(attr[name]["__wd_mult__"])
+        self.wd_mult.update(args_wd_mult)
+
+    # ------------------------------------------------------------- schedules
+    def _update_count(self, index):
+        if index not in self._index_update_count:
+            self._index_update_count[index] = self.begin_num_update
+        self._index_update_count[index] += 1
+        self.num_update = max(self._index_update_count[index], self.num_update)
+
+    def _get_lr(self, index):
+        if self.lr_scheduler is not None:
+            lr = self.lr_scheduler(self.num_update)
+        else:
+            lr = self.lr
+        if index in self.lr_mult:
+            lr *= self.lr_mult[index]
+        elif index in self.idx2name:
+            lr *= self.lr_mult.get(self.idx2name[index], 1.0)
+        return lr
+
+    def _get_wd(self, index):
+        wd = self.wd
+        if index in self.wd_mult:
+            wd *= self.wd_mult[index]
+        elif index in self.idx2name:
+            wd *= self.wd_mult.get(self.idx2name[index], 1.0)
+        return wd
+
+    def _common_attrs(self, lr, wd):
+        attrs = {"lr": lr, "wd": wd, "rescale_grad": self.rescale_grad}
+        if self.clip_gradient is not None:
+            attrs["clip_gradient"] = self.clip_gradient
+        return attrs
+
+
+register = Optimizer.register
+create = Optimizer.create_optimizer
+
+
+@register
+class SGD(Optimizer):
+    """SGD with momentum (reference: optimizer.py:279), lowered to the fused
+    sgd_update / sgd_mom_update ops."""
+
+    def __init__(self, momentum=0.0, **kwargs):
+        super().__init__(**kwargs)
+        self.momentum = momentum
+
+    def create_state(self, index, weight):
+        if self.momentum == 0.0:
+            return None
+        return zeros(weight.shape, ctx=weight.context, dtype=weight.dtype)
+
+    def update(self, index, weight, grad, state):
+        self._update_count(index)
+        lr, wd = self._get_lr(index), self._get_wd(index)
+        attrs = self._common_attrs(lr, wd)
+        if state is not None:
+            attrs["momentum"] = self.momentum
+            imperative_invoke("sgd_mom_update", [weight, grad, state], attrs, out=[weight, state])
+        else:
+            imperative_invoke("sgd_update", [weight, grad], attrs, out=[weight])
+
+
+@register
+class NAG(SGD):
+    """Nesterov accelerated SGD (reference: optimizer.py:380)."""
+
+    def update(self, index, weight, grad, state):
+        self._update_count(index)
+        lr, wd = self._get_lr(index), self._get_wd(index)
+        grad = grad * self.rescale_grad
+        if self.clip_gradient is not None:
+            grad = nd.clip(grad, a_min=-self.clip_gradient, a_max=self.clip_gradient)
+        if state is not None:
+            mom = state
+            mom[:] = mom * self.momentum + grad + wd * weight
+            grad[:] = grad + self.momentum * mom
+            weight[:] = weight - lr * grad
+        else:
+            weight[:] = weight - lr * (grad + wd * weight)
+
+
+@register
+class SGLD(Optimizer):
+    """Stochastic Gradient Langevin Dynamics (reference: optimizer.py:416)."""
+
+    def update(self, index, weight, grad, state):
+        self._update_count(index)
+        lr, wd = self._get_lr(index), self._get_wd(index)
+        grad = grad * self.rescale_grad
+        if self.clip_gradient is not None:
+            grad = nd.clip(grad, a_min=-self.clip_gradient, a_max=self.clip_gradient)
+        noise = nd.random_normal(
+            loc=0.0, scale=math.sqrt(lr), shape=weight.shape, ctx=weight.context
+        )
+        weight[:] = weight - lr / 2 * (grad + wd * weight) + noise
+
+
+@register
+class DCASGD(Optimizer):
+    """Delay-compensated async SGD (reference: optimizer.py:325)."""
+
+    def __init__(self, momentum=0.0, lamda=0.04, **kwargs):
+        super().__init__(**kwargs)
+        self.momentum = momentum
+        self.weight_previous = {}
+        self.lamda = lamda
+
+    def create_state(self, index, weight):
+        if self.momentum == 0.0:
+            return (None, weight.copy())
+        return (
+            zeros(weight.shape, ctx=weight.context, dtype=weight.dtype),
+            weight.copy(),
+        )
+
+    def update(self, index, weight, grad, state):
+        self._update_count(index)
+        lr, wd = self._get_lr(index), self._get_wd(index)
+        grad = grad * self.rescale_grad
+        if self.clip_gradient is not None:
+            grad = nd.clip(grad, a_min=-self.clip_gradient, a_max=self.clip_gradient)
+        mom, previous_weight = state
+        if mom is not None:
+            mom[:] = mom * self.momentum
+            mom[:] = mom - lr * (
+                grad + wd * weight + self.lamda * grad * grad * (weight - previous_weight)
+            )
+        else:
+            mom = -lr * (
+                grad + wd * weight + self.lamda * grad * grad * (weight - previous_weight)
+            )
+        previous_weight[:] = weight
+        weight[:] = weight + mom
+
+
+@register
+class Adam(Optimizer):
+    """Adam (reference: optimizer.py:451) with the reference's bias-corrected
+    effective lr, lowered to the fused adam_update op."""
+
+    def __init__(self, learning_rate=0.001, beta1=0.9, beta2=0.999, epsilon=1e-8, **kwargs):
+        super().__init__(learning_rate=learning_rate, **kwargs)
+        self.beta1 = beta1
+        self.beta2 = beta2
+        self.epsilon = epsilon
+
+    def create_state(self, index, weight):
+        return (
+            zeros(weight.shape, ctx=weight.context, dtype=weight.dtype),  # mean
+            zeros(weight.shape, ctx=weight.context, dtype=weight.dtype),  # var
+        )
+
+    def update(self, index, weight, grad, state):
+        self._update_count(index)
+        lr, wd = self._get_lr(index), self._get_wd(index)
+        t = self._index_update_count[index]
+        coef1 = 1.0 - self.beta1 ** t
+        coef2 = 1.0 - self.beta2 ** t
+        lr *= math.sqrt(coef2) / coef1
+        mean, var = state
+        attrs = self._common_attrs(lr, wd)
+        attrs.update(beta1=self.beta1, beta2=self.beta2, epsilon=self.epsilon)
+        imperative_invoke("adam_update", [weight, grad, mean, var], attrs, out=[weight, mean, var])
+
+
+@register
+class AdaGrad(Optimizer):
+    """AdaGrad (reference: optimizer.py:499)."""
+
+    def __init__(self, eps=1e-7, **kwargs):
+        super().__init__(**kwargs)
+        self.float_stable_eps = eps
+
+    def create_state(self, index, weight):
+        return zeros(weight.shape, ctx=weight.context, dtype=weight.dtype)
+
+    def update(self, index, weight, grad, state):
+        self._update_count(index)
+        lr, wd = self._get_lr(index), self._get_wd(index)
+        grad = grad * self.rescale_grad
+        if self.clip_gradient is not None:
+            grad = nd.clip(grad, a_min=-self.clip_gradient, a_max=self.clip_gradient)
+        history = state
+        history[:] = history + grad * grad
+        weight[:] = weight - lr * (grad / nd.sqrt(history + self.float_stable_eps) + wd * weight)
+
+
+@register
+class RMSProp(Optimizer):
+    """RMSProp; centered=True uses Alex Graves' variant like the reference
+    (optimizer.py:536), via the fused rmsprop/rmspropalex ops."""
+
+    def __init__(
+        self,
+        learning_rate=0.001,
+        gamma1=0.9,
+        gamma2=0.9,
+        epsilon=1e-8,
+        centered=False,
+        clip_weights=None,
+        **kwargs,
+    ):
+        super().__init__(learning_rate=learning_rate, **kwargs)
+        self.gamma1 = gamma1
+        self.gamma2 = gamma2
+        self.centered = centered
+        self.epsilon = epsilon
+        self.clip_weights = clip_weights
+
+    def create_state(self, index, weight):
+        if self.centered:
+            return (
+                zeros(weight.shape, ctx=weight.context, dtype=weight.dtype),  # n
+                zeros(weight.shape, ctx=weight.context, dtype=weight.dtype),  # g
+                zeros(weight.shape, ctx=weight.context, dtype=weight.dtype),  # delta
+            )
+        return (zeros(weight.shape, ctx=weight.context, dtype=weight.dtype),)  # n
+
+    def update(self, index, weight, grad, state):
+        self._update_count(index)
+        lr, wd = self._get_lr(index), self._get_wd(index)
+        attrs = self._common_attrs(lr, wd)
+        attrs.update(gamma1=self.gamma1, epsilon=self.epsilon)
+        if self.clip_weights is not None:
+            attrs["clip_weights"] = self.clip_weights
+        if not self.centered:
+            (n,) = state
+            imperative_invoke("rmsprop_update", [weight, grad, n], attrs, out=[weight, n])
+        else:
+            n, g, delta = state
+            attrs["gamma2"] = self.gamma2
+            imperative_invoke(
+                "rmspropalex_update", [weight, grad, n, g, delta], attrs, out=[weight, n, g, delta]
+            )
+
+
+@register
+class AdaDelta(Optimizer):
+    """AdaDelta (reference: optimizer.py:605)."""
+
+    def __init__(self, rho=0.90, epsilon=1e-5, **kwargs):
+        super().__init__(**kwargs)
+        self.rho = rho
+        self.epsilon = epsilon
+
+    def create_state(self, index, weight):
+        return (
+            zeros(weight.shape, ctx=weight.context, dtype=weight.dtype),  # accumulated g
+            zeros(weight.shape, ctx=weight.context, dtype=weight.dtype),  # accumulated delta
+        )
+
+    def update(self, index, weight, grad, state):
+        self._update_count(index)
+        wd = self._get_wd(index)
+        grad = grad * self.rescale_grad
+        if self.clip_gradient is not None:
+            grad = nd.clip(grad, a_min=-self.clip_gradient, a_max=self.clip_gradient)
+        acc_g, acc_delta = state
+        acc_g[:] = self.rho * acc_g + (1.0 - self.rho) * grad * grad
+        current_delta = nd.sqrt(acc_delta + self.epsilon) / nd.sqrt(acc_g + self.epsilon) * grad
+        acc_delta[:] = self.rho * acc_delta + (1.0 - self.rho) * current_delta * current_delta
+        weight[:] = weight - current_delta - wd * weight
+
+
+@register
+class Test(Optimizer):
+    """Trivial optimizer for tests (reference: optimizer.py:653)."""
+
+    def create_state(self, index, weight):
+        return zeros(weight.shape, ctx=weight.context, dtype=weight.dtype)
+
+    def update(self, index, weight, grad, state):
+        weight[:] = weight + grad * self.rescale_grad
+        state[:] = weight
+
+
+class Updater:
+    """Closure applying an optimizer per key with lazily-created state
+    (reference: get_updater / kvstore _updater)."""
+
+    def __init__(self, optimizer: Optimizer):
+        self.optimizer = optimizer
+        self.states = {}
+
+    def __call__(self, index, grad, weight):
+        if index not in self.states:
+            self.states[index] = self.optimizer.create_state(index, weight)
+        self.optimizer.update(index, weight, grad, self.states[index])
+
+    def set_states(self, states):
+        import pickle
+
+        self.states = pickle.loads(states) if isinstance(states, bytes) else states
+
+    def get_states(self):
+        import pickle
+
+        return pickle.dumps(self.states)
+
+
+def get_updater(optimizer: Optimizer) -> Updater:
+    return Updater(optimizer)

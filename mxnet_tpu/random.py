@@ -24,6 +24,14 @@ def _next_key():
     return sub
 
 
+def _next_seed() -> int:
+    """A fresh host-side integer seed derived from the global key (for numpy-
+    based initializers like Orthogonal that need CPU linear algebra)."""
+    import jax
+
+    return int(jax.random.randint(_next_key(), (), 0, 2**31 - 1))
+
+
 def seed(seed_state: int):
     """Seed the global generator (reference: mx.random.seed → MXRandomSeed)."""
     global _KEY

@@ -369,10 +369,14 @@ class Symbol:
                     # shapes unknown: still propagate dtypes by promotion so
                     # infer_type works standalone (Cast/creation ops override)
                     dt = _fallback_dtype(node, parsed, in_dtypes)
+                    # inputs take the promotion of the KNOWN inputs — never the
+                    # output dtype, which dtype-forcing ops (Cast) decouple
+                    known_in = [d for d in in_dtypes if d is not None]
+                    in_promo = np.dtype(np.result_type(*known_in)) if known_in else None
                     for (inp, _), d in zip(node.inputs, in_dtypes):
-                        if inp.is_variable and var_dtype.get(inp.name) is None and dt is not None:
-                            var_dtype[inp.name] = dt
-                            entries_dtype[(id(inp), 0)] = dt
+                        if inp.is_variable and var_dtype.get(inp.name) is None and in_promo is not None:
+                            var_dtype[inp.name] = in_promo
+                            entries_dtype[(id(inp), 0)] = in_promo
                     for i in range(node.num_outputs()):
                         entries_shape[(id(node), i)] = None
                         entries_dtype[(id(node), i)] = dt
@@ -623,6 +627,11 @@ def _make_symbol_function(op_name):
         slots = opdef.input_names(parsed) + opdef.aux_names(parsed)
         hint = opdef.name.lower().lstrip("_") or opdef.name.lower()
         name = NameManager.current().get(name, hint)
+        if len(sym_args) > len(slots):
+            raise MXNetError(
+                "%s: too many positional inputs (%d given, expects %s)"
+                % (op_name, len(sym_args), slots)
+            )
         filled: Dict[str, Symbol] = {}
         for slot, s in zip(slots, sym_args):
             filled[slot] = s

@@ -1,0 +1,248 @@
+"""Module / training-stack tests.
+
+Modeled on the reference's tests/python/unittest/test_module.py and
+tests/python/train/test_mlp.py / test_conv.py — end-to-end convergence on a
+learnable task is the oracle (SURVEY.md §4: "convergence thresholds").
+"""
+import numpy as np
+import pytest
+
+import mxnet_tpu as mx
+
+
+def _synthetic_classification(n=600, n_features=20, n_classes=5, seed=7):
+    """Linearly separable-ish clusters an MLP must fit to ~100%."""
+    rs = np.random.RandomState(seed)
+    centers = rs.uniform(-3, 3, (n_classes, n_features)).astype("f")
+    y = rs.randint(0, n_classes, n)
+    x = centers[y] + rs.normal(0, 0.3, (n, n_features)).astype("f")
+    return x.astype("f"), y.astype("f")
+
+
+def mlp_symbol(num_classes=5):
+    data = mx.sym.Variable("data")
+    net = mx.sym.FullyConnected(data=data, num_hidden=64, name="fc1")
+    net = mx.sym.Activation(data=net, act_type="relu")
+    net = mx.sym.FullyConnected(data=net, num_hidden=num_classes, name="fc2")
+    return mx.sym.SoftmaxOutput(data=net, name="softmax")
+
+
+def test_module_fit_mlp_converges():
+    x, y = _synthetic_classification()
+    train = mx.io.NDArrayIter(x[:500], y[:500], batch_size=50, shuffle=True)
+    val = mx.io.NDArrayIter(x[500:], y[500:], batch_size=50)
+    mod = mx.mod.Module(mlp_symbol(), context=mx.cpu())
+    mod.fit(
+        train,
+        eval_data=val,
+        optimizer="sgd",
+        optimizer_params=(("learning_rate", 0.1), ("momentum", 0.9)),
+        num_epoch=6,
+    )
+    score = mod.score(val, "acc")
+    assert score[0][1] > 0.95, "accuracy %f too low" % score[0][1]
+
+
+def test_module_fit_conv_converges():
+    """Small conv net on image-shaped synthetic data (train/test_conv.py gate)."""
+    rs = np.random.RandomState(0)
+    n, classes = 400, 4
+    y = rs.randint(0, classes, n)
+    x = np.zeros((n, 1, 8, 8), dtype="f")
+    # each class lights up a distinct quadrant
+    for i, yi in enumerate(y):
+        r, c = divmod(int(yi), 2)
+        x[i, 0, r * 4 : r * 4 + 4, c * 4 : c * 4 + 4] = 1.0
+    x += rs.normal(0, 0.2, x.shape).astype("f")
+
+    data = mx.sym.Variable("data")
+    net = mx.sym.Convolution(data=data, kernel=(3, 3), num_filter=8, name="c1")
+    net = mx.sym.Activation(data=net, act_type="relu")
+    net = mx.sym.Pooling(data=net, kernel=(2, 2), stride=(2, 2), pool_type="max")
+    net = mx.sym.Flatten(data=net)
+    net = mx.sym.FullyConnected(data=net, num_hidden=classes, name="fc")
+    net = mx.sym.SoftmaxOutput(data=net, name="softmax")
+
+    train = mx.io.NDArrayIter(x, y.astype("f"), batch_size=40, shuffle=True)
+    mod = mx.mod.Module(net, context=mx.cpu())
+    mod.fit(train, optimizer="sgd", optimizer_params=(("learning_rate", 0.2),), num_epoch=5)
+    score = mod.score(mx.io.NDArrayIter(x, y.astype("f"), batch_size=40), "acc")
+    assert score[0][1] > 0.95
+
+
+def test_module_adam_converges():
+    x, y = _synthetic_classification(n=300)
+    train = mx.io.NDArrayIter(x, y, batch_size=30, shuffle=True)
+    mod = mx.mod.Module(mlp_symbol(), context=mx.cpu())
+    mod.fit(train, optimizer="adam", optimizer_params=(("learning_rate", 0.01),), num_epoch=5)
+    score = mod.score(mx.io.NDArrayIter(x, y, batch_size=30), "acc")
+    assert score[0][1] > 0.95
+
+
+def test_module_get_set_params_roundtrip():
+    mod = mx.mod.Module(mlp_symbol(), context=mx.cpu())
+    mod.bind(data_shapes=[("data", (10, 20))], label_shapes=[("softmax_label", (10,))])
+    mod.init_params(initializer=mx.init.Xavier())
+    args, auxs = mod.get_params()
+    assert set(args.keys()) == {"fc1_weight", "fc1_bias", "fc2_weight", "fc2_bias"}
+    mod2 = mx.mod.Module(mlp_symbol(), context=mx.cpu())
+    mod2.bind(data_shapes=[("data", (10, 20))], label_shapes=[("softmax_label", (10,))])
+    mod2.init_params(arg_params=args, aux_params=auxs)
+    a2, _ = mod2.get_params()
+    for k in args:
+        assert np.allclose(args[k].asnumpy(), a2[k].asnumpy())
+
+
+def test_module_predict():
+    x, y = _synthetic_classification(n=100)
+    mod = mx.mod.Module(mlp_symbol(), context=mx.cpu())
+    it = mx.io.NDArrayIter(x, y, batch_size=25)
+    mod.bind(data_shapes=it.provide_data, label_shapes=it.provide_label, for_training=False)
+    mod.init_params()
+    out = mod.predict(it)
+    assert out.shape == (100, 5)
+    assert np.allclose(out.asnumpy().sum(axis=1), 1.0, atol=1e-5)
+
+
+def test_module_save_load_checkpoint(tmp_path):
+    x, y = _synthetic_classification(n=100)
+    prefix = str(tmp_path / "mlp")
+    mod = mx.mod.Module(mlp_symbol(), context=mx.cpu())
+    it = mx.io.NDArrayIter(x, y, batch_size=20)
+    mod.bind(data_shapes=it.provide_data, label_shapes=it.provide_label)
+    mod.init_params()
+    mod.save_checkpoint(prefix, 3)
+    mod2 = mx.mod.Module.load(prefix, 3, context=mx.cpu())
+    mod2.bind(data_shapes=it.provide_data, label_shapes=it.provide_label)
+    a1, _ = mod.get_params()
+    a2, _ = mod2.get_params()
+    for k in a1:
+        assert np.allclose(a1[k].asnumpy(), a2[k].asnumpy())
+
+
+def test_module_multi_device_data_parallel():
+    """Multi-context DP on the virtual 8-device CPU mesh (reference trick:
+    test_multi_device_exec.py uses cpu(0)/cpu(1))."""
+    x, y = _synthetic_classification(n=400)
+    ctxs = [mx.cpu(i) for i in range(4)]
+    train = mx.io.NDArrayIter(x, y, batch_size=40, shuffle=True)
+    mod = mx.mod.Module(mlp_symbol(), context=ctxs)
+    mod.fit(train, optimizer="sgd", optimizer_params=(("learning_rate", 0.1),), num_epoch=4)
+    score = mod.score(mx.io.NDArrayIter(x, y, batch_size=40), "acc")
+    assert score[0][1] > 0.9
+
+
+def test_module_multi_device_matches_single_device():
+    """One step of DP training must equal single-device training on the same
+    batch (gradient-sum arithmetic, reference: dist_sync closed-form test)."""
+    x, y = _synthetic_classification(n=40, seed=3)
+    batch = mx.io.DataBatch(
+        data=[mx.nd.array(x)], label=[mx.nd.array(y)], pad=0, index=None
+    )
+    results = []
+    for ctxs in ([mx.cpu(0)], [mx.cpu(i) for i in range(4)]):
+        mx.random.seed(11)
+        mod = mx.mod.Module(mlp_symbol(), context=ctxs)
+        mod.bind(data_shapes=[("data", (40, 20))], label_shapes=[("softmax_label", (40,))])
+        mod.init_params(initializer=mx.init.Xavier())
+        mod.init_optimizer(optimizer="sgd", optimizer_params=(("learning_rate", 0.5),))
+        for _ in range(3):
+            mod.forward_backward(batch)
+            mod.update()
+        args, _ = mod.get_params()
+        results.append({k: v.asnumpy() for k, v in args.items()})
+    for k in results[0]:
+        assert np.allclose(results[0][k], results[1][k], rtol=1e-4, atol=1e-5), k
+
+
+def test_ndarray_iter_pad_and_shuffle():
+    x = np.arange(50, dtype="f").reshape(10, 5)
+    y = np.arange(10, dtype="f")
+    it = mx.io.NDArrayIter(x, y, batch_size=4, last_batch_handle="pad")
+    batches = list(it)
+    assert len(batches) == 3
+    assert batches[-1].pad == 2
+    it.reset()
+    total = sum(b.data[0].shape[0] for b in it)
+    assert total == 12
+
+
+def test_optimizer_lr_scheduler():
+    sched = mx.lr_scheduler.FactorScheduler(step=10, factor=0.5)
+    opt = mx.optimizer.SGD(learning_rate=1.0, lr_scheduler=sched)
+    w = mx.nd.ones((2,))
+    g = mx.nd.ones((2,))
+    for _ in range(25):
+        opt.update(0, w, g, None)
+    assert sched.base_lr < 1.0
+
+
+def test_optimizer_wd_mult_skips_bias():
+    opt = mx.optimizer.SGD(learning_rate=0.1, wd=0.5, param_idx2name={0: "fc_weight", 1: "fc_bias"})
+    w = mx.nd.ones((2,))
+    b = mx.nd.ones((2,))
+    zero_grad = mx.nd.zeros((2,))
+    opt.update(0, w, zero_grad, None)
+    opt.update(1, b, zero_grad, None)
+    assert np.allclose(w.asnumpy(), 1.0 - 0.1 * 0.5)  # decayed
+    assert np.allclose(b.asnumpy(), 1.0)  # bias: wd_mult 0
+
+
+def test_kvstore_local_semantics():
+    """Aggregation identities (reference: tests/python/unittest/test_kvstore.py)."""
+    shape = (4, 4)
+    kv = mx.kv.create("local")
+    kv.init(3, mx.nd.zeros(shape))
+    kv.push(3, mx.nd.ones(shape))
+    out = mx.nd.zeros(shape)
+    kv.pull(3, out=out)
+    assert np.allclose(out.asnumpy(), 1.0)
+    # aggregate over "devices"
+    vals = [mx.nd.ones(shape) for _ in range(4)]
+    kv.push(3, vals)
+    kv.pull(3, out=out)
+    assert np.allclose(out.asnumpy(), 4.0)
+    # updater path
+    kv2 = mx.kv.create("local")
+    kv2.init(9, mx.nd.ones(shape))
+    opt = mx.optimizer.SGD(learning_rate=0.1, rescale_grad=1.0)
+    kv2.set_optimizer(opt)
+    kv2.push(9, [mx.nd.ones(shape)] * 2)  # grad sum = 2
+    kv2.pull(9, out=out)
+    assert np.allclose(out.asnumpy(), 1.0 - 0.1 * 2.0)
+
+
+def test_initializers():
+    for init, check in [
+        (mx.init.Zero(), lambda a: np.allclose(a, 0)),
+        (mx.init.One(), lambda a: np.allclose(a, 1)),
+        (mx.init.Constant(3.5), lambda a: np.allclose(a, 3.5)),
+        (mx.init.Uniform(0.1), lambda a: np.abs(a).max() <= 0.1),
+        (mx.init.Normal(0.01), lambda a: np.abs(a).mean() < 0.05),
+        (mx.init.Xavier(), lambda a: np.isfinite(a).all()),
+        (mx.init.MSRAPrelu(), lambda a: np.isfinite(a).all()),
+    ]:
+        arr = mx.nd.zeros((20, 30))
+        init("test_weight", arr)
+        assert check(arr.asnumpy()), type(init).__name__
+    # orthogonal: W @ W.T ≈ scale^2 * I
+    arr = mx.nd.zeros((10, 30))
+    mx.init.Orthogonal(scale=1.0)("q_weight", arr)
+    a = arr.asnumpy()
+    assert np.allclose(a @ a.T, np.eye(10), atol=1e-4)
+    # bias/gamma/beta dispatch
+    arr = mx.nd.full((5,), 9.0)
+    mx.init.Xavier()("fc1_bias", arr)
+    assert np.allclose(arr.asnumpy(), 0.0)
+
+
+def test_metrics():
+    acc = mx.metric.create("acc")
+    acc.update([mx.nd.array([0, 1, 1])], [mx.nd.array([[0.9, 0.1], [0.2, 0.8], [0.7, 0.3]])])
+    assert abs(acc.get()[1] - 2.0 / 3) < 1e-6
+    mse = mx.metric.MSE()
+    mse.update([mx.nd.array([1.0, 2.0])], [mx.nd.array([[1.5], [2.5]])])
+    assert abs(mse.get()[1] - 0.25) < 1e-6
+    topk = mx.metric.TopKAccuracy(top_k=2)
+    topk.update([mx.nd.array([2, 0])], [mx.nd.array([[0.1, 0.5, 0.4], [0.35, 0.4, 0.25]])])
+    assert abs(topk.get()[1] - 1.0) < 1e-6
