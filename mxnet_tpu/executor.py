@@ -183,10 +183,14 @@ class Executor:
             arr._set_jax(new)
 
     def _apply_grads(self, grads):
+        import jax
         import jax.numpy as jnp
 
         for garr, g, req in zip(self.grad_arrays, grads, self._grad_req):
             if req == "null" or garr is None:
+                continue
+            if g.dtype == jax.dtypes.float0:
+                # integer-typed argument (e.g. token ids): no tangent space
                 continue
             if req == "add":
                 garr._set_jax(garr._jax() + g.astype(garr.dtype))

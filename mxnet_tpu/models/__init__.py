@@ -1,0 +1,41 @@
+"""Model zoo: Symbol constructors for the reference's training configs.
+
+Counterpart of the reference's ``example/image-classification/symbols/``
+(resnet.py, alexnet.py, vgg.py, inception-bn.py, lenet.py, mlp.py) — same
+capability, re-authored TPU-first: every network lowers through Symbol →
+Executor into one fused XLA computation, with shapes static so the MXU tiles
+matmuls/convs, and bf16-friendly dtypes threaded via the ``dtype`` argument.
+
+``get_symbol(name, num_classes, **kwargs)`` mirrors the reference's per-script
+``get_symbol`` entry points (e.g. example/image-classification/symbols/
+resnet.py get_symbol).
+"""
+from . import lenet, mlp, alexnet, vgg, resnet, inception_bn, lstm
+
+_ZOO = {
+    "lenet": lenet.get_symbol,
+    "mlp": mlp.get_symbol,
+    "alexnet": alexnet.get_symbol,
+    "vgg": vgg.get_symbol,
+    "vgg16": lambda **kw: vgg.get_symbol(num_layers=16, **kw),
+    "vgg19": lambda **kw: vgg.get_symbol(num_layers=19, **kw),
+    "inception-bn": inception_bn.get_symbol,
+    "inception_bn": inception_bn.get_symbol,
+    "resnet": resnet.get_symbol,
+    "resnet-18": lambda **kw: resnet.get_symbol(num_layers=18, **kw),
+    "resnet-34": lambda **kw: resnet.get_symbol(num_layers=34, **kw),
+    "resnet-50": lambda **kw: resnet.get_symbol(num_layers=50, **kw),
+    "resnet-101": lambda **kw: resnet.get_symbol(num_layers=101, **kw),
+    "resnet-152": lambda **kw: resnet.get_symbol(num_layers=152, **kw),
+    "lstm": lstm.get_symbol,
+}
+
+
+def get_symbol(name, **kwargs):
+    """Build a named network Symbol (reference: each symbols/<net>.py
+    get_symbol). kwargs are passed to the network constructor
+    (num_classes, image_shape, num_layers, dtype, ...)."""
+    key = name.lower()
+    if key not in _ZOO:
+        raise ValueError("unknown model %r (have: %s)" % (name, sorted(_ZOO)))
+    return _ZOO[key](**kwargs)

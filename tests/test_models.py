@@ -1,0 +1,64 @@
+"""Model zoo: every network builds, infers shapes, and runs one fwd/bwd step."""
+import numpy as np
+import pytest
+
+import mxnet_tpu as mx
+from mxnet_tpu import models
+
+
+@pytest.mark.parametrize(
+    "name,shape,kwargs",
+    [
+        ("lenet", (2, 1, 28, 28), {}),
+        ("mlp", (2, 784), {}),
+        ("resnet-18", (2, 3, 32, 32), {"image_shape": "3,32,32"}),
+        ("resnet-50", (2, 3, 32, 32), {"image_shape": "3,32,32"}),
+        ("alexnet", (2, 3, 224, 224), {}),
+        ("vgg16", (2, 3, 64, 64), {}),
+        ("inception-bn", (2, 3, 64, 64), {}),
+    ],
+)
+def test_model_infer_shape(name, shape, kwargs):
+    net = models.get_symbol(name, num_classes=10, **kwargs)
+    _, out_shapes, _ = net.infer_shape(data=shape)
+    assert out_shapes == [(shape[0], 10)]
+
+
+def test_lenet_trains_one_step():
+    net = models.get_symbol("lenet", num_classes=10)
+    exe = net.simple_bind(ctx=mx.cpu(), data=(4, 1, 28, 28), softmax_label=(4,))
+    exe.arg_dict["data"][:] = np.random.rand(4, 1, 28, 28).astype("float32")
+    exe.arg_dict["softmax_label"][:] = np.array([0, 1, 2, 3], dtype="float32")
+    for name, arr in exe.arg_dict.items():
+        if name not in ("data", "softmax_label"):
+            arr[:] = np.random.uniform(-0.05, 0.05, arr.shape).astype("float32")
+    exe.forward_backward()
+    g = exe.grad_dict["fc2_weight"].asnumpy()
+    assert np.isfinite(g).all() and np.abs(g).sum() > 0
+
+
+def test_resnet18_forward_runs():
+    net = models.get_symbol("resnet-18", num_classes=10, image_shape="3,32,32")
+    exe = net.simple_bind(ctx=mx.cpu(), data=(2, 3, 32, 32), softmax_label=(2,))
+    for name, arr in exe.arg_dict.items():
+        arr[:] = np.random.uniform(-0.05, 0.05, arr.shape).astype("float32")
+    out = exe.forward(is_train=False)[0].asnumpy()
+    assert out.shape == (2, 10)
+    assert np.allclose(out.sum(axis=1), 1.0, atol=1e-4)  # softmax rows
+
+
+def test_lstm_forward_backward():
+    net = models.get_symbol("lstm", num_classes=50, num_embed=8, num_hidden=16,
+                            num_layers=2, seq_len=6, batch_size=3)
+    exe = net.simple_bind(ctx=mx.cpu(), data=(3, 6), softmax_label=(3, 6),
+                          type_dict={"data": "int32"})
+    exe.arg_dict["data"][:] = np.random.randint(0, 50, (3, 6)).astype("int32")
+    exe.arg_dict["softmax_label"][:] = np.random.randint(0, 50, (3, 6)).astype("float32")
+    for name, arr in exe.arg_dict.items():
+        if name in ("data", "softmax_label"):
+            continue
+        arr[:] = np.random.uniform(-0.1, 0.1, arr.shape).astype("float32")
+    out = exe.forward_backward()
+    assert out[0].shape == (18, 50)
+    g = exe.grad_dict["lstm_parameters"].asnumpy()
+    assert np.isfinite(g).all()
