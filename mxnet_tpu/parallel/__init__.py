@@ -1,0 +1,38 @@
+"""SPMD parallelism over a TPU device mesh.
+
+This package is the TPU-native answer to the reference's entire distribution
+stack — DataParallelExecutorGroup's per-device executors
+(python/mxnet/module/executor_group.py:77), the KVStore push/pull gradient sync
+(src/kvstore/kvstore_local.h:22, kvstore_dist.h:32), and the ps-lite
+worker/server topology (SURVEY.md §2.4/§2.5). Instead of one executor per
+device plus an explicit reduce, the WHOLE training step — forward, backward,
+gradient all-reduce, optimizer — is one jitted XLA computation over a
+``jax.sharding.Mesh``:
+
+  * batch axis sharded over the ``data`` mesh axis (data parallelism; the
+    gradient psum is inserted by XLA's sharding propagation and rides ICI),
+  * large weights optionally sharded over the ``model`` axis (tensor
+    parallelism — the reference's group2ctx model parallelism re-imagined as
+    sharding annotations instead of graph-partitioning + _CrossDeviceCopy),
+  * ``jax.checkpoint`` rematerialisation standing in for
+    MXNET_BACKWARD_DO_MIRROR (graph_executor.cc:210-223),
+  * bf16 compute with fp32 master weights for the MXU fast path.
+
+Multi-host: the same jit over a mesh spanning ``jax.devices()`` of all
+processes (after ``jax.distributed.initialize``) IS the dist_tpu_sync design —
+collectives ride ICI within a slice and DCN across slices; there is no
+server/scheduler role to run.
+"""
+from .mesh import make_mesh, local_mesh
+from .sharding import ShardingRules, param_pspec
+from .optim import make_functional_optimizer
+from .trainer import SPMDTrainer
+
+__all__ = [
+    "make_mesh",
+    "local_mesh",
+    "ShardingRules",
+    "param_pspec",
+    "make_functional_optimizer",
+    "SPMDTrainer",
+]

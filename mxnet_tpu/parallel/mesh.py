@@ -1,0 +1,50 @@
+"""Device-mesh construction helpers."""
+from __future__ import annotations
+
+import numpy as np
+
+__all__ = ["make_mesh", "local_mesh"]
+
+
+def make_mesh(shape=None, axis_names=("data", "model"), devices=None):
+    """Build a ``jax.sharding.Mesh``.
+
+    ``shape`` maps axis name → size (dict) or is a tuple aligned with
+    ``axis_names``. Unspecified trailing axes default to size 1; a single
+    ``-1`` entry absorbs the remaining devices. With no shape at all, every
+    device lands on the first axis (pure data parallelism)."""
+    import jax
+
+    if devices is None:
+        devices = jax.devices()
+    n = len(devices)
+    if shape is None:
+        sizes = [n] + [1] * (len(axis_names) - 1)
+    elif isinstance(shape, dict):
+        axis_names = tuple(shape.keys())
+        sizes = list(shape.values())
+    else:
+        sizes = list(shape)
+        if len(sizes) < len(axis_names):
+            sizes += [1] * (len(axis_names) - len(sizes))
+    if sizes.count(-1) > 1:
+        raise ValueError("at most one mesh axis may be -1")
+    if -1 in sizes:
+        known = int(np.prod([s for s in sizes if s != -1]))
+        if n % known:
+            raise ValueError("mesh shape %s does not divide %d devices" % (sizes, n))
+        sizes[sizes.index(-1)] = n // known
+    if int(np.prod(sizes)) != n:
+        raise ValueError("mesh shape %s != %d devices" % (sizes, n))
+    dev_array = np.asarray(devices).reshape(sizes)
+    return jax.sharding.Mesh(dev_array, tuple(axis_names))
+
+
+def local_mesh(n_devices=None, axis_names=("data",)):
+    """Mesh over the first ``n_devices`` local devices, one axis by default."""
+    import jax
+
+    devices = jax.devices()
+    if n_devices is not None:
+        devices = devices[:n_devices]
+    return make_mesh((len(devices),) + (1,) * (len(axis_names) - 1), axis_names, devices)

@@ -1,0 +1,91 @@
+"""Functional optimizers for the in-step SPMD update.
+
+The imperative ``mxnet_tpu.optimizer`` classes update NDArrays key-by-key —
+fine for the Module/KVStore path, but the SPMD trainer needs the update
+*inside* the jitted step (the reference's ``update_on_kvstore`` moved the
+optimizer onto ps-lite servers, kvstore_dist_server.h:164-198; SPMD moves it
+into the compiled program). These return pure ``(init, apply)`` pairs over
+parameter pytrees, mirroring the fused-op semantics of ops/optimizer_ops.py.
+"""
+from __future__ import annotations
+
+__all__ = ["make_functional_optimizer"]
+
+
+def make_functional_optimizer(name="sgd", learning_rate=0.01, wd=0.0,
+                              rescale_grad=1.0, clip_gradient=None,
+                              momentum=0.9, beta1=0.9, beta2=0.999,
+                              epsilon=1e-8, **_ignored):
+    """Return ``(init_fn, apply_fn)``.
+
+    ``init_fn(params) -> state``; ``apply_fn(params, grads, state) ->
+    (new_params, new_state)``. All pure jax, so the whole update fuses into
+    the training step's XLA computation."""
+    import jax
+    import jax.numpy as jnp
+
+    lr, mom = learning_rate, momentum
+
+    def prep(g):
+        g = g * rescale_grad
+        if clip_gradient is not None and clip_gradient > 0:
+            g = jnp.clip(g, -clip_gradient, clip_gradient)
+        return g
+
+    if name in ("sgd", "nag"):
+        use_mom = mom > 0
+
+        def init(params):
+            t = jnp.zeros((), "int32")
+            if not use_mom:
+                return {"t": t}
+            return {"t": t, "mom": jax.tree.map(jnp.zeros_like, params)}
+
+        def apply(params, grads, state):
+            def upd(w, g, m=None):
+                g = prep(g) + wd * w
+                if m is None:
+                    return w - lr * g, None
+                new_m = mom * m - lr * g
+                if name == "nag":  # Nesterov lookahead (reference optimizer.py NAG)
+                    return w + mom * new_m - lr * g, new_m
+                return w + new_m, new_m
+
+            if use_mom:
+                out = jax.tree.map(lambda w, g, m: upd(w, g, m), params, grads, state["mom"])
+                new_params = jax.tree.map(lambda o: o[0], out, is_leaf=lambda x: isinstance(x, tuple))
+                new_mom = jax.tree.map(lambda o: o[1], out, is_leaf=lambda x: isinstance(x, tuple))
+                return new_params, {"t": state["t"] + 1, "mom": new_mom}
+            new_params = jax.tree.map(lambda w, g: upd(w, g)[0], params, grads)
+            return new_params, {"t": state["t"] + 1}
+
+        return init, apply
+
+    if name == "adam":
+
+        def init(params):
+            return {
+                "t": jnp.zeros((), "int32"),
+                "m": jax.tree.map(jnp.zeros_like, params),
+                "v": jax.tree.map(jnp.zeros_like, params),
+            }
+
+        def apply(params, grads, state):
+            t = state["t"] + 1
+            # bias-corrected step size, as the reference Adam computes lr_t
+            lr_t = lr * jnp.sqrt(1.0 - beta2 ** t.astype("float32")) / (
+                1.0 - beta1 ** t.astype("float32"))
+
+            def upd(w, g, m, v):
+                g = prep(g) + wd * w
+                m = beta1 * m + (1 - beta1) * g
+                v = beta2 * v + (1 - beta2) * g * g
+                return w - lr_t * m / (jnp.sqrt(v) + epsilon), m, v
+
+            out = jax.tree.map(upd, params, grads, state["m"], state["v"])
+            first = lambda i: jax.tree.map(lambda o: o[i], out, is_leaf=lambda x: isinstance(x, tuple))
+            return first(0), {"t": t, "m": first(1), "v": first(2)}
+
+        return init, apply
+
+    raise ValueError("unknown functional optimizer %r (have sgd/nag/adam)" % name)

@@ -1,0 +1,76 @@
+"""Sharding rules: map symbol arguments to PartitionSpecs.
+
+The reference distributes work by *where tensors live* (ctx lists, group2ctx
+device placement, kvstore reduce targets). On TPU the equivalent decision is
+*how arrays are laid out over the mesh*; XLA then materialises the collectives.
+These rules are that translation table.
+"""
+from __future__ import annotations
+
+from typing import Callable, Optional
+
+__all__ = ["ShardingRules", "param_pspec"]
+
+
+def param_pspec(name, shape, model_axis="model", model_size=1, min_shard_elems=2 ** 16):
+    """Default tensor-parallel rule for a parameter.
+
+    Shards the output dimension of large FC weights (``(out, in)``) and the
+    vocab dimension of large embeddings over the ``model`` axis when the dim
+    divides evenly; everything else (conv filters, biases, BN stats) is
+    replicated — conv FLOPs are already parallel over the sharded batch, and
+    small arrays cost more to shard than to replicate."""
+    import numpy as np
+    from jax.sharding import PartitionSpec as P
+
+    if model_size <= 1 or len(shape) < 2:
+        return P()
+    if int(np.prod(shape)) < min_shard_elems:
+        return P()
+    if shape[0] % model_size == 0:
+        return P(model_axis, *([None] * (len(shape) - 1)))
+    return P()
+
+
+class ShardingRules:
+    """Bundle of sharding decisions for one training program.
+
+    ``data_axis``/``model_axis`` name mesh axes. ``param_rule(name, shape) ->
+    PartitionSpec`` decides parameter layout (default: ``param_pspec``).
+    Data/label batches are sharded on dim 0 over the data axis."""
+
+    def __init__(self, mesh, data_axis="data", model_axis="model",
+                 param_rule: Optional[Callable] = None):
+        self.mesh = mesh
+        self.data_axis = data_axis if data_axis in mesh.axis_names else None
+        self.model_axis = model_axis if model_axis in mesh.axis_names else None
+        self._param_rule = param_rule
+
+    @property
+    def data_parallel_size(self):
+        return self.mesh.shape[self.data_axis] if self.data_axis else 1
+
+    @property
+    def model_parallel_size(self):
+        return self.mesh.shape[self.model_axis] if self.model_axis else 1
+
+    def batch_spec(self, shape):
+        from jax.sharding import PartitionSpec as P
+
+        if not self.data_axis or not shape:
+            return P()
+        return P(self.data_axis, *([None] * (len(shape) - 1)))
+
+    def param_spec(self, name, shape):
+        from jax.sharding import PartitionSpec as P
+
+        if self._param_rule is not None:
+            return self._param_rule(name, shape)
+        if not self.model_axis:
+            return P()
+        return param_pspec(name, shape, self.model_axis, self.model_parallel_size)
+
+    def named(self, spec):
+        from jax.sharding import NamedSharding
+
+        return NamedSharding(self.mesh, spec)

@@ -1,0 +1,218 @@
+"""SPMDTrainer: the whole training step as one sharded XLA computation.
+
+Replaces the reference's hot path end to end (SURVEY.md §3.1): where
+``Module.fit`` drove DataParallelExecutorGroup.forward/backward per device and
+then KVStore push/pull per key (executor_group.py:355/481, model.py:88-116),
+here forward + backward + gradient all-reduce + optimizer update compile into
+a single ``jax.jit`` over a device mesh. The gradient psum never appears in
+user code — params are laid out replicated (or model-axis-sharded) while the
+batch is data-axis-sharded, so XLA's sharding propagation inserts the
+all-reduce, batching all keys of the step into fused collectives riding ICI
+(the hand-tuned priority queues of model.py:95-110 become the compiler's
+latency hiding).
+"""
+from __future__ import annotations
+
+from typing import Dict, Optional
+
+import numpy as np
+
+from ..base import MXNetError
+from .optim import make_functional_optimizer
+from .sharding import ShardingRules
+
+__all__ = ["SPMDTrainer"]
+
+
+class SPMDTrainer:
+    """Train a Symbol over a mesh.
+
+    Parameters
+    ----------
+    symbol : the network (loss heads as outputs, e.g. SoftmaxOutput).
+    mesh : jax.sharding.Mesh (see parallel.make_mesh).
+    data_names / label_names : input argument names.
+    optimizer / optimizer_params : functional optimizer spec (optim.py).
+    rules : ShardingRules (defaults to batch-on-'data', params replicated or
+        tensor-sharded on 'model' when present).
+    remat : rematerialise the forward during backward (jax.checkpoint) — the
+        MXNET_BACKWARD_DO_MIRROR memory/compute trade.
+    compute_dtype : e.g. 'bfloat16' — cast inputs+params for compute, keep
+        fp32 master weights and fp32 grads (MXU fast path).
+    """
+
+    def __init__(self, symbol, mesh, data_names=("data",),
+                 label_names=("softmax_label",), optimizer="sgd",
+                 optimizer_params=None, rules: Optional[ShardingRules] = None,
+                 remat=False, compute_dtype=None):
+        from ..executor import _GraphProgram
+
+        self.symbol = symbol
+        self.mesh = mesh
+        self.rules = rules or ShardingRules(mesh)
+        self._prog = _GraphProgram(symbol)
+        self._remat = bool(remat)
+        self._compute_dtype = np.dtype(compute_dtype) if compute_dtype else None
+
+        arg_names = self._prog.arg_names
+        self.input_names = [n for n in list(data_names) + list(label_names) if n in arg_names]
+        self.param_names = [n for n in arg_names if n not in self.input_names]
+        self.aux_names = self._prog.aux_names
+
+        self._opt_init, self._opt_apply = make_functional_optimizer(
+            optimizer, **dict(optimizer_params or {}))
+
+        self.params: Dict = {}
+        self.aux: Dict = {}
+        self.opt_state = None
+        self._step_fn = None
+        self._step_count = 0
+
+    # ------------------------------------------------------------------ init
+    def init_params(self, data_shapes, label_shapes=None, initializer=None,
+                    dtype="float32", seed=0):
+        """Infer all shapes, initialize params on host, lay them out on the
+        mesh per the sharding rules (committed arrays — jit respects them)."""
+        import jax
+        import jax.numpy as jnp
+
+        from ..initializer import InitDesc, Xavier
+
+        initializer = initializer or Xavier(factor_type="in", magnitude=2.0)
+        hints = dict(data_shapes)
+        hints.update(label_shapes or {})
+        arg_shapes, _, aux_shapes = self.symbol.infer_shape(**hints)
+        arg_map = dict(zip(self._prog.arg_names, arg_shapes))
+        aux_map = dict(zip(self.aux_names, aux_shapes))
+        attrs = self.symbol.attr_dict()
+        from .. import random as _rnd
+
+        _rnd.seed(seed)  # deterministic init regardless of prior RNG use
+
+        def host_init(name, shape):
+            arr = np.zeros(shape, dtype=dtype)
+            desc = InitDesc(name, attrs.get(name, {}))
+            # initializer mutates NDArray-likes; adapt via a tiny shim
+            from ..ndarray import array as nd_array
+
+            tmp = nd_array(arr)
+            initializer(desc, tmp)
+            return tmp.asnumpy()
+
+        self.params = {}
+        for name in self.param_names:
+            spec = self.rules.param_spec(name, arg_map[name])
+            host = host_init(name, arg_map[name])
+            self.params[name] = jax.device_put(jnp.asarray(host), self.rules.named(spec))
+        self.aux = {}
+        for name in self.aux_names:
+            host = host_init(name, aux_map[name])
+            self.aux[name] = jax.device_put(jnp.asarray(host), self.rules.named(_replicated(self.rules)))
+        self.opt_state = self._opt_init(self.params)
+        return self
+
+    # ------------------------------------------------------------------ step
+    def _build_step(self):
+        import jax
+        import jax.numpy as jnp
+
+        prog = self._prog
+        input_names = self.input_names
+        param_names = self.param_names
+        aux_names = self.aux_names
+        cdt = self._compute_dtype
+        opt_apply = self._opt_apply
+
+        def assemble(params, inputs):
+            vals = []
+            for n in prog.arg_names:
+                v = inputs[n] if n in input_names else params[n]
+                if cdt is not None and jnp.issubdtype(v.dtype, jnp.floating):
+                    v = v.astype(cdt)
+                vals.append(v)
+            return tuple(vals)
+
+        def fwd(params, aux_tuple, inputs, rng):
+            outs, new_aux = prog.interpret(assemble(params, inputs), aux_tuple, True, rng)
+            if cdt is not None:
+                new_aux = tuple(a.astype(o.dtype) if hasattr(o, "dtype") else a
+                                for a, o in zip(new_aux, aux_tuple))
+            return outs, new_aux
+
+        if self._remat:
+            fwd = jax.checkpoint(fwd, static_argnums=())
+
+        def step(params, aux, opt_state, inputs, rng):
+            aux_tuple = tuple(aux[n] for n in aux_names)
+
+            def f(p):
+                return fwd(p, aux_tuple, inputs, rng)
+
+            outs, vjp_fn, new_aux = jax.vjp(f, params, has_aux=True)
+            # loss heads (SoftmaxOutput & friends) ignore the incoming
+            # cotangent, so ones is the identity head gradient
+            cot = tuple(jnp.ones_like(o) for o in outs)
+            (grads,) = vjp_fn(cot)
+            grads = {k: g.astype(params[k].dtype) for k, g in grads.items()
+                     if hasattr(g, "dtype") and g.dtype != jax.dtypes.float0}
+            for k in params:
+                if k not in grads:
+                    grads[k] = jnp.zeros_like(params[k])
+            new_params, new_opt = opt_apply(params, grads, opt_state)
+            new_aux_d = dict(zip(aux_names, new_aux))
+            return new_params, new_aux_d, new_opt, outs
+
+        return jax.jit(step, donate_argnums=(0, 1, 2))
+
+    def step(self, data: Dict, label: Optional[Dict] = None):
+        """Run one training step; returns the head outputs (jax arrays)."""
+        import jax
+        import jax.numpy as jnp
+
+        if not self.params and self.param_names:
+            raise MXNetError("call init_params first")
+        if self._step_fn is None:
+            self._step_fn = self._build_step()
+        inputs = dict(data)
+        inputs.update(label or {})
+        placed = {}
+        for n in self.input_names:
+            if n not in inputs:
+                raise MXNetError("missing input %r" % n)
+            v = inputs[n]
+            v = v if hasattr(v, "dtype") and not isinstance(v, np.ndarray) else jnp.asarray(np.asarray(v))
+            spec = self.rules.batch_spec(v.shape)
+            placed[n] = jax.device_put(v, self.rules.named(spec))
+        rng = jax.random.PRNGKey(self._step_count)
+        self._step_count += 1
+        self.params, self.aux, self.opt_state, outs = self._step_fn(
+            self.params, self.aux, self.opt_state, placed, rng)
+        return outs
+
+    # ------------------------------------------------------------------ misc
+    def get_params(self):
+        """Gather params/aux to host numpy (for checkpointing / Module interop)."""
+        import jax
+
+        gather = lambda d: {k: np.asarray(jax.device_get(v)) for k, v in d.items()}
+        return gather(self.params), gather(self.aux)
+
+    def set_params(self, arg_params, aux_params=None):
+        import jax
+        import jax.numpy as jnp
+
+        for name, v in (arg_params or {}).items():
+            if name in self.param_names:
+                spec = self.rules.param_spec(name, np.shape(v))
+                self.params[name] = jax.device_put(jnp.asarray(np.asarray(v)), self.rules.named(spec))
+        for name, v in (aux_params or {}).items():
+            if name in self.aux_names:
+                self.aux[name] = jax.device_put(jnp.asarray(np.asarray(v)), self.rules.named(_replicated(self.rules)))
+        if self.opt_state is None and self.params:
+            self.opt_state = self._opt_init(self.params)
+
+
+def _replicated(rules):
+    from jax.sharding import PartitionSpec as P
+
+    return P()
