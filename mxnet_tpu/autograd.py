@@ -1,0 +1,261 @@
+"""Imperative autograd: record NDArray ops, replay backward.
+
+Counterpart of the reference's AutogradRuntime (src/ndarray/autograd.cc:73
+RecordImperativeFCompute, :135 ComputeGradient) and the Python surface
+python/mxnet/contrib/autograd.py (set_is_training, train_section,
+mark_variables, backward, grad_and_loss). The reference records ops into an
+NNVM graph and binds a GraphExecutor over the tape; here the tape is replayed
+as a pure JAX function over the marked variables and differentiated with
+``jax.vjp`` — one fused backward XLA program instead of a node-by-node engine
+walk.
+
+Limitations (documented, as in the 0.9.5 contrib API): arrays must not be
+mutated in place between recording and ``backward``; views of marked arrays
+are not tracked as the marked variable.
+"""
+from __future__ import annotations
+
+import contextlib
+from typing import List, Optional
+
+from .base import MXNetError
+from .ndarray import NDArray, _Chunk
+
+__all__ = [
+    "set_is_training",
+    "is_training",
+    "set_recording",
+    "is_recording",
+    "record",
+    "train_section",
+    "test_section",
+    "mark_variables",
+    "backward",
+    "compute_gradient",
+    "grad_and_loss",
+    "grad",
+]
+
+_RECORDING = False
+_TRAIN_MODE = True
+_TAPE: List["_TapeEntry"] = []
+_MARKED = {}  # id(NDArray) -> (ndarray, grad ndarray, grad_req)
+
+
+class _TapeEntry:
+    __slots__ = ("op", "attrs", "inputs", "in_vals", "n_aux", "outputs", "rng", "is_train")
+
+    def __init__(self, op, attrs, inputs, in_vals, n_aux, outputs, rng, is_train):
+        self.op = op
+        self.attrs = attrs
+        self.inputs = inputs
+        self.in_vals = in_vals
+        self.n_aux = n_aux
+        self.outputs = outputs
+        self.rng = rng
+        self.is_train = is_train
+
+
+# ------------------------------------------------------------------ recording
+def is_recording() -> bool:
+    return _RECORDING
+
+
+def is_training() -> bool:
+    return _TRAIN_MODE
+
+
+def set_recording(flag: bool) -> bool:
+    """Returns the previous state (reference: autograd.py set_is_recording)."""
+    global _RECORDING
+    prev, _RECORDING = _RECORDING, bool(flag)
+    return prev
+
+
+def set_is_training(flag: bool) -> bool:
+    global _TRAIN_MODE
+    prev, _TRAIN_MODE = _TRAIN_MODE, bool(flag)
+    return prev
+
+
+@contextlib.contextmanager
+def record(train_mode=True):
+    """Recording scope (reference: contrib/autograd.py train_section)."""
+    prev_r = set_recording(True)
+    prev_t = set_is_training(train_mode)
+    try:
+        yield
+    finally:
+        set_recording(prev_r)
+        set_is_training(prev_t)
+
+
+@contextlib.contextmanager
+def train_section():
+    with record(train_mode=True):
+        yield
+
+
+@contextlib.contextmanager
+def test_section():
+    with record(train_mode=False):
+        yield
+
+
+def _record_op(op_name, attrs, inputs, in_vals, n_aux, outputs, rng, is_train):
+    """Called by imperative_invoke under recording."""
+    _TAPE.append(_TapeEntry(op_name, dict(attrs), list(inputs), list(in_vals),
+                            n_aux, list(outputs), rng, is_train))
+
+
+def _clear_tape():
+    _TAPE.clear()
+
+
+# ------------------------------------------------------------------ variables
+def mark_variables(variables, gradients, grad_reqs="write"):
+    """Attach gradient buffers to arrays (reference: autograd.cc MarkVariables)."""
+    if isinstance(variables, NDArray):
+        variables = [variables]
+    if isinstance(gradients, NDArray):
+        gradients = [gradients]
+    if isinstance(grad_reqs, str):
+        grad_reqs = [grad_reqs] * len(variables)
+    if not (len(variables) == len(gradients) == len(grad_reqs)):
+        raise MXNetError("mark_variables: length mismatch")
+    for v, g, r in zip(variables, gradients, grad_reqs):
+        if not isinstance(v, NDArray) or not isinstance(g, NDArray):
+            raise TypeError("mark_variables expects NDArrays")
+        _MARKED[id(v)] = (v, g, r)
+
+
+# ------------------------------------------------------------------- backward
+def backward(outputs, out_grads=None, retain_graph=False):
+    """Compute gradients of ``outputs`` w.r.t. all marked variables
+    (reference: autograd.cc:135 ComputeGradient)."""
+    import jax
+    import jax.numpy as jnp
+
+    from .ops.registry import get_op
+
+    if isinstance(outputs, NDArray):
+        outputs = [outputs]
+    if out_grads is not None and isinstance(out_grads, NDArray):
+        out_grads = [out_grads]
+
+    produced = {}
+    for ei, e in enumerate(_TAPE):
+        for o in e.outputs:
+            produced[id(o)] = ei
+
+    # reverse reachability from heads → the slice of the tape that matters
+    needed = set()
+    stack = [id(o) for o in outputs]
+    seen = set()
+    while stack:
+        oid = stack.pop()
+        if oid in seen or oid not in produced:
+            continue
+        seen.add(oid)
+        ei = produced[oid]
+        needed.add(ei)
+        e = _TAPE[ei]
+        for x in e.inputs[: len(e.inputs) - e.n_aux]:
+            stack.append(id(x))
+    order = sorted(needed)
+
+    marked = [(v, g, r) for (v, g, r) in _MARKED.values()]
+    if not marked:
+        raise MXNetError("backward: no marked variables (call mark_variables)")
+    var_ids = [id(v) for v, _, _ in marked]
+    var_vals = tuple(v._jax() for v, _, _ in marked)
+    head_ids = {id(o): i for i, o in enumerate(outputs)}
+
+    def replay(vals):
+        env = dict(zip(var_ids, vals))
+        for ei in order:
+            e = _TAPE[ei]
+            opdef = get_op(e.op)
+            n_in = len(e.inputs) - e.n_aux
+            ins = [env.get(id(x), e.in_vals[i]) for i, x in enumerate(e.inputs[:n_in])]
+            aux = list(e.in_vals[n_in:])
+            outs, _ = opdef.apply(e.attrs, ins, aux=aux, is_train=e.is_train, rng=e.rng)
+            for o_nd, o_val in zip(e.outputs, outs):
+                env[id(o_nd)] = o_val
+        heads = []
+        for o in outputs:
+            if id(o) not in env:
+                raise MXNetError("backward: output was not recorded on the tape")
+            heads.append(env[id(o)])
+        return tuple(heads)
+
+    heads, vjp_fn = jax.vjp(replay, var_vals)
+    if out_grads is None:
+        cot = tuple(jnp.ones_like(h) for h in heads)
+    else:
+        if len(out_grads) != len(heads):
+            raise MXNetError("backward: expected %d head grads" % len(heads))
+        cot = tuple(g._jax().astype(h.dtype) for g, h in zip(out_grads, heads))
+    (grads,) = vjp_fn(cot)
+
+    for (v, gbuf, req), g in zip(marked, grads):
+        if req == "null":
+            continue
+        if g.dtype == jax.dtypes.float0:
+            continue
+        if req == "add":
+            gbuf._set_jax(gbuf._jax() + g.astype(gbuf.dtype))
+        else:
+            gbuf._set_jax(g.astype(gbuf.dtype))
+
+    if not retain_graph:
+        _clear_tape()
+
+
+def compute_gradient(outputs):
+    """(reference: contrib/autograd.py compute_gradient)"""
+    backward(outputs)
+
+
+# ------------------------------------------------------------------ decorators
+def grad_and_loss(func, argnum=None):
+    """Return a function computing both gradient of args and loss
+    (reference: contrib/autograd.py grad_and_loss)."""
+    import functools
+
+    @functools.wraps(func)
+    def wrapped(*args):
+        variables = list(args)
+        if argnum is not None:
+            argnums = [argnum] if isinstance(argnum, int) else list(argnum)
+            variables = [args[i] for i in argnums]
+        for v in variables:
+            if not isinstance(v, NDArray):
+                raise TypeError("grad_and_loss: arguments must be NDArrays")
+        from .ndarray import zeros
+
+        grads = [zeros(v.shape, ctx=v.context, dtype=v.dtype) for v in variables]
+        mark_variables(variables, grads)
+        prev = list(_TAPE)
+        _clear_tape()
+        try:
+            with record():
+                outputs = func(*args)
+            backward([outputs] if isinstance(outputs, NDArray) else list(outputs))
+        finally:
+            for v in variables:
+                _MARKED.pop(id(v), None)
+            _TAPE.extend(prev)
+        return grads, outputs
+
+    return wrapped
+
+
+def grad(func, argnum=None):
+    """(reference: contrib/autograd.py grad)"""
+    fn = grad_and_loss(func, argnum)
+
+    def wrapped(*args):
+        return fn(*args)[0]
+
+    return wrapped

@@ -67,6 +67,8 @@ class SPMDTrainer:
         self.opt_state = None
         self._step_fn = None
         self._step_count = 0
+        self._seed = 0
+        self._base_key = None
 
     # ------------------------------------------------------------------ init
     def init_params(self, data_shapes, label_shapes=None, initializer=None,
@@ -142,7 +144,10 @@ class SPMDTrainer:
         if self._remat:
             fwd = jax.checkpoint(fwd, static_argnums=())
 
-        def step(params, aux, opt_state, inputs, rng):
+        def step(params, aux, opt_state, inputs, base_key):
+            # derive the per-step key on device from the optimizer counter —
+            # no host→device key transfer inside the training loop
+            rng = jax.random.fold_in(base_key, opt_state["t"])
             aux_tuple = tuple(aux[n] for n in aux_names)
 
             def f(p):
@@ -183,10 +188,12 @@ class SPMDTrainer:
             v = v if hasattr(v, "dtype") and not isinstance(v, np.ndarray) else jnp.asarray(np.asarray(v))
             spec = self.rules.batch_spec(v.shape)
             placed[n] = jax.device_put(v, self.rules.named(spec))
-        rng = jax.random.PRNGKey(self._step_count)
+        if getattr(self, "_base_key", None) is None:
+            self._base_key = jax.device_put(
+                jax.random.PRNGKey(self._seed), self.rules.named(_replicated(self.rules)))
         self._step_count += 1
         self.params, self.aux, self.opt_state, outs = self._step_fn(
-            self.params, self.aux, self.opt_state, placed, rng)
+            self.params, self.aux, self.opt_state, placed, self._base_key)
         return outs
 
     # ------------------------------------------------------------------ misc

@@ -1,0 +1,109 @@
+"""Imperative autograd (port of the reference's tests/python/unittest/
+test_autograd.py semantics: grad_and_loss, argnum, unary/binary chains,
+training-mode flag)."""
+import numpy as np
+
+import mxnet_tpu as mx
+from mxnet_tpu import autograd as ag
+from mxnet_tpu.ndarray import zeros
+
+
+def _uniform(shape):
+    return mx.nd.array(np.random.uniform(-1, 1, shape).astype("float32"))
+
+
+def autograd_assert(*args, func, grad_func):
+    grad_and_loss = ag.grad_and_loss(func)
+    grads, output = grad_and_loss(*args)
+    res = func(*args)
+    np.testing.assert_allclose(output.asnumpy(), res.asnumpy(), rtol=1e-5)
+    expected = grad_func(*args)
+    for g, e in zip(grads, expected):
+        np.testing.assert_allclose(g.asnumpy(), e, rtol=1e-4, atol=1e-5)
+
+
+def test_unary_func():
+    x = _uniform((4, 5))
+    autograd_assert(x, func=lambda x: x + 1, grad_func=lambda x: [np.ones_like(x.asnumpy())])
+    autograd_assert(x, func=lambda x: x * 4, grad_func=lambda x: [4 * np.ones_like(x.asnumpy())])
+    autograd_assert(x, func=lambda x: x * x, grad_func=lambda x: [2 * x.asnumpy()])
+
+
+def test_binary_func():
+    x = _uniform((3, 4))
+    y = _uniform((3, 4))
+    autograd_assert(x, y, func=lambda a, b: a * b,
+                    grad_func=lambda a, b: [b.asnumpy(), a.asnumpy()])
+    autograd_assert(x, y, func=lambda a, b: a + b,
+                    grad_func=lambda a, b: [np.ones((3, 4), "f"), np.ones((3, 4), "f")])
+
+
+def test_argnum():
+    def f_with_mode(a, b, mode):
+        if mode:
+            return a + b
+        return a * b
+
+    x = _uniform((3, 2))
+    y = _uniform((3, 2))
+    fn = ag.grad_and_loss(lambda a, b, m: f_with_mode(a, b, m), argnum=[0, 1])
+    grads, out = fn(x, y, True)
+    np.testing.assert_allclose(grads[0].asnumpy(), np.ones((3, 2)), rtol=1e-5)
+
+
+def test_chain_of_ops():
+    x = _uniform((2, 3))
+
+    def f(x):
+        y = mx.nd.exp(x)
+        z = y * y
+        return mx.nd.sum(z)
+
+    grads = ag.grad(f)(x)
+    expected = 2 * np.exp(2 * x.asnumpy())
+    np.testing.assert_allclose(grads[0].asnumpy(), expected, rtol=1e-4)
+
+
+def test_backward_with_head_grad():
+    x = _uniform((3, 3))
+    gx = zeros((3, 3))
+    ag.mark_variables([x], [gx])
+    with ag.record():
+        y = x * 2
+    head = mx.nd.array(np.full((3, 3), 0.5, "float32"))
+    ag.backward([y], out_grads=[head])
+    np.testing.assert_allclose(gx.asnumpy(), np.ones((3, 3)), rtol=1e-5)
+    ag._MARKED.clear()
+
+
+def test_grad_req_add():
+    x = _uniform((2, 2))
+    gx = zeros((2, 2))
+    ag.mark_variables([x], [gx], grad_reqs="add")
+    for _ in range(2):
+        with ag.record():
+            y = x * 3
+        ag.backward([y])
+    np.testing.assert_allclose(gx.asnumpy(), 6 * np.ones((2, 2)), rtol=1e-5)
+    ag._MARKED.clear()
+
+
+def test_training_flag():
+    x = mx.nd.ones((10, 10))
+    with ag.record(train_mode=False):
+        assert ag.is_training() is False
+        assert ag.is_recording() is True
+    assert ag.is_recording() is False
+
+
+def test_retain_graph():
+    x = _uniform((2, 2))
+    gx = zeros((2, 2))
+    ag.mark_variables([x], [gx])
+    with ag.record():
+        y = x * x
+    ag.backward([y], retain_graph=True)
+    g1 = gx.asnumpy().copy()
+    ag.backward([y])  # tape still alive
+    np.testing.assert_allclose(gx.asnumpy(), g1, rtol=1e-6)
+    ag._MARKED.clear()
