@@ -36,37 +36,45 @@ def main():
 
     dev = jax.devices()[0]
     on_tpu = dev.platform not in ("cpu",)
-    batch = 32 if on_tpu else 8
     image = 224 if on_tpu else 64
+    candidates = [256, 128, 64, 32] if on_tpu else [8]
 
     mesh = parallel.make_mesh((1,), axis_names=("data",), devices=[dev])
     net = models.get_symbol("resnet-50", num_classes=1000,
                             image_shape="3,%d,%d" % (image, image))
-    trainer = parallel.SPMDTrainer(
-        net, mesh,
-        optimizer="sgd",
-        optimizer_params={"learning_rate": 0.1, "momentum": 0.9},
-        compute_dtype="bfloat16" if on_tpu else None,
-    )
-    trainer.init_params({"data": (batch, 3, image, image)},
-                        {"softmax_label": (batch,)}, seed=0)
 
-    rs = np.random.RandomState(0)
-    # pre-place the synthetic batch on device once — the benchmark measures
-    # the training step, not host→device feed (the reference's --benchmark 1
-    # likewise reuses one synthetic batch)
-    x = jax.device_put(
-        rs.rand(batch, 3, image, image).astype("float32"),
-        trainer.rules.named(trainer.rules.batch_spec((batch, 3, image, image))))
-    y = jax.device_put(
-        rs.randint(0, 1000, (batch,)).astype("float32"),
-        trainer.rules.named(trainer.rules.batch_spec((batch,))))
-
-    # warmup: compile + 2 steady steps
-    for _ in range(3):
-        outs = trainer.step({"data": x}, {"softmax_label": y})
-    jax.block_until_ready(outs)
-    jax.block_until_ready(trainer.params)
+    trainer = x = y = None
+    for batch in candidates:
+        try:
+            trainer = parallel.SPMDTrainer(
+                net, mesh,
+                optimizer="sgd",
+                optimizer_params={"learning_rate": 0.1, "momentum": 0.9},
+                compute_dtype="bfloat16" if on_tpu else None,
+            )
+            trainer.init_params({"data": (batch, 3, image, image)},
+                                {"softmax_label": (batch,)}, seed=0)
+            rs = np.random.RandomState(0)
+            # pre-place the synthetic batch on device once — the benchmark
+            # measures the training step, not host→device feed (the
+            # reference's --benchmark 1 likewise reuses one synthetic batch)
+            x = jax.device_put(
+                rs.rand(batch, 3, image, image).astype("float32"),
+                trainer.rules.named(trainer.rules.batch_spec((batch, 3, image, image))))
+            y = jax.device_put(
+                rs.randint(0, 1000, (batch,)).astype("float32"),
+                trainer.rules.named(trainer.rules.batch_spec((batch,))))
+            # warmup: compile + 2 steady steps
+            for _ in range(3):
+                outs = trainer.step({"data": x}, {"softmax_label": y})
+            jax.block_until_ready(outs)
+            jax.block_until_ready(trainer.params)
+            break
+        except Exception:  # OOM at this batch — try the next size down
+            if batch == candidates[-1]:
+                raise
+            trainer = None
+            continue
 
     n_steps = 10 if on_tpu else 3
     t0 = time.perf_counter()

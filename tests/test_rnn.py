@@ -1,0 +1,120 @@
+"""RNN toolkit (port of the reference's tests/python/unittest/test_rnn.py
+strategy: fused-vs-unfused consistency under pack/unpack, cell unroll shapes,
+bucketing iterator)."""
+import numpy as np
+import pytest
+
+import mxnet_tpu as mx
+from mxnet_tpu import rnn
+from mxnet_tpu import symbol as sym
+from mxnet_tpu.ops.rnn import rnn_param_size
+
+
+def _bind_and_run(out_sym, args_np):
+    exe = mx.executor.bind(
+        out_sym, mx.cpu(),
+        {k: mx.nd.array(v) for k, v in args_np.items()},
+        args_grad=None, grad_req="null", aux_states={})
+    return [o.asnumpy() for o in exe.forward(is_train=False)]
+
+
+@pytest.mark.parametrize("mode", ["lstm", "gru", "rnn_tanh", "rnn_relu"])
+def test_fused_matches_unfused(mode):
+    T, N, I, H = 5, 3, 4, 6
+    rs = np.random.RandomState(42)
+    x = rs.uniform(-1, 1, (N, T, I)).astype("float32")
+    nparam = rnn_param_size(1, I, H, False, mode)
+    blob = rs.uniform(-0.5, 0.5, (nparam,)).astype("float32")
+
+    fused = rnn.FusedRNNCell(H, num_layers=1, mode=mode, prefix="%s_" % mode)
+    data = sym.Variable("data")
+    fout, _ = fused.unroll(T, inputs=data, layout="NTC", merge_outputs=True)
+    n_states = 2 if mode == "lstm" else 1
+    fargs = {"data": x, "%s_parameters" % mode: blob}
+    for i in range(n_states):
+        fargs["%s_begin_state_%d" % (mode, i)] = np.zeros((1, N, H), "float32")
+    fres = _bind_and_run(fout, fargs)[0]
+
+    unfused = fused.unfuse()
+    uout_list, _ = unfused.unroll(T, inputs=sym.Variable("data"), layout="NTC")
+    uout = sym.Group(uout_list)
+    weights = fused.unpack_weights({"%s_parameters" % mode: mx.nd.array(blob)})
+    uargs = {"data": x}
+    for k, v in weights.items():
+        uargs[k] = v.asnumpy()
+    for i in range(n_states):
+        uargs["%s_l0_begin_state_%d" % (mode, i)] = np.zeros((N, H), "float32")
+    ures = _bind_and_run(uout, uargs)
+    stacked = np.stack(ures, axis=1)  # (N, T, H)
+    np.testing.assert_allclose(fres, stacked, rtol=1e-4, atol=1e-5)
+
+
+def test_pack_unpack_roundtrip():
+    I, H = 4, 6
+    fused = rnn.FusedRNNCell(H, num_layers=2, mode="lstm", prefix="lstm_")
+    nparam = rnn_param_size(2, I, H, False, "lstm")
+    blob = np.arange(nparam, dtype="float32")
+    unpacked = fused.unpack_weights({"lstm_parameters": mx.nd.array(blob)})
+    assert "lstm_l0_i2h_weight" in unpacked and "lstm_l1_h2h_bias" in unpacked
+    packed = fused.pack_weights(unpacked)
+    np.testing.assert_array_equal(packed["lstm_parameters"].asnumpy(), blob)
+
+
+def test_lstm_cell_unroll_shapes():
+    cell = rnn.LSTMCell(16, prefix="c_")
+    outs, states = cell.unroll(3, input_prefix="c_")
+    out = sym.Group(outs)
+    shapes = {"c_t%d_data" % i: (2, 8) for i in range(3)}
+    shapes.update({"c_begin_state_0": (2, 16), "c_begin_state_1": (2, 16)})
+    _, out_shapes, _ = out.infer_shape(**shapes)
+    assert out_shapes == [(2, 16)] * 3
+
+
+def test_sequential_stack():
+    stack = rnn.SequentialRNNCell()
+    stack.add(rnn.LSTMCell(8, prefix="l0_"))
+    stack.add(rnn.LSTMCell(8, prefix="l1_"))
+    outs, states = stack.unroll(2, input_prefix="s_")
+    assert len(outs) == 2 and len(states) == 4
+
+
+def test_bidirectional_unroll():
+    cell = rnn.BidirectionalCell(
+        rnn.LSTMCell(4, prefix="l_"), rnn.LSTMCell(4, prefix="r_"))
+    data = sym.Variable("data")
+    outs, states = cell.unroll(3, inputs=data, layout="NTC")
+    out = sym.Group(outs)
+    shapes = {"data": (2, 3, 5)}
+    for p in ("l_", "r_"):
+        shapes["%sbegin_state_0" % p] = (2, 4)
+        shapes["%sbegin_state_1" % p] = (2, 4)
+    _, out_shapes, _ = out.infer_shape(**shapes)
+    assert out_shapes == [(2, 8)] * 3  # fwd+bwd concat
+
+
+def test_residual_cell():
+    cell = rnn.ResidualCell(rnn.RNNCell(4, prefix="rc_"))
+    data = sym.Variable("data")
+    outs, _ = cell.unroll(2, inputs=data, layout="NTC")
+    _, out_shapes, _ = sym.Group(outs).infer_shape(
+        data=(2, 2, 4), rc_begin_state_0=(2, 4))
+    assert out_shapes == [(2, 4)] * 2
+
+
+def test_bucket_sentence_iter():
+    rs = np.random.RandomState(0)
+    sentences = [list(rs.randint(1, 50, rs.randint(2, 12))) for _ in range(100)]
+    it = rnn.BucketSentenceIter(sentences, batch_size=4, buckets=[4, 8, 12],
+                                invalid_label=0)
+    n = 0
+    for batch in it:
+        n += 1
+        assert batch.bucket_key in (4, 8, 12)
+        d = batch.data[0].asnumpy()
+        l = batch.label[0].asnumpy()
+        assert d.shape == (4, batch.bucket_key)
+        # label is data shifted by one step
+        np.testing.assert_array_equal(l[:, :-1], d[:, 1:])
+    assert n > 0
+    it.reset()
+    assert sum(1 for _ in it) == n
