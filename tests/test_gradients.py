@@ -1,0 +1,270 @@
+"""Gradient oracles: finite differences + closed-form loss backwards.
+
+The reference's test_operator.py validates nearly every op with
+check_numeric_gradient; this suite does the same for the TPU build, with
+explicit closed-form checks for the custom-vjp loss ops (whose one job is
+their backward — SoftmaxOutput's p−y, regression deltas, MakeLoss's
+grad-scale), plus bf16 forward tolerance.
+"""
+import numpy as np
+import pytest
+
+import mxnet_tpu as mx
+from mxnet_tpu import symbol as sym
+from mxnet_tpu import test_utils as tu
+
+
+def _rs():
+    return np.random.RandomState(7)
+
+
+# ------------------------------------------------------------ loss backwards
+def test_softmax_output_backward_is_p_minus_y():
+    rs = _rs()
+    x = rs.uniform(-1, 1, (4, 5)).astype("float32")
+    label = np.array([0, 2, 1, 4], dtype="float32")
+    data = sym.Variable("data")
+    lab = sym.Variable("label")
+    out = sym.SoftmaxOutput(data=data, label=lab, name="sm")
+    e = np.exp(x - x.max(axis=1, keepdims=True))
+    p = e / e.sum(axis=1, keepdims=True)
+    y = np.eye(5, dtype="float32")[label.astype(int)]
+    expected = (p - y) / 4.0 * 4.0  # grad_scale=1, no normalization → p-y
+    tu.check_symbolic_backward(
+        out, {"data": x, "label": label}, [np.ones((4, 5), "float32")],
+        {"data": p - y}, check_eps=1e-4)
+
+
+def test_softmax_output_ignores_head_gradient():
+    rs = _rs()
+    x = rs.uniform(-1, 1, (3, 4)).astype("float32")
+    label = np.array([1, 0, 3], dtype="float32")
+    out = sym.SoftmaxOutput(data=sym.Variable("data"), label=sym.Variable("label"))
+    g1 = tu.check_symbolic_backward(out, {"data": x, "label": label},
+                                    [np.ones((3, 4), "float32")], {})
+    g2 = tu.check_symbolic_backward(out, {"data": x, "label": label},
+                                    [np.full((3, 4), 123.0, "float32")], {})
+    np.testing.assert_allclose(g1["data"], g2["data"], rtol=1e-6)
+
+
+def test_linear_regression_backward():
+    rs = _rs()
+    x = rs.uniform(-1, 1, (6, 3)).astype("float32")
+    y = rs.uniform(-1, 1, (6, 3)).astype("float32")
+    out = sym.LinearRegressionOutput(data=sym.Variable("data"), label=sym.Variable("label"))
+    # reference regression_output-inl.h divides by per-sample output count
+    tu.check_symbolic_backward(
+        out, {"data": x, "label": y}, [np.ones((6, 3), "float32")],
+        {"data": (x - y) / 3.0}, check_eps=1e-4)
+
+
+def test_logistic_regression_backward():
+    rs = _rs()
+    x = rs.uniform(-1, 1, (5, 2)).astype("float32")
+    y = rs.randint(0, 2, (5, 2)).astype("float32")
+    out = sym.LogisticRegressionOutput(data=sym.Variable("data"), label=sym.Variable("label"))
+    p = 1 / (1 + np.exp(-x))
+    tu.check_symbolic_backward(
+        out, {"data": x, "label": y}, [np.ones((5, 2), "float32")],
+        {"data": (p - y) / 2.0}, check_eps=1e-4)
+
+
+def test_mae_regression_backward():
+    rs = _rs()
+    x = rs.uniform(-1, 1, (4, 3)).astype("float32")
+    y = rs.uniform(-1, 1, (4, 3)).astype("float32")
+    out = sym.MAERegressionOutput(data=sym.Variable("data"), label=sym.Variable("label"))
+    tu.check_symbolic_backward(
+        out, {"data": x, "label": y}, [np.ones((4, 3), "float32")],
+        {"data": np.sign(x - y) / 3.0}, check_eps=1e-4)
+
+
+def test_make_loss_grad_scale():
+    rs = _rs()
+    x = rs.uniform(0.1, 1, (3, 3)).astype("float32")
+    out = sym.MakeLoss(data=sym.Variable("data"), grad_scale=2.5)
+    tu.check_symbolic_backward(
+        out, {"data": x}, [np.ones((3, 3), "float32")],
+        {"data": np.full((3, 3), 2.5, "float32")}, check_eps=1e-5)
+
+
+def test_block_grad_stops_gradient():
+    rs = _rs()
+    x = rs.uniform(-1, 1, (3, 3)).astype("float32")
+    d = sym.Variable("data")
+    out = sym.BlockGrad(d * 2.0)
+    g = tu.check_symbolic_backward(out, {"data": x},
+                                   [np.ones((3, 3), "float32")], {})
+    np.testing.assert_allclose(g["data"], np.zeros((3, 3)), atol=1e-7)
+
+
+def test_svm_output_backward_finite():
+    rs = _rs()
+    x = rs.uniform(-1, 1, (4, 3)).astype("float32")
+    label = np.array([0, 1, 2, 1], dtype="float32")
+    out = sym.SVMOutput(data=sym.Variable("data"), label=sym.Variable("label"))
+    g = tu.check_symbolic_backward(out, {"data": x, "label": label},
+                                   [np.ones((4, 3), "float32")], {})
+    assert np.isfinite(g["data"]).all() and np.abs(g["data"]).sum() > 0
+
+
+# ------------------------------------------------------- numeric grad checks
+_UNARY_CASES = [
+    ("exp", lambda d: sym.exp(d), 0.5),
+    ("log", lambda d: sym.log(d + 3.0), 0.5),
+    ("sqrt", lambda d: sym.sqrt(d + 3.0), 0.5),
+    ("tanh", lambda d: sym.tanh(d), 0.5),
+    ("sigmoid", lambda d: sym.sigmoid(d), 0.5),
+    ("square", lambda d: sym.square(d), 0.5),
+    ("relu_act", lambda d: sym.Activation(d, act_type="relu"), 0.6),
+    ("softrelu", lambda d: sym.Activation(d, act_type="softrelu"), 0.5),
+    ("negative", lambda d: -d, 0.5),
+    ("sin", lambda d: sym.sin(d), 0.8),
+    ("cos", lambda d: sym.cos(d), 0.8),
+    ("abs", lambda d: sym.abs(d + 1.7), 0.5),
+]
+
+
+@pytest.mark.parametrize("name,builder,scale", _UNARY_CASES)
+def test_unary_numeric_gradient(name, builder, scale):
+    rs = _rs()
+    x = rs.uniform(-scale, scale, (3, 4)).astype("float32")
+    # keep finite differences away from kinks (relu/abs at 0)
+    x = np.where(np.abs(x) < 0.05, 0.1, x).astype("float32")
+    tu.check_numeric_gradient(builder(sym.Variable("data")), {"data": x})
+
+
+_BINARY_CASES = [
+    ("add", lambda a, b: a + b),
+    ("sub", lambda a, b: a - b),
+    ("mul", lambda a, b: a * b),
+    ("div", lambda a, b: a / (b + 2.0)),
+    ("broadcast_add", lambda a, b: sym.broadcast_add(a, b)),
+    ("broadcast_mul", lambda a, b: sym.broadcast_mul(a, b)),
+    ("maximum", lambda a, b: sym.maximum(a, b)),
+]
+
+
+@pytest.mark.parametrize("name,builder", _BINARY_CASES)
+def test_binary_numeric_gradient(name, builder):
+    rs = _rs()
+    a = rs.uniform(-1, 1, (3, 4)).astype("float32")
+    b = rs.uniform(-1, 1, (3, 4)).astype("float32") + 0.1
+    out = builder(sym.Variable("a"), sym.Variable("b"))
+    tu.check_numeric_gradient(out, {"a": a, "b": b})
+
+
+def test_fully_connected_numeric_gradient():
+    rs = _rs()
+    out = sym.FullyConnected(data=sym.Variable("data"), num_hidden=3, name="fc")
+    loc = {
+        "data": rs.uniform(-1, 1, (2, 4)).astype("float32"),
+        "fc_weight": rs.uniform(-1, 1, (3, 4)).astype("float32"),
+        "fc_bias": rs.uniform(-1, 1, (3,)).astype("float32"),
+    }
+    tu.check_numeric_gradient(out, loc)
+
+
+def test_conv_numeric_gradient():
+    rs = _rs()
+    out = sym.Convolution(data=sym.Variable("data"), kernel=(3, 3), num_filter=2,
+                          pad=(1, 1), name="c")
+    loc = {
+        "data": rs.uniform(-1, 1, (1, 2, 5, 5)).astype("float32"),
+        "c_weight": rs.uniform(-0.5, 0.5, (2, 2, 3, 3)).astype("float32"),
+        "c_bias": rs.uniform(-0.5, 0.5, (2,)).astype("float32"),
+    }
+    tu.check_numeric_gradient(out, loc, numeric_eps=1e-3, check_eps=2e-2)
+
+
+def test_pooling_numeric_gradient():
+    rs = _rs()
+    out = sym.Pooling(data=sym.Variable("data"), kernel=(2, 2), stride=(2, 2),
+                      pool_type="avg")
+    x = rs.uniform(-1, 1, (1, 2, 4, 4)).astype("float32")
+    tu.check_numeric_gradient(out, {"data": x})
+
+
+def test_dot_numeric_gradient():
+    rs = _rs()
+    out = sym.dot(sym.Variable("a"), sym.Variable("b"))
+    loc = {"a": rs.uniform(-1, 1, (3, 4)).astype("float32"),
+           "b": rs.uniform(-1, 1, (4, 2)).astype("float32")}
+    tu.check_numeric_gradient(out, loc)
+
+
+def test_reductions_numeric_gradient():
+    rs = _rs()
+    x = rs.uniform(-1, 1, (3, 4)).astype("float32")
+    for builder in (lambda d: sym.sum(d, axis=1), lambda d: sym.mean(d),
+                    lambda d: sym.sum(d, axis=(0, 1), keepdims=True)):
+        tu.check_numeric_gradient(builder(sym.Variable("data")), {"data": x})
+
+
+def test_reshape_transpose_numeric_gradient():
+    rs = _rs()
+    x = rs.uniform(-1, 1, (2, 6)).astype("float32")
+    tu.check_numeric_gradient(sym.Reshape(sym.Variable("data"), shape=(3, 4)), {"data": x})
+    tu.check_numeric_gradient(sym.transpose(sym.Variable("data")), {"data": x})
+
+
+def test_concat_slice_numeric_gradient():
+    rs = _rs()
+    a = rs.uniform(-1, 1, (2, 3)).astype("float32")
+    b = rs.uniform(-1, 1, (2, 3)).astype("float32")
+    out = sym.Concat(sym.Variable("a"), sym.Variable("b"), dim=1, num_args=2)
+    tu.check_numeric_gradient(out, {"a": a, "b": b})
+    parts = sym.SliceChannel(sym.Variable("a"), num_outputs=3, axis=1)
+    tu.check_numeric_gradient(sym.Group(list(parts)), {"a": a})
+
+
+def test_batchnorm_numeric_gradient():
+    rs = _rs()
+    # square the output: the sum of BN outputs is ~constant in the inputs
+    # (normalization), which would make the check vacuous
+    out = sym.square(sym.BatchNorm(data=sym.Variable("data"), fix_gamma=False, name="bn"))
+    loc = {"data": rs.uniform(-1, 1, (4, 3)).astype("float32"),
+           "bn_gamma": rs.uniform(0.5, 1.5, (3,)).astype("float32"),
+           "bn_beta": rs.uniform(-0.5, 0.5, (3,)).astype("float32")}
+    aux = {"bn_moving_mean": np.zeros((3,), "float32"),
+           "bn_moving_var": np.ones((3,), "float32")}
+    tu.check_numeric_gradient(out, loc, aux_states=aux, numeric_eps=1e-3, check_eps=3e-2)
+
+
+def test_embedding_take_gradient():
+    rs = _rs()
+    emb = sym.Embedding(data=sym.Variable("idx"), input_dim=7, output_dim=3, name="e")
+    idx = np.array([[0, 2], [5, 1]], dtype="int32")
+    w = rs.uniform(-1, 1, (7, 3)).astype("float32")
+    g = tu.check_symbolic_backward(
+        emb, {"idx": idx, "e_weight": w}, [np.ones((2, 2, 3), "float32")], {})
+    expected = np.zeros((7, 3), "float32")
+    for i in idx.ravel():
+        expected[i] += 1
+    np.testing.assert_allclose(g["e_weight"], expected, rtol=1e-5)
+
+
+# ---------------------------------------------------------------- bf16 paths
+def test_bf16_forward_consistency_fc():
+    rs = _rs()
+    out = sym.FullyConnected(data=sym.Variable("data"), num_hidden=8, name="fc")
+    loc = {"data": rs.uniform(-1, 1, (4, 16)).astype("float32"),
+           "fc_weight": rs.uniform(-1, 1, (8, 16)).astype("float32"),
+           "fc_bias": rs.uniform(-1, 1, (8,)).astype("float32")}
+    tu.check_consistency(out, loc, dtypes=("float32", "bfloat16"))
+
+
+def test_bf16_forward_consistency_conv():
+    rs = _rs()
+    out = sym.Convolution(data=sym.Variable("data"), kernel=(3, 3), num_filter=4,
+                          no_bias=True, name="c")
+    loc = {"data": rs.uniform(-1, 1, (2, 3, 8, 8)).astype("float32"),
+           "c_weight": rs.uniform(-0.3, 0.3, (4, 3, 3, 3)).astype("float32")}
+    tu.check_consistency(out, loc, dtypes=("float32", "bfloat16"))
+
+
+def test_bf16_softmax_consistency():
+    rs = _rs()
+    out = sym.SoftmaxActivation(data=sym.Variable("data"))
+    loc = {"data": rs.uniform(-2, 2, (4, 10)).astype("float32")}
+    tu.check_consistency(out, loc, dtypes=("float32", "bfloat16"))
