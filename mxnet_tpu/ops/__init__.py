@@ -18,6 +18,7 @@ from . import sample  # noqa: F401
 from . import sequence  # noqa: F401
 from . import optimizer_ops  # noqa: F401
 from . import rnn  # noqa: F401
+from . import vision  # noqa: F401
 
 __all__ = [
     "AttrSpec",

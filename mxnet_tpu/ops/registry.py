@@ -73,6 +73,15 @@ class AttrSpec:
             if isinstance(value, (int, np.integer)):
                 return (int(value),)
             return tuple(int(v) for v in value)
+        if t == "ftuple":
+            if isinstance(value, str):
+                s = value.strip().lstrip("([").rstrip(")]")
+                if not s:
+                    return ()
+                return tuple(float(x) for x in s.split(",") if x.strip())
+            if isinstance(value, (int, float, np.floating, np.integer)):
+                return (float(value),)
+            return tuple(float(v) for v in value)
         if t == "dtype":
             from ..base import np_dtype
 

@@ -1,0 +1,548 @@
+"""Vision / detection operators.
+
+Counterparts of the reference's src/operator/{roi_pooling, spatial_transformer,
+grid_generator, bilinear_sampler, crop, correlation}.cc and
+src/operator/contrib/{multibox_prior, multibox_target, multibox_detection,
+proposal, fft, count_sketch}.cc — the op set behind the SSD and RCNN configs.
+
+TPU-first design notes: every op is a static-shaped jnp/lax composition (no
+data-dependent shapes — candidates are masked, not filtered, so XLA can tile);
+ROI pooling uses bin masks over the feature map instead of per-bin scalar
+loops; NMS is a fixed-trip-count ``lax.fori_loop`` over score-sorted slots.
+Differentiable paths (ROIPooling, BilinearSampler, SpatialTransformer, Crop,
+fft) get their gradients from JAX; target-assignment ops (MultiBox*, Proposal)
+are label machinery with no tangent, like the reference's backward-is-zero
+kernels.
+"""
+from __future__ import annotations
+
+import numpy as np
+
+import jax
+import jax.numpy as jnp
+
+from .registry import AttrSpec, register
+
+__all__ = []
+
+
+# ------------------------------------------------------------------ helpers
+def _corner_iou(a, b):
+    """IoU between box sets a (N,4) and b (M,4), corner layout → (N,M)."""
+    ax1, ay1, ax2, ay2 = a[:, 0:1], a[:, 1:2], a[:, 2:3], a[:, 3:4]
+    bx1, by1, bx2, by2 = b[None, :, 0], b[None, :, 1], b[None, :, 2], b[None, :, 3]
+    ix = jnp.maximum(0.0, jnp.minimum(ax2, bx2) - jnp.maximum(ax1, bx1))
+    iy = jnp.maximum(0.0, jnp.minimum(ay2, by2) - jnp.maximum(ay1, by1))
+    inter = ix * iy
+    area_a = jnp.maximum(0.0, ax2 - ax1) * jnp.maximum(0.0, ay2 - ay1)
+    area_b = jnp.maximum(0.0, bx2 - bx1) * jnp.maximum(0.0, by2 - by1)
+    union = area_a + area_b - inter
+    return jnp.where(union > 0, inter / union, 0.0)
+
+
+# --------------------------------------------------------------- ROIPooling
+@register(
+    "ROIPooling",
+    attrs={
+        "pooled_size": AttrSpec("shape", required=True),
+        "spatial_scale": AttrSpec("float", required=True),
+    },
+    input_names=("data", "rois"),
+)
+def _roi_pooling(attrs, data, rois):
+    """Max-pool each ROI onto a fixed grid (reference: roi_pooling.cc).
+    rois: (R, 5) = [batch_index, x1, y1, x2, y2] in image coords."""
+    PH, PW = (int(s) for s in attrs["pooled_size"])
+    scale = attrs["spatial_scale"]
+    N, C, H, W = data.shape
+
+    def one_roi(roi):
+        img = jnp.take(data, roi[0].astype("int32"), axis=0)  # (C,H,W)
+        x1 = jnp.round(roi[1] * scale)
+        y1 = jnp.round(roi[2] * scale)
+        x2 = jnp.round(roi[3] * scale)
+        y2 = jnp.round(roi[4] * scale)
+        roi_h = jnp.maximum(y2 - y1 + 1.0, 1.0)
+        roi_w = jnp.maximum(x2 - x1 + 1.0, 1.0)
+        bin_h = roi_h / PH
+        bin_w = roi_w / PW
+        ph = jnp.arange(PH, dtype=data.dtype)
+        pw = jnp.arange(PW, dtype=data.dtype)
+        hstart = jnp.clip(jnp.floor(ph * bin_h) + y1, 0, H)
+        hend = jnp.clip(jnp.ceil((ph + 1) * bin_h) + y1, 0, H)
+        wstart = jnp.clip(jnp.floor(pw * bin_w) + x1, 0, W)
+        wend = jnp.clip(jnp.ceil((pw + 1) * bin_w) + x1, 0, W)
+        ys = jnp.arange(H, dtype=data.dtype)
+        xs = jnp.arange(W, dtype=data.dtype)
+        my = (ys[None, :] >= hstart[:, None]) & (ys[None, :] < hend[:, None])  # (PH,H)
+        mx = (xs[None, :] >= wstart[:, None]) & (xs[None, :] < wend[:, None])  # (PW,W)
+        mask = my[:, None, :, None] & mx[None, :, None, :]  # (PH,PW,H,W)
+        neg = jnp.asarray(-jnp.inf, data.dtype)
+        big = jnp.where(mask[:, :, None, :, :], img[None, None], neg)
+        out = big.max(axis=(3, 4))  # (PH,PW,C)
+        empty = ~mask.any(axis=(2, 3))
+        out = jnp.where(empty[:, :, None], 0.0, out)
+        return jnp.transpose(out, (2, 0, 1))  # (C,PH,PW)
+
+    return jax.vmap(one_roi)(rois.astype(data.dtype))
+
+
+# --------------------------------------------------------- BilinearSampler
+def _bilinear_sample(data, gx, gy):
+    """Sample data (C,H,W) at normalized grid coords gx,gy ∈ [-1,1] (Ho,Wo),
+    zero outside the boundary (reference: bilinear_sampler.cc)."""
+    C, H, W = data.shape
+    x = (gx + 1.0) * (W - 1) / 2.0
+    y = (gy + 1.0) * (H - 1) / 2.0
+    x0 = jnp.floor(x)
+    y0 = jnp.floor(y)
+    x1 = x0 + 1
+    y1 = y0 + 1
+
+    def gather(yy, xx):
+        yi = jnp.clip(yy, 0, H - 1).astype("int32")
+        xi = jnp.clip(xx, 0, W - 1).astype("int32")
+        valid = (yy >= 0) & (yy <= H - 1) & (xx >= 0) & (xx <= W - 1)
+        vals = data[:, yi, xi]  # (C,Ho,Wo)
+        return jnp.where(valid[None], vals, 0.0)
+
+    wa = (x1 - x) * (y1 - y)
+    wb = (x1 - x) * (y - y0)
+    wc = (x - x0) * (y1 - y)
+    wd = (x - x0) * (y - y0)
+    out = (wa[None] * gather(y0, x0) + wb[None] * gather(y1, x0)
+           + wc[None] * gather(y0, x1) + wd[None] * gather(y1, x1))
+    return out
+
+
+@register("BilinearSampler", attrs={}, input_names=("data", "grid"))
+def _bilinear_sampler(attrs, data, grid):
+    """data (N,C,H,W), grid (N,2,Ho,Wo) with (x,y) in [-1,1]."""
+    return jax.vmap(lambda d, g: _bilinear_sample(d, g[0], g[1]))(data, grid)
+
+
+# ------------------------------------------------------------ GridGenerator
+@register(
+    "GridGenerator",
+    attrs={
+        "transform_type": AttrSpec("str", required=True),
+        "target_shape": AttrSpec("shape", default=(0, 0)),
+    },
+)
+def _grid_generator(attrs, data):
+    """affine: data (N,6) θ → sampling grid (N,2,H,W); warp: data (N,2,H,W)
+    flow → identity + normalized flow (reference: grid_generator.cc)."""
+    tt = attrs["transform_type"]
+    if tt == "affine":
+        H, W = (int(s) for s in attrs["target_shape"])
+        ys, xs = jnp.meshgrid(jnp.linspace(-1, 1, H), jnp.linspace(-1, 1, W), indexing="ij")
+        ones = jnp.ones_like(xs)
+        base = jnp.stack([xs, ys, ones], 0).reshape(3, -1).astype(data.dtype)  # (3,HW)
+        theta = data.reshape(-1, 2, 3)
+        grid = jnp.einsum("nij,jk->nik", theta, base)  # (N,2,HW)
+        return grid.reshape(-1, 2, H, W)
+    if tt == "warp":
+        N, _, H, W = data.shape
+        ys, xs = jnp.meshgrid(jnp.arange(H, dtype=data.dtype),
+                              jnp.arange(W, dtype=data.dtype), indexing="ij")
+        gx = (xs[None] + data[:, 0]) * 2.0 / max(W - 1, 1) - 1.0
+        gy = (ys[None] + data[:, 1]) * 2.0 / max(H - 1, 1) - 1.0
+        return jnp.stack([gx, gy], axis=1)
+    raise ValueError("GridGenerator: unknown transform_type %r" % tt)
+
+
+# -------------------------------------------------------- SpatialTransformer
+@register(
+    "SpatialTransformer",
+    attrs={
+        "target_shape": AttrSpec("shape", required=True),
+        "transform_type": AttrSpec("str", default="affine"),
+        "sampler_type": AttrSpec("str", default="bilinear"),
+    },
+    input_names=("data", "loc"),
+)
+def _spatial_transformer(attrs, data, loc):
+    """Affine grid from loc (N,6) + bilinear sampling of data
+    (reference: spatial_transformer.cc)."""
+    H, W = (int(s) for s in attrs["target_shape"])
+    ys, xs = jnp.meshgrid(jnp.linspace(-1, 1, H), jnp.linspace(-1, 1, W), indexing="ij")
+    ones = jnp.ones_like(xs)
+    base = jnp.stack([xs, ys, ones], 0).reshape(3, -1).astype(data.dtype)
+    theta = loc.reshape(-1, 2, 3)
+    grid = jnp.einsum("nij,jk->nik", theta, base).reshape(-1, 2, H, W)
+    return jax.vmap(lambda d, g: _bilinear_sample(d, g[0], g[1]))(data, grid)
+
+
+# --------------------------------------------------------------------- Crop
+def _crop_names(attrs):
+    return ["data", "crop_like"] if int(attrs.get("num_args", 1)) > 1 else ["data"]
+
+
+@register(
+    "Crop",
+    attrs={
+        "num_args": AttrSpec("int", default=1),
+        "offset": AttrSpec("shape", default=(0, 0)),
+        "h_w": AttrSpec("shape", default=(0, 0)),
+        "center_crop": AttrSpec("bool", default=False),
+    },
+    input_names=_crop_names,
+)
+def _crop(attrs, data, crop_like=None):
+    """Crop data's spatial dims to h_w (or crop_like's) at offset / centered
+    (reference: crop.cc)."""
+    if crop_like is not None:
+        th, tw = crop_like.shape[2], crop_like.shape[3]
+    else:
+        th, tw = (int(s) for s in attrs["h_w"])
+    H, W = data.shape[2], data.shape[3]
+    if attrs["center_crop"]:
+        oy, ox = (H - th) // 2, (W - tw) // 2
+    else:
+        oy, ox = (int(s) for s in attrs["offset"])
+    return data[:, :, oy : oy + th, ox : ox + tw]
+
+
+# ------------------------------------------------------------ MultiBoxPrior
+@register(
+    "_contrib_MultiBoxPrior",
+    attrs={
+        "sizes": AttrSpec("ftuple", default=(1.0,)),
+        "ratios": AttrSpec("ftuple", default=(1.0,)),
+        "clip": AttrSpec("bool", default=False),
+        "steps": AttrSpec("ftuple", default=(-1.0, -1.0)),
+        "offsets": AttrSpec("ftuple", default=(0.5, 0.5)),
+    },
+    aliases=("MultiBoxPrior",),
+)
+def _multibox_prior(attrs, data):
+    """Anchor boxes per feature-map pixel (reference: contrib/multibox_prior.cc).
+    Output (1, H*W*A, 4) corner boxes in [0,1] coords;
+    A = len(sizes) + len(ratios) - 1."""
+    H, W = data.shape[2], data.shape[3]
+    sizes = [float(s) for s in attrs["sizes"]]
+    ratios = [float(r) for r in attrs["ratios"]]
+    step_y, step_x = (float(s) for s in attrs["steps"])
+    off_y, off_x = (float(o) for o in attrs["offsets"])
+    if step_y <= 0:
+        step_y = 1.0 / H
+    if step_x <= 0:
+        step_x = 1.0 / W
+    cy = (jnp.arange(H, dtype=data.dtype) + off_y) * step_y
+    cx = (jnp.arange(W, dtype=data.dtype) + off_x) * step_x
+    cyg, cxg = jnp.meshgrid(cy, cx, indexing="ij")  # (H,W)
+    whs = []
+    for k, s in enumerate(sizes):
+        r = ratios[0]
+        whs.append((s * np.sqrt(r), s / np.sqrt(r)))
+    for r in ratios[1:]:
+        s = sizes[0]
+        whs.append((s * np.sqrt(r), s / np.sqrt(r)))
+    anchors = []
+    for w, h in whs:
+        anchors.append(jnp.stack(
+            [cxg - w / 2, cyg - h / 2, cxg + w / 2, cyg + h / 2], axis=-1))
+    out = jnp.stack(anchors, axis=2).reshape(-1, 4)  # (H*W*A, 4)
+    if attrs["clip"]:
+        out = jnp.clip(out, 0.0, 1.0)
+    return out[None]
+
+
+# ----------------------------------------------------------- MultiBoxTarget
+@register(
+    "_contrib_MultiBoxTarget",
+    attrs={
+        "overlap_threshold": AttrSpec("float", default=0.5),
+        "ignore_label": AttrSpec("float", default=-1.0),
+        "negative_mining_ratio": AttrSpec("float", default=-1.0),
+        "negative_mining_thresh": AttrSpec("float", default=0.5),
+        "minimum_negative_samples": AttrSpec("int", default=0),
+        "variances": AttrSpec("ftuple", default=(0.1, 0.1, 0.2, 0.2)),
+    },
+    input_names=("anchor", "label", "cls_pred"),
+    aliases=("MultiBoxTarget",),
+    num_outputs=3,
+    output_names=("loc_target", "loc_mask", "cls_target"),
+)
+def _multibox_target(attrs, anchor, label, cls_pred):
+    """Assign ground truth to anchors (reference: contrib/multibox_target.cc).
+    anchor (1,N,4); label (B,M,5) rows [cls,x1,y1,x2,y2], cls<0 = pad;
+    cls_pred (B, num_cls+1, N). Outputs: loc_target (B,4N), loc_mask (B,4N),
+    cls_target (B,N) with 0 = background, k+1 = class k."""
+    anchors = anchor[0]  # (N,4)
+    N = anchors.shape[0]
+    v = attrs["variances"]
+    thresh = attrs["overlap_threshold"]
+
+    aw = anchors[:, 2] - anchors[:, 0]
+    ah = anchors[:, 3] - anchors[:, 1]
+    acx = (anchors[:, 0] + anchors[:, 2]) / 2
+    acy = (anchors[:, 1] + anchors[:, 3]) / 2
+
+    def one(lab):
+        valid = lab[:, 0] >= 0  # (M,)
+        gt = lab[:, 1:5]
+        iou = _corner_iou(anchors, gt)  # (N,M)
+        iou = jnp.where(valid[None, :], iou, -1.0)
+        best_gt = jnp.argmax(iou, axis=1)  # (N,)
+        best_iou = jnp.max(iou, axis=1)
+        matched = best_iou >= thresh
+        # force-match: each valid gt claims its best anchor
+        best_anchor = jnp.argmax(iou, axis=0)  # (M,)
+        forced = jnp.zeros((N,), bool).at[best_anchor].set(valid)
+        forced_gt = jnp.zeros((N,), "int32").at[best_anchor].set(
+            jnp.arange(gt.shape[0], dtype="int32"))
+        use_forced = forced
+        gt_idx = jnp.where(use_forced, forced_gt, best_gt)
+        matched = matched | use_forced
+
+        g = gt[gt_idx]  # (N,4)
+        gw = jnp.maximum(g[:, 2] - g[:, 0], 1e-8)
+        gh = jnp.maximum(g[:, 3] - g[:, 1], 1e-8)
+        gcx = (g[:, 0] + g[:, 2]) / 2
+        gcy = (g[:, 1] + g[:, 3]) / 2
+        tx = (gcx - acx) / jnp.maximum(aw, 1e-8) / v[0]
+        ty = (gcy - acy) / jnp.maximum(ah, 1e-8) / v[1]
+        tw = jnp.log(gw / jnp.maximum(aw, 1e-8)) / v[2]
+        th = jnp.log(gh / jnp.maximum(ah, 1e-8)) / v[3]
+        loc_t = jnp.stack([tx, ty, tw, th], axis=1)  # (N,4)
+        loc_t = jnp.where(matched[:, None], loc_t, 0.0)
+        loc_m = jnp.where(matched[:, None], 1.0, 0.0) * jnp.ones((N, 4), anchors.dtype)
+        cls_t = jnp.where(matched, lab[gt_idx, 0] + 1.0, 0.0)
+        return loc_t.reshape(-1), loc_m.reshape(-1), cls_t
+
+    loc_t, loc_m, cls_t = jax.vmap(one)(label)
+    return loc_t, loc_m, cls_t
+
+
+# -------------------------------------------------------- MultiBoxDetection
+def _nms_mask(boxes, scores, keep_init, nms_threshold, topk):
+    """Greedy NMS over score-sorted boxes; returns keep mask (N,) bool.
+    Fixed trip count (topk) so the loop compiles once."""
+    N = boxes.shape[0]
+    order = jnp.argsort(-scores)
+    boxes_s = boxes[order]
+    keep = keep_init[order]
+
+    def body(i, keep):
+        cur_valid = keep[i]
+        iou = _corner_iou(boxes_s[i][None], boxes_s)[0]  # (N,)
+        suppress = (iou > nms_threshold) & (jnp.arange(N) > i) & cur_valid
+        return keep & ~suppress
+
+    keep = jax.lax.fori_loop(0, min(topk, N), body, keep)
+    inv = jnp.zeros((N,), "int32").at[order].set(jnp.arange(N, dtype="int32"))
+    return keep[inv]
+
+
+@register(
+    "_contrib_MultiBoxDetection",
+    attrs={
+        "clip": AttrSpec("bool", default=True),
+        "threshold": AttrSpec("float", default=0.01),
+        "background_id": AttrSpec("int", default=0),
+        "nms_threshold": AttrSpec("float", default=0.5),
+        "force_suppress": AttrSpec("bool", default=False),
+        "variances": AttrSpec("ftuple", default=(0.1, 0.1, 0.2, 0.2)),
+        "nms_topk": AttrSpec("int", default=-1),
+    },
+    input_names=("cls_prob", "loc_pred", "anchor"),
+    aliases=("MultiBoxDetection",),
+)
+def _multibox_detection(attrs, cls_prob, loc_pred, anchor):
+    """Decode + NMS (reference: contrib/multibox_detection.cc).
+    cls_prob (B,num_cls+1,N), loc_pred (B,4N), anchor (1,N,4) →
+    (B,N,6) rows [cls_id, score, x1,y1,x2,y2]; suppressed rows cls_id=-1."""
+    anchors = anchor[0]
+    N = anchors.shape[0]
+    v = attrs["variances"]
+    bg = int(attrs["background_id"])
+    topk = attrs["nms_topk"] if attrs["nms_topk"] > 0 else N
+
+    aw = anchors[:, 2] - anchors[:, 0]
+    ah = anchors[:, 3] - anchors[:, 1]
+    acx = (anchors[:, 0] + anchors[:, 2]) / 2
+    acy = (anchors[:, 1] + anchors[:, 3]) / 2
+
+    def one(probs, loc):
+        loc = loc.reshape(N, 4)
+        cx = loc[:, 0] * v[0] * aw + acx
+        cy = loc[:, 1] * v[1] * ah + acy
+        w = jnp.exp(loc[:, 2] * v[2]) * aw
+        h = jnp.exp(loc[:, 3] * v[3]) * ah
+        boxes = jnp.stack([cx - w / 2, cy - h / 2, cx + w / 2, cy + h / 2], 1)
+        if attrs["clip"]:
+            boxes = jnp.clip(boxes, 0.0, 1.0)
+        # best non-background class per anchor
+        masked = probs.at[bg].set(-1.0)
+        cls_id = jnp.argmax(masked, axis=0)  # (N,)
+        score = jnp.max(masked, axis=0)
+        valid = score > attrs["threshold"]
+        keep = _nms_mask(boxes, jnp.where(valid, score, -1.0), valid,
+                         attrs["nms_threshold"], topk)
+        out_id = jnp.where(keep, cls_id.astype(boxes.dtype) - (1.0 if bg == 0 else 0.0), -1.0)
+        return jnp.concatenate([out_id[:, None], score[:, None], boxes], axis=1)
+
+    return jax.vmap(one)(cls_prob, loc_pred)
+
+
+# ------------------------------------------------------------------ Proposal
+@register(
+    "_contrib_Proposal",
+    attrs={
+        "rpn_pre_nms_top_n": AttrSpec("int", default=6000),
+        "rpn_post_nms_top_n": AttrSpec("int", default=300),
+        "threshold": AttrSpec("float", default=0.7),
+        "rpn_min_size": AttrSpec("int", default=16),
+        "scales": AttrSpec("ftuple", default=(4.0, 8.0, 16.0, 32.0)),
+        "ratios": AttrSpec("ftuple", default=(0.5, 1.0, 2.0)),
+        "feature_stride": AttrSpec("int", default=16),
+        "output_score": AttrSpec("bool", default=False),
+        "iou_loss": AttrSpec("bool", default=False),
+    },
+    input_names=("cls_prob", "bbox_pred", "im_info"),
+    aliases=("Proposal",),
+)
+def _proposal(attrs, cls_prob, bbox_pred, im_info):
+    """RPN proposal generation (reference: contrib/proposal.cc).
+    cls_prob (B, 2A, H, W), bbox_pred (B, 4A, H, W), im_info (B,3)
+    → rois (B*post_nms, 5) [batch_idx, x1,y1,x2,y2]."""
+    B, _, H, W = cls_prob.shape
+    scales = [float(s) for s in attrs["scales"]]
+    ratios = [float(r) for r in attrs["ratios"]]
+    stride = attrs["feature_stride"]
+    A = len(scales) * len(ratios)
+    post_n = int(attrs["rpn_post_nms_top_n"])
+
+    # base anchors centered on stride/2 (generate_anchors convention)
+    base = []
+    cx = cy = (stride - 1) / 2.0
+    for r in ratios:
+        size = stride * stride
+        ws = np.round(np.sqrt(size / r))
+        hs = np.round(ws * r)
+        for s in scales:
+            w, h = ws * s, hs * s
+            base.append([cx - (w - 1) / 2, cy - (h - 1) / 2,
+                         cx + (w - 1) / 2, cy + (h - 1) / 2])
+    base = jnp.asarray(np.array(base, dtype="float32"))  # (A,4)
+    sy = jnp.arange(H, dtype="float32") * stride
+    sx = jnp.arange(W, dtype="float32") * stride
+    syg, sxg = jnp.meshgrid(sy, sx, indexing="ij")
+    shift = jnp.stack([sxg, syg, sxg, syg], axis=-1).reshape(-1, 1, 4)  # (HW,1,4)
+    anchors = (shift + base[None]).reshape(-1, 4)  # (HW*A,4)
+    N = anchors.shape[0]
+
+    def one(probs, deltas, info):
+        scores = probs[A:].reshape(A, H, W).transpose(1, 2, 0).reshape(-1)  # fg scores
+        d = deltas.reshape(A, 4, H, W).transpose(2, 3, 0, 1).reshape(-1, 4)
+        aw = anchors[:, 2] - anchors[:, 0] + 1.0
+        ah = anchors[:, 3] - anchors[:, 1] + 1.0
+        acx = anchors[:, 0] + aw / 2
+        acy = anchors[:, 1] + ah / 2
+        cx = d[:, 0] * aw + acx
+        cy = d[:, 1] * ah + acy
+        w = jnp.exp(jnp.clip(d[:, 2], -10, 10)) * aw
+        h = jnp.exp(jnp.clip(d[:, 3], -10, 10)) * ah
+        boxes = jnp.stack([cx - w / 2, cy - h / 2, cx + w / 2, cy + h / 2], 1)
+        boxes = jnp.stack([
+            jnp.clip(boxes[:, 0], 0, info[1] - 1),
+            jnp.clip(boxes[:, 1], 0, info[0] - 1),
+            jnp.clip(boxes[:, 2], 0, info[1] - 1),
+            jnp.clip(boxes[:, 3], 0, info[0] - 1)], 1)
+        min_size = attrs["rpn_min_size"] * info[2]
+        valid = ((boxes[:, 2] - boxes[:, 0] + 1 >= min_size)
+                 & (boxes[:, 3] - boxes[:, 1] + 1 >= min_size))
+        scores = jnp.where(valid, scores, -1.0)
+        keep = _nms_mask(boxes, scores, valid, attrs["threshold"],
+                         min(int(attrs["rpn_pre_nms_top_n"]), N))
+        scores = jnp.where(keep, scores, -1.0)
+        top_idx = jnp.argsort(-scores)[:post_n]
+        return boxes[top_idx], scores[top_idx]
+
+    boxes, scores = jax.vmap(one)(cls_prob, bbox_pred, im_info)  # (B,post,4)
+    bidx = jnp.repeat(jnp.arange(B, dtype=boxes.dtype), post_n).reshape(B, post_n, 1)
+    rois = jnp.concatenate([bidx, boxes], axis=2).reshape(B * post_n, 5)
+    if attrs["output_score"]:
+        return rois, scores.reshape(B * post_n, 1)
+    return rois
+
+
+# ------------------------------------------------------------------ fft/ifft
+@register("_contrib_fft", attrs={"compute_size": AttrSpec("int", default=128)},
+          aliases=("fft",))
+def _fft(attrs, data):
+    """FFT along the last axis; output interleaves real/imag (…, 2K)
+    (reference: contrib/fft.cc)."""
+    out = jnp.fft.fft(data.astype("complex64"), axis=-1)
+    stacked = jnp.stack([out.real, out.imag], axis=-1)
+    return stacked.reshape(data.shape[:-1] + (2 * data.shape[-1],)).astype(data.dtype)
+
+
+@register("_contrib_ifft", attrs={"compute_size": AttrSpec("int", default=128)},
+          aliases=("ifft",))
+def _ifft(attrs, data):
+    """Inverse of _contrib_fft: input (…, 2K) interleaved → (…, K) real.
+    Matches the reference's unnormalized ifft (contrib/ifft.cc): scaled by K."""
+    K = data.shape[-1] // 2
+    pairs = data.reshape(data.shape[:-1] + (K, 2))
+    z = pairs[..., 0] + 1j * pairs[..., 1]
+    out = jnp.fft.ifft(z.astype("complex64"), axis=-1).real * K
+    return out.astype(data.dtype)
+
+
+# -------------------------------------------------------------- count_sketch
+@register(
+    "_contrib_count_sketch",
+    attrs={"out_dim": AttrSpec("int", required=True),
+           "processing_batch_size": AttrSpec("int", default=32)},
+    input_names=("data", "h", "s"),
+    aliases=("count_sketch",),
+)
+def _count_sketch(attrs, data, h, s):
+    """Count-sketch projection: out[n, h[j]] += s[j]·data[n, j]
+    (reference: contrib/count_sketch.cc)."""
+    out_dim = int(attrs["out_dim"])
+    idx = h.reshape(-1).astype("int32")
+    sign = s.reshape(-1).astype(data.dtype)
+    vals = data * sign[None, :]
+    out = jnp.zeros((data.shape[0], out_dim), data.dtype)
+    return out.at[:, idx].add(vals)
+
+
+# --------------------------------------------------------------- Correlation
+@register(
+    "Correlation",
+    attrs={
+        "kernel_size": AttrSpec("int", default=1),
+        "max_displacement": AttrSpec("int", default=1),
+        "stride1": AttrSpec("int", default=1),
+        "stride2": AttrSpec("int", default=1),
+        "pad_size": AttrSpec("int", default=0),
+        "is_multiply": AttrSpec("bool", default=True),
+    },
+    input_names=("data1", "data2"),
+)
+def _correlation(attrs, data1, data2):
+    """FlowNet correlation layer (reference: correlation.cc). For each
+    displacement (dy,dx) in the neighborhood, mean over channels of
+    data1·shift(data2) (or |data1−shift|, is_multiply=False)."""
+    md = int(attrs["max_displacement"])
+    s2 = int(attrs["stride2"])
+    pad = int(attrs["pad_size"])
+    N, C, H, W = data1.shape
+    p1 = jnp.pad(data1, ((0, 0), (0, 0), (pad, pad), (pad, pad)))
+    p2 = jnp.pad(data2, ((0, 0), (0, 0), (pad, pad), (pad, pad)))
+    disp = range(-md, md + 1, s2)
+    outs = []
+    Hp, Wp = H + 2 * pad, W + 2 * pad
+    for dy in disp:
+        for dx in disp:
+            shifted = jnp.roll(p2, shift=(-dy, -dx), axis=(2, 3))
+            if attrs["is_multiply"]:
+                prod = (p1 * shifted).mean(axis=1)
+            else:
+                prod = jnp.abs(p1 - shifted).mean(axis=1)
+            outs.append(prod)
+    out = jnp.stack(outs, axis=1)  # (N, D*D, Hp, Wp)
+    return out[:, :, pad : Hp - pad, pad : Wp - pad] if pad else out

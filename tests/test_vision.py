@@ -1,0 +1,181 @@
+"""Vision/detection ops vs numpy oracles (reference coverage:
+test_operator.py ROIPooling/BilinearSampler/SpatialTransformer sections and
+the SSD MultiBox pipeline)."""
+import numpy as np
+import pytest
+
+import mxnet_tpu as mx
+from mxnet_tpu import symbol as sym
+
+
+def _run(out_sym, args, aux=None):
+    exe = mx.executor.bind(out_sym, mx.cpu(),
+                           {k: mx.nd.array(v) for k, v in args.items()},
+                           args_grad=None, grad_req="null", aux_states=aux or {})
+    return [o.asnumpy() for o in exe.forward(is_train=False)]
+
+
+def test_roi_pooling_identity_roi():
+    # ROI covering the whole 4x4 image, pooled to 2x2 → max of each quadrant
+    data = np.arange(16, dtype="float32").reshape(1, 1, 4, 4)
+    rois = np.array([[0, 0, 0, 3, 3]], dtype="float32")
+    out = mx.nd.ROIPooling(mx.nd.array(data), mx.nd.array(rois),
+                           pooled_size=(2, 2), spatial_scale=1.0).asnumpy()
+    expected = np.array([[[[5, 7], [13, 15]]]], dtype="float32")
+    np.testing.assert_array_equal(out, expected)
+
+
+def test_roi_pooling_spatial_scale():
+    data = np.random.rand(1, 2, 8, 8).astype("float32")
+    rois = np.array([[0, 0, 0, 15, 15]], dtype="float32")  # scale .5 → full map
+    out = mx.nd.ROIPooling(mx.nd.array(data), mx.nd.array(rois),
+                           pooled_size=(1, 1), spatial_scale=0.5).asnumpy()
+    np.testing.assert_allclose(out[0, :, 0, 0], data[0].max(axis=(1, 2)), rtol=1e-6)
+
+
+def test_bilinear_sampler_identity_grid():
+    data = np.random.rand(2, 3, 5, 6).astype("float32")
+    H, W = 5, 6
+    ys, xs = np.meshgrid(np.linspace(-1, 1, H), np.linspace(-1, 1, W), indexing="ij")
+    grid = np.stack([xs, ys], 0)[None].repeat(2, axis=0).astype("float32")
+    out = mx.nd.BilinearSampler(mx.nd.array(data), mx.nd.array(grid)).asnumpy()
+    np.testing.assert_allclose(out, data, rtol=1e-5, atol=1e-6)
+
+
+def test_spatial_transformer_identity_theta():
+    data = np.random.rand(1, 2, 4, 4).astype("float32")
+    theta = np.array([[1, 0, 0, 0, 1, 0]], dtype="float32")
+    out = mx.nd.SpatialTransformer(mx.nd.array(data), mx.nd.array(theta),
+                                   target_shape=(4, 4)).asnumpy()
+    np.testing.assert_allclose(out, data, rtol=1e-5, atol=1e-6)
+
+
+def test_grid_generator_affine_identity():
+    theta = np.array([[1, 0, 0, 0, 1, 0]], dtype="float32")
+    grid = mx.nd.GridGenerator(mx.nd.array(theta), transform_type="affine",
+                               target_shape=(3, 3)).asnumpy()
+    assert grid.shape == (1, 2, 3, 3)
+    np.testing.assert_allclose(grid[0, 0, 0], [-1, 0, 1], atol=1e-6)  # x row
+    np.testing.assert_allclose(grid[0, 1, :, 0], [-1, 0, 1], atol=1e-6)  # y col
+
+
+def test_crop():
+    data = np.arange(36, dtype="float32").reshape(1, 1, 6, 6)
+    out = mx.nd.Crop(mx.nd.array(data), offset=(1, 2), h_w=(3, 3)).asnumpy()
+    np.testing.assert_array_equal(out[0, 0], data[0, 0, 1:4, 2:5])
+    out_c = mx.nd.Crop(mx.nd.array(data), h_w=(2, 2), center_crop=True).asnumpy()
+    np.testing.assert_array_equal(out_c[0, 0], data[0, 0, 2:4, 2:4])
+
+
+def test_multibox_prior():
+    data = np.zeros((1, 3, 2, 2), dtype="float32")
+    anchors = mx.nd.MultiBoxPrior(mx.nd.array(data), sizes=(0.5,),
+                                  ratios=(1.0, 2.0)).asnumpy()
+    assert anchors.shape == (1, 2 * 2 * 2, 4)
+    # first anchor: center (0.25, 0.25), size 0.5 ratio 1 → square
+    np.testing.assert_allclose(anchors[0, 0], [0.0, 0.0, 0.5, 0.5], atol=1e-6)
+    # ratio-2 anchor is wider than tall
+    a1 = anchors[0, 1]
+    assert (a1[2] - a1[0]) > (a1[3] - a1[1])
+
+
+def test_multibox_target_matches_gt():
+    anchors = np.array([[[0.0, 0.0, 0.5, 0.5], [0.5, 0.5, 1.0, 1.0]]], dtype="float32")
+    # gt overlapping the first anchor exactly, class 0
+    label = np.array([[[0, 0.0, 0.0, 0.5, 0.5]]], dtype="float32")
+    cls_pred = np.zeros((1, 2, 2), dtype="float32")
+    loc_t, loc_m, cls_t = mx.nd.MultiBoxTarget(
+        mx.nd.array(anchors), mx.nd.array(label), mx.nd.array(cls_pred))
+    cls_t = cls_t.asnumpy()
+    loc_m = loc_m.asnumpy()
+    assert cls_t[0, 0] == 1.0 and cls_t[0, 1] == 0.0  # class0 → target 1, bg 0
+    assert loc_m[0, :4].sum() == 4 and loc_m[0, 4:].sum() == 0
+    # exact match → zero offsets
+    np.testing.assert_allclose(loc_t.asnumpy()[0, :4], 0.0, atol=1e-5)
+
+
+def test_multibox_detection_decodes_and_nms():
+    anchors = np.array([[[0.1, 0.1, 0.4, 0.4],
+                         [0.12, 0.1, 0.42, 0.4],
+                         [0.6, 0.6, 0.9, 0.9]]], dtype="float32")
+    # class probs: [background; class0] — anchors 0,1 confident class0
+    cls_prob = np.array([[[0.1, 0.2, 0.9], [0.9, 0.8, 0.1]]], dtype="float32")
+    loc_pred = np.zeros((1, 12), dtype="float32")
+    out = mx.nd.MultiBoxDetection(mx.nd.array(cls_prob), mx.nd.array(loc_pred),
+                                  mx.nd.array(anchors), nms_threshold=0.5,
+                                  threshold=0.5).asnumpy()
+    assert out.shape == (1, 3, 6)
+    ids = out[0, :, 0]
+    # one of the two overlapping anchors suppressed; far anchor under threshold
+    assert (ids >= 0).sum() == 1
+    assert ids[0] == 0.0 and out[0, 0, 1] == pytest.approx(0.9)
+
+
+def test_proposal_shapes():
+    B, A, H, W = 1, 12, 4, 4  # 4 scales x 3 ratios
+    cls_prob = np.random.rand(B, 2 * A, H, W).astype("float32")
+    bbox_pred = (np.random.rand(B, 4 * A, H, W).astype("float32") - 0.5) * 0.1
+    im_info = np.array([[64, 64, 1.0]], dtype="float32")
+    rois = mx.nd.Proposal(mx.nd.array(cls_prob), mx.nd.array(bbox_pred),
+                          mx.nd.array(im_info), feature_stride=16,
+                          rpn_post_nms_top_n=8).asnumpy()
+    assert rois.shape == (8, 5)
+    assert (rois[:, 0] == 0).all()
+    assert (rois[:, 1:] >= 0).all() and (rois[:, 1:] <= 64).all()
+
+
+def test_fft_ifft_roundtrip():
+    x = np.random.rand(2, 8).astype("float32")
+    f = mx.nd.fft(mx.nd.array(x))
+    assert f.shape == (2, 16)
+    # oracle: numpy fft interleaved
+    ref = np.fft.fft(x, axis=-1)
+    inter = np.stack([ref.real, ref.imag], -1).reshape(2, 16).astype("float32")
+    np.testing.assert_allclose(f.asnumpy(), inter, rtol=1e-4, atol=1e-4)
+    back = mx.nd.ifft(f).asnumpy() / 8  # reference ifft is unnormalized (×K)
+    np.testing.assert_allclose(back, x, rtol=1e-4, atol=1e-4)
+
+
+def test_count_sketch():
+    data = np.array([[1.0, 2.0, 3.0]], dtype="float32")
+    h = np.array([0, 1, 0], dtype="float32")
+    s = np.array([1, -1, 1], dtype="float32")
+    out = mx.nd.count_sketch(mx.nd.array(data), mx.nd.array(h), mx.nd.array(s),
+                             out_dim=2).asnumpy()
+    np.testing.assert_allclose(out, [[4.0, -2.0]], atol=1e-6)
+
+
+def test_correlation_self_is_mean_square():
+    x = np.random.rand(1, 4, 5, 5).astype("float32")
+    out = mx.nd.Correlation(mx.nd.array(x), mx.nd.array(x),
+                            max_displacement=1).asnumpy()
+    assert out.shape == (1, 9, 5, 5)
+    center = out[0, 4]  # zero displacement channel
+    np.testing.assert_allclose(center, (x[0] ** 2).mean(axis=0), rtol=1e-5)
+
+
+def test_roi_pooling_gradient_flows():
+    from mxnet_tpu import test_utils as tu
+
+    rs = np.random.RandomState(3)
+    data = rs.rand(1, 2, 6, 6).astype("float32")
+    rois = np.array([[0, 0, 0, 5, 5]], dtype="float32")
+    out = sym.ROIPooling(data=sym.Variable("data"), rois=sym.Variable("rois"),
+                         pooled_size=(2, 2), spatial_scale=1.0)
+    g = tu.check_symbolic_backward(out, {"data": data, "rois": rois},
+                                   [np.ones((1, 2, 2, 2), "float32")], {})
+    # max pooling routes each bin's gradient to exactly one input element
+    assert g["data"].sum() == pytest.approx(8.0)
+
+
+def test_bilinear_sampler_gradient():
+    from mxnet_tpu import test_utils as tu
+
+    rs = np.random.RandomState(4)
+    data = rs.rand(1, 1, 4, 4).astype("float32")
+    ys, xs = np.meshgrid(np.linspace(-0.9, 0.9, 4), np.linspace(-0.9, 0.9, 4),
+                         indexing="ij")
+    grid = np.stack([xs, ys], 0)[None].astype("float32")
+    out = sym.BilinearSampler(data=sym.Variable("data"), grid=sym.Variable("grid"))
+    tu.check_numeric_gradient(out, {"data": data, "grid": grid},
+                              numeric_eps=1e-3, check_eps=3e-2)
