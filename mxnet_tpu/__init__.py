@@ -61,6 +61,7 @@ def __getattr__(name):
         "rnn": ".rnn",
         "model": ".model",
         "autograd": ".autograd",
+        "operator": ".operator",
         "parallel": ".parallel",
         "test_utils": ".test_utils",
         "visualization": ".visualization",

@@ -1,0 +1,135 @@
+"""Custom operator: user Python (numpy) code inside the graph.
+
+Counterpart of the reference's Custom op (src/operator/custom/custom.cc +
+python/mxnet/operator.py:396 CustomOp/CustomOpProp/register). The reference
+calls back into Python through C callbacks from the engine thread; here the
+host code is embedded into the traced XLA program with ``jax.pure_callback``
+— so a Custom node composes with jit/vjp like any other op — and its backward
+is wired through ``jax.custom_vjp`` calling the user's ``backward``.
+"""
+from __future__ import annotations
+
+import functools
+
+import numpy as np
+
+import jax
+import jax.numpy as jnp
+
+from ..base import MXNetError
+from .registry import AttrSpec, register
+
+_CUSTOM_PROPS = {}
+
+
+def register_custom(op_type):
+    """Decorator registering a CustomOpProp subclass under ``op_type``
+    (reference: operator.py register)."""
+
+    def wrap(klass):
+        if op_type in _CUSTOM_PROPS:
+            raise MXNetError("custom op %r already registered" % op_type)
+        _CUSTOM_PROPS[op_type] = klass
+        return klass
+
+    return wrap
+
+
+def _instantiate(attrs):
+    op_type = attrs.get("op_type")
+    if op_type not in _CUSTOM_PROPS:
+        raise MXNetError("unknown custom op_type %r" % op_type)
+    kwargs = {k: v for k, v in attrs.items()
+              if k != "op_type" and not (k.startswith("__") and k.endswith("__"))
+              and v is not None}
+    return _CUSTOM_PROPS[op_type](**kwargs)
+
+
+def _custom_input_names(attrs):
+    prop = _instantiate(attrs)
+    return list(prop.list_arguments())
+
+
+def _custom_aux_names(attrs):
+    prop = _instantiate(attrs)
+    return list(prop.list_auxiliary_states())
+
+
+def _custom_num_outputs(attrs):
+    return len(_instantiate(attrs).list_outputs())
+
+
+@register(
+    "Custom",
+    attrs={"op_type": AttrSpec("str", required=True)},
+    input_names=_custom_input_names,
+    aux_names=_custom_aux_names,
+    num_outputs=_custom_num_outputs,
+    needs_train_flag=True,
+)
+def _custom(attrs, inputs, aux, is_train=False):
+    prop = _instantiate(attrs)
+    data, aux = list(inputs), list(aux or [])
+    in_shapes = [list(x.shape) for x in data]
+    _, out_shapes, _ = prop.infer_shape(in_shapes)
+    in_types = [x.dtype for x in data]
+    try:
+        _, out_types, _ = prop.infer_type(in_types)
+    except Exception:
+        out_types = [in_types[0] if in_types else np.float32] * len(out_shapes)
+    out_struct = tuple(jax.ShapeDtypeStruct(tuple(s), np.dtype(t))
+                       for s, t in zip(out_shapes, out_types))
+    op = prop.create_operator(None, in_shapes, in_types)
+    need_top_grad = getattr(prop, "need_top_grad_", True)
+
+    from ..ndarray import array as nd_array
+
+    def host_forward(*arrays):
+        in_nd = [nd_array(np.asarray(a)) for a in arrays[: len(data)]]
+        aux_nd = [nd_array(np.asarray(a)) for a in arrays[len(data):]]
+        out_nd = [nd_array(np.zeros(tuple(s), np.dtype(t)))
+                  for s, t in zip(out_shapes, out_types)]
+        op.forward(is_train=is_train, req=["write"] * len(out_nd),
+                   in_data=in_nd, out_data=out_nd, aux=aux_nd)
+        outs = tuple(o.asnumpy() for o in out_nd)
+        return outs if len(outs) > 1 else outs[0]
+
+    def host_backward(*arrays):
+        k = len(out_struct)
+        ograds = [nd_array(np.asarray(a)) for a in arrays[:k]]
+        in_nd = [nd_array(np.asarray(a)) for a in arrays[k : k + len(data)]]
+        outs_nd = [nd_array(np.asarray(a)) for a in arrays[k + len(data) : k + len(data) + k]]
+        aux_nd = [nd_array(np.asarray(a)) for a in arrays[k + len(data) + k :]]
+        in_grad = [nd_array(np.zeros_like(np.asarray(x.asnumpy()))) for x in in_nd]
+        op.backward(req=["write"] * len(in_grad), out_grad=ograds,
+                    in_data=in_nd, out_data=outs_nd, in_grad=in_grad, aux=aux_nd)
+        grads = tuple(g.asnumpy() for g in in_grad)
+        return grads if len(grads) > 1 else grads[0]
+
+    in_grad_struct = tuple(jax.ShapeDtypeStruct(x.shape, x.dtype) for x in data)
+
+    @jax.custom_vjp
+    def run(data_t, aux_t):
+        res = jax.pure_callback(host_forward, out_struct if len(out_struct) > 1 else out_struct[0],
+                                *data_t, *aux_t, vmap_method="sequential")
+        return res if isinstance(res, tuple) else (res,)
+
+    def run_fwd(data_t, aux_t):
+        outs = run(data_t, aux_t)
+        return outs, (data_t, aux_t, outs)
+
+    def run_bwd(saved, cot):
+        data_t, aux_t, outs = saved
+        grads = jax.pure_callback(
+            host_backward,
+            in_grad_struct if len(in_grad_struct) > 1 else in_grad_struct[0],
+            *cot, *data_t, *outs, *aux_t, vmap_method="sequential")
+        if not isinstance(grads, tuple):
+            grads = (grads,)
+        return (tuple(grads), tuple(jnp.zeros_like(a) for a in aux_t))
+
+    run.defvjp(run_fwd, run_bwd)
+    outs = run(tuple(data), tuple(aux))
+    # aux states pass through unchanged (host-side aux mutation would need a
+    # write-back channel; custom aux is likewise rare in the reference)
+    return tuple(outs), list(aux)

@@ -1,0 +1,89 @@
+"""Custom op API (port of the reference's test_operator.py custom-op tests:
+a python Sigmoid with hand-written backward, used imperatively, symbolically,
+and under autograd)."""
+import numpy as np
+
+import mxnet_tpu as mx
+from mxnet_tpu import operator as op
+from mxnet_tpu import symbol as sym
+
+
+@op.register("test_sigmoid")
+class SigmoidProp(op.CustomOpProp):
+    def __init__(self):
+        super().__init__(need_top_grad=True)
+
+    def create_operator(self, ctx, in_shapes, in_dtypes):
+        return SigmoidOp()
+
+
+class SigmoidOp(op.CustomOp):
+    def forward(self, is_train, req, in_data, out_data, aux):
+        x = in_data[0].asnumpy()
+        self.assign(out_data[0], req[0], 1.0 / (1.0 + np.exp(-x)))
+
+    def backward(self, req, out_grad, in_data, out_data, in_grad, aux):
+        y = out_data[0].asnumpy()
+        g = out_grad[0].asnumpy()
+        self.assign(in_grad[0], req[0], g * y * (1.0 - y))
+
+
+@op.register("test_scale2")
+class Scale2Prop(op.CustomOpProp):
+    def __init__(self, factor="2.0"):
+        super().__init__(need_top_grad=True)
+        self.factor = float(factor)
+
+    def create_operator(self, ctx, in_shapes, in_dtypes):
+        prop = self
+
+        class ScaleOp(op.CustomOp):
+            def forward(self, is_train, req, in_data, out_data, aux):
+                self.assign(out_data[0], req[0], in_data[0].asnumpy() * prop.factor)
+
+            def backward(self, req, out_grad, in_data, out_data, in_grad, aux):
+                self.assign(in_grad[0], req[0], out_grad[0].asnumpy() * prop.factor)
+
+        return ScaleOp()
+
+
+def test_custom_imperative():
+    x = np.random.uniform(-1, 1, (3, 4)).astype("float32")
+    out = mx.nd.Custom(mx.nd.array(x), op_type="test_sigmoid").asnumpy()
+    np.testing.assert_allclose(out, 1 / (1 + np.exp(-x)), rtol=1e-6)
+
+
+def test_custom_attr_passthrough():
+    x = np.random.uniform(-1, 1, (2, 2)).astype("float32")
+    out = mx.nd.Custom(mx.nd.array(x), op_type="test_scale2", factor="3.0").asnumpy()
+    np.testing.assert_allclose(out, 3.0 * x, rtol=1e-6)
+
+
+def test_custom_symbolic_forward_backward():
+    from mxnet_tpu import test_utils as tu
+
+    x = np.random.uniform(-1, 1, (3, 3)).astype("float32")
+    out = sym.Custom(sym.Variable("data"), op_type="test_sigmoid")
+    s = 1 / (1 + np.exp(-x))
+    tu.check_symbolic_forward(out, {"data": x}, [s], check_eps=1e-5)
+    g = np.full((3, 3), 2.0, "float32")
+    tu.check_symbolic_backward(out, {"data": x}, [g],
+                               {"data": g * s * (1 - s)}, check_eps=1e-4)
+
+
+def test_custom_composes_in_graph():
+    x = np.random.uniform(-1, 1, (4, 2)).astype("float32")
+    d = sym.Variable("data")
+    out = sym.sum(sym.Custom(d * 2.0, op_type="test_sigmoid"))
+    from mxnet_tpu import test_utils as tu
+
+    tu.check_numeric_gradient(out, {"data": x}, numeric_eps=1e-3, check_eps=2e-2)
+
+
+def test_custom_under_autograd():
+    from mxnet_tpu import autograd as ag
+
+    x = mx.nd.array(np.random.uniform(-1, 1, (2, 3)).astype("float32"))
+    grads = ag.grad(lambda a: mx.nd.Custom(a, op_type="test_sigmoid"))(x)
+    s = 1 / (1 + np.exp(-x.asnumpy()))
+    np.testing.assert_allclose(grads[0].asnumpy(), s * (1 - s), rtol=1e-5)
