@@ -1,0 +1,67 @@
+"""Monitor: per-batch output statistics (reference: python/mxnet/monitor.py:16).
+
+Installs an executor monitor callback; each ``tic``/``toc`` window collects
+(name, stat) pairs for outputs matching the pattern — the observability layer
+Module.fit wires when ``monitor`` is passed (base_module.py fit)."""
+from __future__ import annotations
+
+import logging
+import re
+from math import sqrt
+
+from .ndarray import NDArray
+
+__all__ = ["Monitor"]
+
+
+class Monitor:
+    def __init__(self, interval, stat_func=None, pattern=".*", sort=False):
+        if stat_func is None:
+            def asum_stat(x):
+                """|x|/size(x), the reference's default stat"""
+                arr = x.asnumpy()
+                return abs(arr).sum() / arr.size
+
+            stat_func = asum_stat
+        self.stat_func = stat_func
+        self.interval = interval
+        self.activated = False
+        self.queue = []
+        self.step = 0
+        self.exes = []
+        self.re_prog = re.compile(pattern)
+        self.sort = sort
+
+    def stat_helper(self, name, arr):
+        if not self.activated or not self.re_prog.match(name):
+            return
+        self.queue.append((self.step, name, self.stat_func(arr)))
+
+    def install(self, exe):
+        """(reference: monitor.py install — executor.set_monitor_callback)"""
+        exe.set_monitor_callback(self.stat_helper)
+        self.exes.append(exe)
+
+    def tic(self):
+        if self.step % self.interval == 0:
+            self.queue = []
+            self.activated = True
+        self.step += 1
+
+    def toc(self):
+        if not self.activated:
+            return []
+        self.activated = False
+        res = []
+        if self.sort:
+            self.queue.sort(key=lambda x: x[1])
+        for n, k, v in self.queue:
+            res.append((n, k, str(v)))
+        self.queue = []
+        return res
+
+    def toc_print(self):
+        res = self.toc()
+        for n, k, v in res:
+            logging.info("Batch: %7d %30s %s", n, k, v)
+        return res

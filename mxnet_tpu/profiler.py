@@ -1,0 +1,58 @@
+"""Profiler (reference: python/mxnet/profiler.py + src/engine/profiler.cc).
+
+The reference hand-stamped per-op start/end times in the engine and emitted
+Chrome trace-event JSON (SURVEY.md §5.1). Here profiling delegates to the JAX
+profiler: ``profiler_set_state('run')`` starts an XLA trace capture (viewable
+in TensorBoard/Perfetto, a superset of the chrome-trace contract) and
+``dump_profile`` finalizes it. The ``mode`` knob maps to the same API names.
+"""
+from __future__ import annotations
+
+import os
+
+from .base import MXNetError
+
+__all__ = ["profiler_set_config", "profiler_set_state", "dump_profile", "State"]
+
+_config = {"mode": "symbolic", "filename": "profile.json"}
+_state = "stop"
+_trace_dir = None
+
+
+class State:
+    stop = "stop"
+    run = "run"
+
+
+def profiler_set_config(mode="symbolic", filename="profile.json"):
+    """(reference: profiler.py profiler_set_config; modes kOnlySymbolic/
+    kAllOperator — with one fused XLA program the distinction collapses)."""
+    if mode not in ("symbolic", "all"):
+        raise MXNetError("profiler mode must be 'symbolic' or 'all'")
+    _config["mode"] = mode
+    _config["filename"] = filename
+
+
+def profiler_set_state(state="stop"):
+    """(reference: profiler.py profiler_set_state)"""
+    global _state, _trace_dir
+    if state not in ("stop", "run"):
+        raise MXNetError("profiler state must be 'stop' or 'run'")
+    import jax
+
+    if state == "run" and _state == "stop":
+        _trace_dir = os.path.join(
+            os.path.dirname(os.path.abspath(_config["filename"])) or ".",
+            "jax_trace")
+        jax.profiler.start_trace(_trace_dir)
+        _state = "run"
+    elif state == "stop" and _state == "run":
+        jax.profiler.stop_trace()
+        _state = "stop"
+
+
+def dump_profile():
+    """Finalize the capture (reference: MXDumpProfile)."""
+    if _state == "run":
+        profiler_set_state("stop")
+    return _trace_dir
