@@ -1,0 +1,190 @@
+// Native IO runtime: RecordIO reader, MNIST idx parser, threaded prefetcher.
+//
+// TPU-native counterpart of the reference's C++ IO stack (src/io/: RecordIO
+// framing via dmlc-core, iter_mnist.cc:241 MNISTIter, iter_prefetcher.h:28
+// PrefetcherIter). The device side needs none of this — PJRT owns transfers —
+// but the host side still wants the file parsing and read-ahead off the
+// Python thread, which is exactly what this library does: a producer thread
+// fills a bounded queue of records while Python consumes them through ctypes.
+//
+// Build: g++ -std=c++17 -O2 -shared -fPIC -pthread io_native.cc -o libmxtpu_io.so
+#include <cstdint>
+#include <cstdio>
+#include <cstdlib>
+#include <cstring>
+#include <condition_variable>
+#include <deque>
+#include <mutex>
+#include <string>
+#include <thread>
+#include <vector>
+
+namespace {
+
+constexpr uint32_t kMagic = 0xced7230a;
+constexpr uint32_t kLenMask = (1u << 29) - 1;
+
+struct Record {
+  char* data;
+  uint64_t size;
+};
+
+// ---------------------------------------------------------------- RecordIO
+struct RecordIOReader {
+  FILE* fp;
+};
+
+bool read_one_record(FILE* fp, Record* out) {
+  uint32_t header[2];
+  if (fread(header, sizeof(uint32_t), 2, fp) != 2) return false;
+  if (header[0] != kMagic) return false;
+  uint64_t n = header[1] & kLenMask;
+  char* buf = static_cast<char*>(malloc(n ? n : 1));
+  if (n && fread(buf, 1, n, fp) != n) {
+    free(buf);
+    return false;
+  }
+  uint64_t pad = (4 - n % 4) % 4;
+  if (pad) fseek(fp, static_cast<long>(pad), SEEK_CUR);
+  out->data = buf;
+  out->size = n;
+  return true;
+}
+
+// --------------------------------------------------------------- Prefetcher
+struct Prefetcher {
+  FILE* fp = nullptr;
+  std::thread worker;
+  std::mutex mu;
+  std::condition_variable cv_put, cv_get;
+  std::deque<Record> queue;
+  size_t capacity = 16;
+  bool eof = false;
+  bool stop = false;
+
+  void run() {
+    Record rec;
+    while (true) {
+      if (!read_one_record(fp, &rec)) break;
+      std::unique_lock<std::mutex> lk(mu);
+      cv_put.wait(lk, [&] { return queue.size() < capacity || stop; });
+      if (stop) {
+        free(rec.data);
+        break;
+      }
+      queue.push_back(rec);
+      cv_get.notify_one();
+    }
+    std::lock_guard<std::mutex> lk(mu);
+    eof = true;
+    cv_get.notify_all();
+  }
+};
+
+}  // namespace
+
+extern "C" {
+
+// ---- plain sequential reader ----
+void* mxio_recordio_open(const char* path) {
+  FILE* fp = fopen(path, "rb");
+  if (!fp) return nullptr;
+  auto* r = new RecordIOReader{fp};
+  return r;
+}
+
+int mxio_recordio_next(void* handle, char** data, uint64_t* size) {
+  auto* r = static_cast<RecordIOReader*>(handle);
+  Record rec;
+  if (!read_one_record(r->fp, &rec)) return 0;
+  *data = rec.data;
+  *size = rec.size;
+  return 1;
+}
+
+void mxio_recordio_close(void* handle) {
+  auto* r = static_cast<RecordIOReader*>(handle);
+  fclose(r->fp);
+  delete r;
+}
+
+// ---- threaded prefetcher ----
+void* mxio_prefetch_open(const char* path, int capacity) {
+  FILE* fp = fopen(path, "rb");
+  if (!fp) return nullptr;
+  auto* p = new Prefetcher();
+  p->fp = fp;
+  if (capacity > 0) p->capacity = static_cast<size_t>(capacity);
+  p->worker = std::thread([p] { p->run(); });
+  return p;
+}
+
+int mxio_prefetch_next(void* handle, char** data, uint64_t* size) {
+  auto* p = static_cast<Prefetcher*>(handle);
+  std::unique_lock<std::mutex> lk(p->mu);
+  p->cv_get.wait(lk, [&] { return !p->queue.empty() || p->eof; });
+  if (p->queue.empty()) return 0;
+  Record rec = p->queue.front();
+  p->queue.pop_front();
+  p->cv_put.notify_one();
+  *data = rec.data;
+  *size = rec.size;
+  return 1;
+}
+
+void mxio_prefetch_close(void* handle) {
+  auto* p = static_cast<Prefetcher*>(handle);
+  {
+    std::lock_guard<std::mutex> lk(p->mu);
+    p->stop = true;
+    p->cv_put.notify_all();
+  }
+  if (p->worker.joinable()) p->worker.join();
+  for (auto& rec : p->queue) free(rec.data);
+  fclose(p->fp);
+  delete p;
+}
+
+void mxio_free(void* ptr) { free(ptr); }
+
+// ---- MNIST idx format (iter_mnist.cc ReadInt/LoadImg layout) ----
+// Returns 1 on success; fills dims[0..ndim) and a malloc'd byte buffer.
+int mxio_idx_read(const char* path, unsigned char** out, uint64_t* size,
+                  int* ndim, int64_t* dims) {
+  FILE* fp = fopen(path, "rb");
+  if (!fp) return 0;
+  unsigned char magic[4];
+  if (fread(magic, 1, 4, fp) != 4 || magic[0] != 0 || magic[1] != 0) {
+    fclose(fp);
+    return 0;
+  }
+  int n = magic[3];
+  if (n > 4) {
+    fclose(fp);
+    return 0;
+  }
+  uint64_t total = 1;
+  for (int i = 0; i < n; ++i) {
+    unsigned char b[4];
+    if (fread(b, 1, 4, fp) != 4) {
+      fclose(fp);
+      return 0;
+    }
+    dims[i] = (int64_t(b[0]) << 24) | (int64_t(b[1]) << 16) |
+              (int64_t(b[2]) << 8) | int64_t(b[3]);
+    total *= static_cast<uint64_t>(dims[i]);
+  }
+  unsigned char* buf = static_cast<unsigned char*>(malloc(total ? total : 1));
+  if (total && fread(buf, 1, total, fp) != total) {
+    free(buf);
+    fclose(fp);
+    return 0;
+  }
+  fclose(fp);
+  *out = buf;
+  *size = total;
+  *ndim = n;
+  return 1;
+}
+
+}  // extern "C"
