@@ -10,7 +10,7 @@ matmuls/convs, and bf16-friendly dtypes threaded via the ``dtype`` argument.
 ``get_symbol`` entry points (e.g. example/image-classification/symbols/
 resnet.py get_symbol).
 """
-from . import lenet, mlp, alexnet, vgg, resnet, inception_bn, lstm
+from . import lenet, mlp, alexnet, vgg, resnet, inception_bn, lstm, transformer
 
 _ZOO = {
     "lenet": lenet.get_symbol,
@@ -28,6 +28,7 @@ _ZOO = {
     "resnet-101": lambda **kw: resnet.get_symbol(num_layers=101, **kw),
     "resnet-152": lambda **kw: resnet.get_symbol(num_layers=152, **kw),
     "lstm": lstm.get_symbol,
+    "transformer": transformer.get_symbol,
 }
 
 

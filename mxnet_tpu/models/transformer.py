@@ -1,0 +1,72 @@
+"""Decoder-only Transformer language model (BASELINE.md stretch config:
+Transformer-base MT; built entirely from Symbol ops with the fused
+MultiHeadAttention op from ops/attention.py).
+
+Pre-norm blocks: x + MHA(LN(x)), x + FFN(LN(x)); LN via the registry's
+LayerNorm-equivalent composition (InstanceNorm is channel-first, so LN here
+is mean/var composed from broadcast ops to stay faithful to the op set)."""
+import numpy as np
+
+from .. import symbol as sym
+
+
+def _layer_norm(x, name, dim):
+    mean = sym.mean(x, axis=-1, keepdims=True)
+    cent = sym.broadcast_sub(x, mean, name="%s_cent" % name)
+    var = sym.mean(sym.square(cent), axis=-1, keepdims=True)
+    inv = sym._rdiv_scalar(sym.sqrt(var + 1e-5), scalar=1.0)
+    normed = sym.broadcast_mul(cent, inv)
+    gamma = sym.Variable("%s_gamma" % name, shape=(dim,))
+    beta = sym.Variable("%s_beta" % name, shape=(dim,))
+    return sym.broadcast_add(sym.broadcast_mul(normed, gamma), beta, name=name)
+
+
+def _attention_block(x, name, num_heads, model_dim, seq_len):
+    dh = model_dim // num_heads
+    qkv = sym.FullyConnected(data=x, num_hidden=3 * model_dim, flatten=False,
+                             name="%s_qkv" % name)
+    qkv = sym.Reshape(qkv, shape=(-1, seq_len, 3, num_heads, dh))
+    q = sym.Reshape(sym.slice_axis(qkv, axis=2, begin=0, end=1),
+                    shape=(-1, seq_len, num_heads, dh))
+    k = sym.Reshape(sym.slice_axis(qkv, axis=2, begin=1, end=2),
+                    shape=(-1, seq_len, num_heads, dh))
+    v = sym.Reshape(sym.slice_axis(qkv, axis=2, begin=2, end=3),
+                    shape=(-1, seq_len, num_heads, dh))
+    # (B,T,H,D) → (B,H,T,D)
+    q = sym.SwapAxis(q, dim1=1, dim2=2)
+    k = sym.SwapAxis(k, dim1=1, dim2=2)
+    v = sym.SwapAxis(v, dim1=1, dim2=2)
+    att = sym.MultiHeadAttention(query=q, key=k, value=v, causal=True,
+                                 name="%s_att" % name)
+    att = sym.SwapAxis(att, dim1=1, dim2=2)  # (B,T,H,D)
+    att = sym.Reshape(att, shape=(-1, seq_len, model_dim))
+    return sym.FullyConnected(data=att, num_hidden=model_dim, flatten=False,
+                              name="%s_proj" % name)
+
+
+def get_symbol(vocab_size=32000, num_layers=6, num_heads=8, model_dim=512,
+               ffn_dim=2048, seq_len=64, **kwargs):
+    data = sym.Variable("data")  # (B, T) int tokens
+    label = sym.Variable("softmax_label")
+    embed = sym.Embedding(data=data, input_dim=vocab_size,
+                          output_dim=model_dim, name="embed")
+    pos = sym.Variable("pos_embed_weight", shape=(seq_len, model_dim))
+    x = sym.broadcast_add(embed, sym.Reshape(pos, shape=(1, seq_len, model_dim)),
+                          name="pos_add")
+    for i in range(num_layers):
+        name = "layer%d" % i
+        a = _attention_block(_layer_norm(x, "%s_ln1" % name, model_dim),
+                             name, num_heads, model_dim, seq_len)
+        x = x + a
+        h = _layer_norm(x, "%s_ln2" % name, model_dim)
+        h = sym.FullyConnected(data=h, num_hidden=ffn_dim, flatten=False,
+                               name="%s_ffn1" % name)
+        h = sym.Activation(h, act_type="relu")
+        h = sym.FullyConnected(data=h, num_hidden=model_dim, flatten=False,
+                               name="%s_ffn2" % name)
+        x = x + h
+    x = _layer_norm(x, "final_ln", model_dim)
+    x = sym.Reshape(x, shape=(-1, model_dim))
+    logits = sym.FullyConnected(data=x, num_hidden=vocab_size, name="lm_head")
+    label_flat = sym.Reshape(label, shape=(-1,))
+    return sym.SoftmaxOutput(data=logits, label=label_flat, name="softmax")

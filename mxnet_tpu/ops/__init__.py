@@ -19,6 +19,7 @@ from . import sequence  # noqa: F401
 from . import optimizer_ops  # noqa: F401
 from . import rnn  # noqa: F401
 from . import vision  # noqa: F401
+from . import attention  # noqa: F401
 from . import custom  # noqa: F401
 
 __all__ = [

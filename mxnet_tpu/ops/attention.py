@@ -1,0 +1,44 @@
+"""Attention operator (the Transformer building block).
+
+The reference (2017 MXNet 0.9.5) predates Transformers; its README's stretch
+config (BASELINE.md Transformer-base MT) needs one. Registered as a single
+fused op rather than a symbol-level composition of batch_dot/softmax so XLA
+sees the whole softmax(QKᵀ)V contraction at once — the same reasoning that
+made the reference wrap cuDNN kernels as one op. The sequence-parallel
+(ring) execution of this op lives in parallel/ring_attention.py.
+"""
+from __future__ import annotations
+
+import numpy as np
+
+import jax
+import jax.numpy as jnp
+
+from .registry import AttrSpec, register
+
+
+@register(
+    "_contrib_MultiHeadAttention",
+    attrs={
+        "causal": AttrSpec("bool", default=False),
+        "scale": AttrSpec("float", default=-1.0),
+    },
+    input_names=("query", "key", "value"),
+    aliases=("MultiHeadAttention",),
+)
+def _multi_head_attention(attrs, query, key, value):
+    """softmax(QKᵀ·scale + mask)V over (B, H, T, D) tensors. Computation in
+    fp32 for a stable softmax regardless of the IO dtype (bf16 fast path)."""
+    d = query.shape[-1]
+    scale = attrs["scale"] if attrs["scale"] > 0 else 1.0 / np.sqrt(d)
+    q = query.astype("float32")
+    k = key.astype("float32")
+    v = value.astype("float32")
+    s = jnp.einsum("bhqd,bhkd->bhqk", q, k) * scale
+    if attrs["causal"]:
+        T, S = s.shape[-2], s.shape[-1]
+        mask = jnp.tril(jnp.ones((T, S), bool))
+        s = jnp.where(mask, s, -jnp.inf)
+    p = jax.nn.softmax(s, axis=-1)
+    out = jnp.einsum("bhqk,bhkd->bhqd", p, v)
+    return out.astype(query.dtype)
