@@ -1,0 +1,63 @@
+"""Inference throughput over the model zoo.
+
+Counterpart of the reference's example/image-classification/benchmark_score.py:
+scores each network on synthetic data across batch sizes and prints img/s.
+Here each network is one compiled XLA executable; the first call per (net,
+batch) pays compilation, so timing starts after warmup.
+
+Usage: python benchmark_score.py [--networks resnet-50,inception-bn] [--batch-sizes 1,32,64]
+"""
+import argparse
+import logging
+import os
+import sys
+import time
+
+import numpy as np
+
+sys.path.insert(0, os.path.dirname(__file__))
+from common import find_mxnet  # noqa: F401
+import mxnet_tpu as mx
+from mxnet_tpu import models
+
+logging.basicConfig(level=logging.INFO)
+
+
+def score(network, batch_size, image_shape=(3, 224, 224), num_batches=10):
+    sym = models.get_symbol(network, num_classes=1000,
+                            image_shape=",".join(str(i) for i in image_shape))
+    data_shape = [("data", (batch_size,) + image_shape)]
+    mod = mx.mod.Module(symbol=sym, label_names=None)
+    mod.bind(for_training=False, data_shapes=data_shape)
+    mod.init_params(initializer=mx.init.Xavier(magnitude=2.0))
+    rs = np.random.RandomState(0)
+    batch = mx.io.DataBatch(
+        data=[mx.nd.array(rs.rand(batch_size, *image_shape).astype(np.float32))],
+        label=None, pad=0)
+    # warmup: compile + settle
+    for _ in range(3):
+        mod.forward(batch, is_train=False)
+    for o in mod.get_outputs():
+        o.wait_to_read()
+    tic = time.time()
+    for _ in range(num_batches):
+        mod.forward(batch, is_train=False)
+    for o in mod.get_outputs():
+        o.wait_to_read()
+    return num_batches * batch_size / (time.time() - tic)
+
+
+if __name__ == "__main__":
+    parser = argparse.ArgumentParser(description="benchmark inference throughput")
+    parser.add_argument("--networks", type=str,
+                        default="alexnet,vgg16,inception-bn,resnet-50")
+    parser.add_argument("--batch-sizes", type=str, default="1,32")
+    parser.add_argument("--image-shape", type=str, default="3,224,224")
+    args = parser.parse_args()
+
+    image_shape = tuple(int(i) for i in args.image_shape.split(","))
+    for net in args.networks.split(","):
+        logging.info("network: %s", net)
+        for b in (int(x) for x in args.batch_sizes.split(",")):
+            speed = score(net, b, image_shape)
+            logging.info("batch size %2d, image/sec: %f", b, speed)

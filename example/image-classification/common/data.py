@@ -1,0 +1,134 @@
+"""Data loaders for the image-classification examples.
+
+Counterpart of the reference's example/image-classification/common/data.py
+(`add_data_args`, `get_rec_iter`) plus its `--benchmark` synthetic mode
+(train_imagenet.py --benchmark 1). Since this environment has no network
+egress, every loader falls back to an in-memory synthetic set with the same
+shapes when the real files are absent — the reference's own benchmark mode
+does exactly this (random data, fixed label) to measure compute throughput.
+"""
+import logging
+import os
+
+import numpy as np
+
+import mxnet_tpu as mx
+
+
+def add_data_args(parser):
+    data = parser.add_argument_group("Data", "the input images")
+    data.add_argument("--data-train", type=str, help="the training data (.rec)")
+    data.add_argument("--data-val", type=str, help="the validation data (.rec)")
+    data.add_argument("--rgb-mean", type=str, default="123.68,116.779,103.939",
+                      help="a tuple of size 3 for the mean rgb")
+    data.add_argument("--pad-size", type=int, default=0, help="padding size")
+    data.add_argument("--image-shape", type=str,
+                      help="the image shape feed into the network, e.g. (3,224,224)")
+    data.add_argument("--num-classes", type=int, help="the number of classes")
+    data.add_argument("--num-examples", type=int, help="the number of training examples")
+    data.add_argument("--data-nthreads", type=int, default=4,
+                      help="number of threads for data decoding")
+    data.add_argument("--benchmark", type=int, default=0,
+                      help="if 1, then feed the network with synthetic data")
+    return data
+
+
+def add_data_aug_args(parser):
+    aug = parser.add_argument_group("Augmentation", "image augmentation")
+    aug.add_argument("--random-crop", type=int, default=1,
+                     help="if or not randomly crop the image")
+    aug.add_argument("--random-mirror", type=int, default=1,
+                     help="if or not randomly flip horizontally")
+    aug.add_argument("--max-random-h", type=int, default=0, help="max change of hue")
+    aug.add_argument("--max-random-s", type=int, default=0, help="max change of saturation")
+    aug.add_argument("--max-random-l", type=int, default=0, help="max change of lightness")
+    aug.add_argument("--max-random-aspect-ratio", type=float, default=0,
+                     help="max change of aspect ratio")
+    aug.add_argument("--max-random-rotate-angle", type=int, default=0,
+                     help="max angle to rotate")
+    aug.add_argument("--max-random-shear-ratio", type=float, default=0,
+                     help="max ratio to shear")
+    aug.add_argument("--max-random-scale", type=float, default=1,
+                     help="max ratio to scale")
+    aug.add_argument("--min-random-scale", type=float, default=1,
+                     help="min ratio to scale")
+    return aug
+
+
+def set_data_aug_level(aug, level):
+    if level >= 1:
+        aug.set_defaults(random_crop=1, random_mirror=1)
+    if level >= 2:
+        aug.set_defaults(max_random_h=36, max_random_s=50, max_random_l=50)
+    if level >= 3:
+        aug.set_defaults(max_random_rotate_angle=10, max_random_shear_ratio=0.1,
+                         max_random_aspect_ratio=0.25)
+
+
+class SyntheticDataIter(mx.io.DataIter):
+    """Random images + labels, generated once and replayed — the reference's
+    `--benchmark 1` feeding strategy (train_imagenet.py)."""
+
+    def __init__(self, num_classes, data_shape, num_batches=50, dtype="float32"):
+        super().__init__(data_shape[0])
+        self.num_batches = num_batches
+        self.cur_batch = 0
+        rs = np.random.RandomState(0)
+        label = rs.randint(0, num_classes, (data_shape[0],)).astype(dtype)
+        data = rs.uniform(-1, 1, data_shape).astype(dtype)
+        self.data = mx.nd.array(data)
+        self.label = mx.nd.array(label)
+        self.provide_data = [mx.io.DataDesc("data", data_shape)]
+        self.provide_label = [mx.io.DataDesc("softmax_label", (data_shape[0],))]
+
+    def next(self):
+        if self.cur_batch >= self.num_batches:
+            raise StopIteration
+        self.cur_batch += 1
+        return mx.io.DataBatch(data=[self.data], label=[self.label], pad=0)
+
+    def reset(self):
+        self.cur_batch = 0
+
+
+def get_rec_iter(args, kv=None):
+    """ImageRecordIter pair over --data-train/--data-val; synthetic fallback
+    when --benchmark 1 or the .rec files are missing (no egress here)."""
+    image_shape = tuple(int(l) for l in args.image_shape.split(","))
+    if "benchmark" in args and args.benchmark:
+        data_shape = (args.batch_size,) + image_shape
+        train = SyntheticDataIter(args.num_classes, data_shape)
+        return (train, None)
+    if not args.data_train or not os.path.exists(args.data_train):
+        logging.warning("training .rec %r not found — using synthetic data "
+                        "(reference --benchmark mode)", args.data_train)
+        data_shape = (args.batch_size,) + image_shape
+        return (SyntheticDataIter(args.num_classes, data_shape), None)
+
+    rank, nworker = (kv.rank, kv.num_workers) if kv else (0, 1)
+    rgb_mean = [float(i) for i in args.rgb_mean.split(",")]
+    train = mx.img.ImageRecordIter(
+        path_imgrec=args.data_train,
+        data_shape=image_shape,
+        batch_size=args.batch_size,
+        shuffle=True,
+        rand_crop=bool(getattr(args, "random_crop", 0)),
+        rand_mirror=bool(getattr(args, "random_mirror", 0)),
+        mean_r=rgb_mean[0], mean_g=rgb_mean[1], mean_b=rgb_mean[2],
+        pad=args.pad_size,
+        num_parts=nworker, part_index=rank,
+        preprocess_threads=args.data_nthreads,
+    )
+    if args.data_val is None or not os.path.exists(args.data_val):
+        return (train, None)
+    val = mx.img.ImageRecordIter(
+        path_imgrec=args.data_val,
+        data_shape=image_shape,
+        batch_size=args.batch_size,
+        shuffle=False,
+        rand_crop=False, rand_mirror=False,
+        mean_r=rgb_mean[0], mean_g=rgb_mean[1], mean_b=rgb_mean[2],
+        num_parts=nworker, part_index=rank,
+        preprocess_threads=args.data_nthreads,
+    )
+    return (train, val)

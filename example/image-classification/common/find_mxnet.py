@@ -1,0 +1,10 @@
+"""Put the in-tree mxnet_tpu package on sys.path (reference:
+example/image-classification/common/find_mxnet.py does the same for mxnet)."""
+import os
+import sys
+
+_ROOT = os.path.abspath(os.path.join(os.path.dirname(__file__), "..", "..", ".."))
+if _ROOT not in sys.path:
+    sys.path.insert(0, _ROOT)
+
+import mxnet_tpu as mx  # noqa: E402,F401

@@ -1,0 +1,50 @@
+"""Train on CIFAR-10.
+
+Counterpart of the reference's example/image-classification/train_cifar10.py:
+same CLI and defaults (resnet-110 class of model on 3x28x28 crops, .rec
+input with synthetic fallback — see common/data.py).
+"""
+import argparse
+import logging
+import os
+import sys
+
+sys.path.insert(0, os.path.dirname(__file__))
+from common import find_mxnet  # noqa: F401
+import mxnet_tpu as mx  # noqa: F401
+from common import data, fit
+
+logging.basicConfig(level=logging.DEBUG)
+
+if __name__ == "__main__":
+    parser = argparse.ArgumentParser(
+        description="train cifar10",
+        formatter_class=argparse.ArgumentDefaultsHelpFormatter)
+    fit.add_fit_args(parser)
+    data.add_data_args(parser)
+    aug = data.add_data_aug_args(parser)
+    data.set_data_aug_level(aug, 1)
+    parser.set_defaults(
+        network="resnet",
+        num_layers=18,
+        num_classes=10,
+        num_examples=50000,
+        image_shape="3,28,28",
+        pad_size=4,
+        batch_size=128,
+        num_epochs=300,
+        lr=0.05,
+        lr_step_epochs="200,250",
+    )
+    args = parser.parse_args()
+
+    from mxnet_tpu import models
+
+    sym = models.get_symbol(
+        args.network,
+        num_classes=args.num_classes,
+        num_layers=args.num_layers,
+        image_shape=args.image_shape,
+    )
+
+    fit.fit(args, sym, data.get_rec_iter)

@@ -1,0 +1,55 @@
+"""Train on ImageNet (config 2 in BASELINE.json).
+
+Counterpart of the reference's example/image-classification/train_imagenet.py:
+same CLI (fit + data + aug args, `--benchmark 1` synthetic mode), feeding an
+ImageRecordIter over .rec packs produced by tools/im2rec.py. On TPU the whole
+fwd+bwd+update step runs as one fused XLA computation per batch; use
+``--kv-store dist_tpu_sync`` for multi-host pods.
+
+Usage:
+    python train_imagenet.py --network resnet --num-layers 50 --benchmark 1
+"""
+import argparse
+import logging
+import os
+import sys
+
+sys.path.insert(0, os.path.dirname(__file__))
+from common import find_mxnet  # noqa: F401
+import mxnet_tpu as mx  # noqa: F401
+from common import data, fit
+
+logging.basicConfig(level=logging.DEBUG)
+
+if __name__ == "__main__":
+    parser = argparse.ArgumentParser(
+        description="train imagenet-1k",
+        formatter_class=argparse.ArgumentDefaultsHelpFormatter)
+    fit.add_fit_args(parser)
+    data.add_data_args(parser)
+    aug = data.add_data_aug_args(parser)
+    data.set_data_aug_level(aug, 2)
+    parser.set_defaults(
+        network="resnet",
+        num_layers=50,
+        num_classes=1000,
+        num_examples=1281167,
+        image_shape="3,224,224",
+        min_random_scale=1,
+        num_epochs=80,
+        lr_step_epochs="30,60",
+        dtype="bfloat16",
+    )
+    args = parser.parse_args()
+
+    from mxnet_tpu import models
+
+    sym = models.get_symbol(
+        args.network,
+        num_classes=args.num_classes,
+        num_layers=args.num_layers,
+        image_shape=args.image_shape,
+        dtype=args.dtype,
+    )
+
+    fit.fit(args, sym, data.get_rec_iter)

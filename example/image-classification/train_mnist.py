@@ -1,0 +1,99 @@
+"""Train on MNIST (config 1 in BASELINE.json).
+
+Counterpart of the reference's example/image-classification/train_mnist.py:
+same CLI, same default mlp network, same NDArrayIter feeding. The reference
+downloads MNIST from the web; here the loader reads local idx files when
+present (``data/train-images-idx3-ubyte`` etc., plain or .gz) and otherwise
+trains on a deterministic synthetic digit set so the script always runs in
+an egress-free environment.
+
+Usage:
+    python train_mnist.py                     # mlp
+    python train_mnist.py --network lenet     # conv net
+"""
+import argparse
+import logging
+import os
+import sys
+
+import numpy as np
+
+sys.path.insert(0, os.path.dirname(__file__))
+from common import find_mxnet  # noqa: F401  (puts the in-tree package on sys.path)
+import mxnet_tpu as mx
+from common import fit
+
+logging.basicConfig(level=logging.DEBUG)
+
+
+def read_data(label_path, image_path):
+    """Read one MNIST idx (label, image) pair from local files."""
+    from mxnet_tpu.io import _read_idx_file
+
+    label = _read_idx_file(label_path)
+    image = _read_idx_file(image_path)
+    return (label, image)
+
+
+def to4d(img):
+    return img.reshape(img.shape[0], 1, 28, 28).astype(np.float32) / 255
+
+
+def _synthetic_mnist(n, num_classes, seed):
+    """Deterministic stand-in when the real idx files are absent: each class
+    is a distinct blocky template + noise, so models actually converge (the
+    templates are fixed across train/val; only the noise seed differs)."""
+    templates = np.random.RandomState(12345).rand(num_classes, 28, 28) > 0.7
+    rs = np.random.RandomState(seed)
+    labels = rs.randint(0, num_classes, (n,)).astype(np.float32)
+    imgs = templates[labels.astype(int)].astype(np.float32) * 255
+    imgs += rs.normal(0, 32, imgs.shape)
+    return labels, np.clip(imgs, 0, 255).astype(np.uint8)
+
+
+def get_mnist_iter(args, kv):
+    data_dir = getattr(args, "data_dir", "data")
+    names = {
+        "train_lbl": "train-labels-idx1-ubyte", "train_img": "train-images-idx3-ubyte",
+        "val_lbl": "t10k-labels-idx1-ubyte", "val_img": "t10k-images-idx3-ubyte",
+    }
+    paths = {k: os.path.join(data_dir, v) for k, v in names.items()}
+    if all(os.path.exists(p) or os.path.exists(p + ".gz") for p in paths.values()):
+        train_lbl, train_img = read_data(paths["train_lbl"], paths["train_img"])
+        val_lbl, val_img = read_data(paths["val_lbl"], paths["val_img"])
+    else:
+        logging.warning("MNIST idx files not found under %r — using synthetic digits",
+                        data_dir)
+        train_lbl, train_img = _synthetic_mnist(args.num_examples, args.num_classes, 0)
+        val_lbl, val_img = _synthetic_mnist(args.num_examples // 6, args.num_classes, 1)
+    train = mx.io.NDArrayIter(to4d(train_img), train_lbl, args.batch_size, shuffle=True)
+    val = mx.io.NDArrayIter(to4d(val_img), val_lbl, args.batch_size)
+    return (train, val)
+
+
+if __name__ == "__main__":
+    parser = argparse.ArgumentParser(
+        description="train mnist", formatter_class=argparse.ArgumentDefaultsHelpFormatter)
+    parser.add_argument("--num-classes", type=int, default=10, help="the number of classes")
+    parser.add_argument("--num-examples", type=int, default=60000,
+                        help="the number of training examples")
+    parser.add_argument("--data-dir", type=str, default="data",
+                        help="directory holding the MNIST idx files")
+    fit.add_fit_args(parser)
+    parser.set_defaults(
+        network="mlp",
+        num_epochs=10,
+        lr=0.05,
+        lr_step_epochs="10",
+    )
+    args = parser.parse_args()
+
+    from mxnet_tpu import models
+
+    if args.network == "mlp":
+        sym = models.get_symbol("mlp", num_classes=args.num_classes)
+    else:
+        sym = models.get_symbol(args.network, num_classes=args.num_classes,
+                                image_shape="1,28,28")
+
+    fit.fit(args, sym, get_mnist_iter)

@@ -1,0 +1,123 @@
+"""Bucketed LSTM language model (config 3 in BASELINE.json).
+
+Counterpart of the reference's example/rnn/lstm_bucketing.py: a
+BucketSentenceIter feeds variable-length sentences into a BucketingModule
+whose sym_gen unrolls LSTM cells per bucket length. TPU economics are the
+same as the reference's executor-per-bucket design — one compiled XLA
+executable per bucket shape, all sharing parameters.
+
+Reads PTB-style text from --data-train if it exists (one sentence per line,
+space-separated tokens); otherwise generates a synthetic Zipf corpus so the
+script runs without egress.
+"""
+import argparse
+import logging
+import os
+import sys
+
+import numpy as np
+
+sys.path.insert(0, os.path.abspath(os.path.join(os.path.dirname(__file__), "..", "..")))
+import mxnet_tpu as mx
+
+logging.basicConfig(level=logging.DEBUG)
+
+parser = argparse.ArgumentParser(
+    description="Train an LSTM language model with bucketing",
+    formatter_class=argparse.ArgumentDefaultsHelpFormatter)
+parser.add_argument("--data-train", type=str, default="./data/ptb.train.txt")
+parser.add_argument("--num-hidden", type=int, default=200)
+parser.add_argument("--num-embed", type=int, default=200)
+parser.add_argument("--num-layers", type=int, default=2)
+parser.add_argument("--num-epochs", type=int, default=25)
+parser.add_argument("--lr", type=float, default=0.01)
+parser.add_argument("--optimizer", type=str, default="sgd")
+parser.add_argument("--mom", type=float, default=0.0)
+parser.add_argument("--wd", type=float, default=1e-5)
+parser.add_argument("--batch-size", type=int, default=32)
+parser.add_argument("--disp-batches", type=int, default=50)
+parser.add_argument("--kv-store", type=str, default="local")
+parser.add_argument("--num-sentences", type=int, default=2000,
+                    help="synthetic corpus size when --data-train is absent")
+
+BUCKETS = [10, 20, 30, 40, 50, 60]
+START_LABEL = 1
+INVALID_LABEL = 0
+
+
+def _simple_tokenize(fname):
+    """Line-per-sentence text → int id lists (the reference's tokenize_text)."""
+    with open(fname) as f:
+        lines = [row.split() for row in f if row.strip()]
+    vocab = {}
+    sentences = []
+    for words in lines:
+        ids = []
+        for w in words:
+            if w not in vocab:
+                vocab[w] = len(vocab) + START_LABEL + 1
+            ids.append(vocab[w])
+        sentences.append(ids)
+    return sentences, vocab
+
+
+def _synthetic_corpus(n_sentences, vocab_size=500, seed=0):
+    rs = np.random.RandomState(seed)
+    # Zipf-ish token frequencies, bucket-spread sentence lengths
+    probs = 1.0 / np.arange(2, vocab_size + 2)
+    probs /= probs.sum()
+    sentences = []
+    for _ in range(n_sentences):
+        length = int(rs.choice(BUCKETS)) - rs.randint(0, 5)
+        toks = rs.choice(np.arange(2, vocab_size + 2), size=max(length, 3), p=probs)
+        sentences.append(toks.tolist())
+    return sentences, vocab_size + 2
+
+
+if __name__ == "__main__":
+    args = parser.parse_args()
+
+    if os.path.exists(args.data_train):
+        train_sent, vocab = _simple_tokenize(args.data_train)
+        vocab_size = len(vocab) + START_LABEL + 1
+    else:
+        logging.warning("%r not found — using a synthetic Zipf corpus", args.data_train)
+        train_sent, vocab_size = _synthetic_corpus(args.num_sentences)
+
+    data_train = mx.rnn.BucketSentenceIter(
+        train_sent, args.batch_size, buckets=BUCKETS, invalid_label=INVALID_LABEL)
+
+    stack = mx.rnn.SequentialRNNCell()
+    for i in range(args.num_layers):
+        stack.add(mx.rnn.LSTMCell(num_hidden=args.num_hidden, prefix="lstm_l%d_" % i))
+
+    def sym_gen(seq_len):
+        data = mx.sym.Variable("data")
+        label = mx.sym.Variable("softmax_label")
+        embed = mx.sym.Embedding(data=data, input_dim=vocab_size,
+                                 output_dim=args.num_embed, name="embed")
+        stack.reset()
+        outputs, states = stack.unroll(
+            seq_len, inputs=embed, merge_outputs=True,
+            begin_state=stack.begin_state(batch_size=args.batch_size))
+        pred = mx.sym.Reshape(outputs, shape=(-1, args.num_hidden))
+        pred = mx.sym.FullyConnected(data=pred, num_hidden=vocab_size, name="pred")
+        label = mx.sym.Reshape(label, shape=(-1,))
+        pred = mx.sym.SoftmaxOutput(data=pred, label=label, name="softmax")
+        return pred, ("data",), ("softmax_label",)
+
+    model = mx.mod.BucketingModule(
+        sym_gen=sym_gen,
+        default_bucket_key=data_train.default_bucket_key,
+        context=mx.current_context())
+
+    model.fit(
+        train_data=data_train,
+        eval_metric=mx.metric.Perplexity(INVALID_LABEL),
+        kvstore=args.kv_store,
+        optimizer=args.optimizer,
+        optimizer_params={"learning_rate": args.lr, "momentum": args.mom, "wd": args.wd},
+        initializer=mx.init.Xavier(factor_type="in", magnitude=2.34),
+        num_epoch=args.num_epochs,
+        batch_end_callback=mx.callback.Speedometer(args.batch_size, args.disp_batches),
+    )

@@ -1,0 +1,165 @@
+"""SSD single-shot detector training (config 4 in BASELINE.json).
+
+Compact counterpart of the reference's example/ssd/ app (train.py +
+symbol/symbol_builder.py): a conv backbone with multi-scale feature maps,
+per-scale class/location conv heads, MultiBoxPrior anchors, MultiBoxTarget
+training targets, and the reference's SSD loss (SoftmaxOutput with ignore
+label for classes + smooth-l1 MakeLoss for box offsets). The whole multi-loss
+graph lowers to one XLA computation per step.
+
+Runs on a synthetic detection set (random rectangles of `num-classes` colors)
+since this environment has no egress; point --data-train at a .rec produced
+by tools/im2rec.py --pack-label for real data.
+"""
+import argparse
+import logging
+import os
+import sys
+
+import numpy as np
+
+sys.path.insert(0, os.path.abspath(os.path.join(os.path.dirname(__file__), "..", "..")))
+import mxnet_tpu as mx
+
+logging.basicConfig(level=logging.DEBUG)
+
+
+def conv_act(data, name, num_filter, kernel=(3, 3), pad=(1, 1), stride=(1, 1)):
+    c = mx.sym.Convolution(data=data, num_filter=num_filter, kernel=kernel,
+                           pad=pad, stride=stride, name="conv" + name)
+    bn = mx.sym.BatchNorm(data=c, name="bn" + name)
+    return mx.sym.Activation(data=bn, act_type="relu", name="relu" + name)
+
+
+def multi_layer_feature(data):
+    """Backbone producing 3 feature scales (reference: symbol_builder's
+    multi_layer_feature over a VGG body)."""
+    b1 = conv_act(conv_act(data, "1_1", 32), "1_2", 32)
+    p1 = mx.sym.Pooling(data=b1, kernel=(2, 2), stride=(2, 2), pool_type="max")
+    b2 = conv_act(conv_act(p1, "2_1", 64), "2_2", 64)
+    p2 = mx.sym.Pooling(data=b2, kernel=(2, 2), stride=(2, 2), pool_type="max")
+    b3 = conv_act(conv_act(p2, "3_1", 128), "3_2", 128)
+    p3 = mx.sym.Pooling(data=b3, kernel=(2, 2), stride=(2, 2), pool_type="max")
+    b4 = conv_act(p3, "4_1", 128)
+    return [b2, b3, b4]
+
+
+def multibox_layer(layers, num_classes, sizes, ratios):
+    """Per-scale heads + anchors (reference: common.py multibox_layer):
+    returns (cls_preds, loc_preds, anchors)."""
+    cls_layers, loc_layers, anchor_layers = [], [], []
+    num_anchors = [len(s) + len(r) - 1 for s, r in zip(sizes, ratios)]
+    for i, (feat, size, ratio, na) in enumerate(zip(layers, sizes, ratios, num_anchors)):
+        cls = mx.sym.Convolution(data=feat, num_filter=na * (num_classes + 1),
+                                 kernel=(3, 3), pad=(1, 1), name="cls_pred_%d" % i)
+        # (B, na*(C+1), H, W) → (B, H*W*na, C+1) → concat over scales
+        cls = mx.sym.transpose(cls, axes=(0, 2, 3, 1))
+        cls = mx.sym.Reshape(cls, shape=(0, -1, num_classes + 1))
+        cls_layers.append(cls)
+
+        loc = mx.sym.Convolution(data=feat, num_filter=na * 4, kernel=(3, 3),
+                                 pad=(1, 1), name="loc_pred_%d" % i)
+        loc = mx.sym.transpose(loc, axes=(0, 2, 3, 1))
+        loc = mx.sym.Reshape(loc, shape=(0, -1))
+        loc_layers.append(loc)
+
+        anchor_layers.append(mx.sym.MultiBoxPrior(
+            feat, sizes=size, ratios=ratio, name="anchors_%d" % i))
+
+    cls_preds = mx.sym.Concat(*cls_layers, dim=1, name="cls_preds")
+    # SoftmaxOutput(multi_output) wants (B, C+1, N)
+    cls_preds = mx.sym.transpose(cls_preds, axes=(0, 2, 1))
+    loc_preds = mx.sym.Concat(*loc_layers, dim=1, name="loc_preds")
+    anchors = mx.sym.Concat(*anchor_layers, dim=1, name="anchors")
+    return cls_preds, loc_preds, anchors
+
+
+def get_ssd_symbol(num_classes):
+    data = mx.sym.Variable("data")
+    label = mx.sym.Variable("label")
+    layers = multi_layer_feature(data)
+    sizes = [(0.2, 0.3), (0.4, 0.5), (0.7, 0.9)]
+    ratios = [(1.0, 2.0, 0.5)] * 3
+    cls_preds, loc_preds, anchors = multibox_layer(layers, num_classes, sizes, ratios)
+
+    loc_target, loc_target_mask, cls_target = mx.sym.MultiBoxTarget(
+        anchors, label, cls_preds, overlap_threshold=0.5,
+        ignore_label=-1, negative_mining_ratio=3, name="multibox_target")
+
+    cls_prob = mx.sym.SoftmaxOutput(data=cls_preds, label=cls_target,
+                                    ignore_label=-1, use_ignore=True,
+                                    multi_output=True,
+                                    normalization="valid", name="cls_prob")
+    loc_diff = loc_target_mask * (loc_preds - loc_target)
+    loc_loss_ = mx.sym.smooth_l1(data=loc_diff, scalar=1.0, name="loc_loss_")
+    loc_loss = mx.sym.MakeLoss(loc_loss_, grad_scale=1.0,
+                               normalization="valid", name="loc_loss")
+
+    cls_label = mx.sym.MakeLoss(data=cls_target, grad_scale=0, name="cls_label")
+    return mx.sym.Group([cls_prob, loc_loss, cls_label])
+
+
+class SyntheticDetIter(mx.io.DataIter):
+    """Random colored rectangles with box labels in the reference's SSD
+    label layout: (B, max_objects, 5) rows of [cls, xmin, ymin, xmax, ymax]."""
+
+    def __init__(self, batch_size, data_shape, num_classes, num_batches=20,
+                 max_objects=4, seed=0):
+        super().__init__(batch_size)
+        self.num_batches = num_batches
+        self.cur = 0
+        rs = np.random.RandomState(seed)
+        b, c, h, w = (batch_size,) + data_shape
+        imgs = np.zeros((b, c, h, w), np.float32)
+        labels = -np.ones((b, max_objects, 5), np.float32)
+        for i in range(b):
+            for j in range(rs.randint(1, max_objects + 1)):
+                cls = rs.randint(0, num_classes)
+                x0, y0 = rs.uniform(0, 0.6, 2)
+                x1, y1 = x0 + rs.uniform(0.2, 0.4), y0 + rs.uniform(0.2, 0.4)
+                x1, y1 = min(x1, 1.0), min(y1, 1.0)
+                imgs[i, cls % c, int(y0 * h):int(y1 * h), int(x0 * w):int(x1 * w)] = 1.0
+                labels[i, j] = [cls, x0, y0, x1, y1]
+        self.data, self.label = mx.nd.array(imgs), mx.nd.array(labels)
+        self.provide_data = [mx.io.DataDesc("data", (b, c, h, w))]
+        self.provide_label = [mx.io.DataDesc("label", labels.shape)]
+
+    def next(self):
+        if self.cur >= self.num_batches:
+            raise StopIteration
+        self.cur += 1
+        return mx.io.DataBatch(data=[self.data], label=[self.label], pad=0)
+
+    def reset(self):
+        self.cur = 0
+
+
+if __name__ == "__main__":
+    parser = argparse.ArgumentParser(
+        description="train SSD", formatter_class=argparse.ArgumentDefaultsHelpFormatter)
+    parser.add_argument("--num-classes", type=int, default=4)
+    parser.add_argument("--batch-size", type=int, default=8)
+    parser.add_argument("--data-shape", type=int, default=64)
+    parser.add_argument("--num-epochs", type=int, default=3)
+    parser.add_argument("--lr", type=float, default=0.01)
+    parser.add_argument("--kv-store", type=str, default="local")
+    args = parser.parse_args()
+
+    net = get_ssd_symbol(args.num_classes)
+    train_iter = SyntheticDetIter(args.batch_size,
+                                  (3, args.data_shape, args.data_shape),
+                                  args.num_classes)
+
+    mod = mx.mod.Module(net, data_names=("data",), label_names=("label",),
+                        context=mx.current_context())
+    mod.fit(
+        train_iter,
+        eval_metric=mx.metric.Loss(),
+        kvstore=args.kv_store,
+        optimizer="sgd",
+        optimizer_params={"learning_rate": args.lr, "momentum": 0.9, "wd": 5e-4},
+        initializer=mx.init.Xavier(),
+        num_epoch=args.num_epochs,
+        batch_end_callback=mx.callback.Speedometer(args.batch_size, 10),
+    )
+    print("SSD training finished")

@@ -11,6 +11,20 @@ a device mesh, and lax.scan RNNs. Importable as ``mx`` for script parity:
 """
 __version__ = "0.1.0"
 
+import os as _os
+
+if _os.environ.get("MXNET_DEFAULT_CONTEXT", "").startswith("cpu"):
+    # Force the CPU backend before any jax backend initializes. The env var
+    # JAX_PLATFORMS alone is not enough on images whose sitecustomize imports
+    # jax with an accelerator platform preset — the config route always works
+    # as long as no computation ran yet (same trick as tests/conftest.py).
+    try:
+        import jax as _jax
+
+        _jax.config.update("jax_platforms", "cpu")
+    except Exception:  # pragma: no cover - jax absent or backend already up
+        pass
+
 from . import base
 from .base import MXNetError
 from .context import Context, cpu, gpu, tpu, current_context, num_gpus, num_tpus
@@ -58,6 +72,7 @@ def __getattr__(name):
         "kv": ".kvstore",
         "callback": ".callback",
         "monitor": ".monitor",
+        "mon": ".monitor",
         "rnn": ".rnn",
         "model": ".model",
         "autograd": ".autograd",

@@ -100,6 +100,10 @@ class Initializer:
             self._init_zero(desc, arr)
         elif name.endswith("moving_avg"):
             self._init_zero(desc, arr)
+        elif name.endswith("label"):
+            # a label variable bound as a param (Module(label_names=None) for
+            # inference, the reference's benchmark_score pattern) — zeros
+            self._init_zero(desc, arr)
         else:
             self._init_default(desc, arr)
 

@@ -14,7 +14,7 @@ def _layer_norm(x, name, dim):
     mean = sym.mean(x, axis=-1, keepdims=True)
     cent = sym.broadcast_sub(x, mean, name="%s_cent" % name)
     var = sym.mean(sym.square(cent), axis=-1, keepdims=True)
-    inv = sym._rdiv_scalar(sym.sqrt(var + 1e-5), scalar=1.0)
+    inv = sym.rsqrt(var + 1e-5)
     normed = sym.broadcast_mul(cent, inv)
     gamma = sym.Variable("%s_gamma" % name, shape=(dim,))
     beta = sym.Variable("%s_beta" % name, shape=(dim,))

@@ -36,8 +36,10 @@ def _multi_head_attention(attrs, query, key, value):
     v = value.astype("float32")
     s = jnp.einsum("bhqd,bhkd->bhqk", q, k) * scale
     if attrs["causal"]:
+        # bottom-right aligned so a rectangular (decode) call — T queries over
+        # S >= T keys — lets each query see all S-T+q past keys
         T, S = s.shape[-2], s.shape[-1]
-        mask = jnp.tril(jnp.ones((T, S), bool))
+        mask = jnp.tril(jnp.ones((T, S), bool), k=S - T)
         s = jnp.where(mask, s, -jnp.inf)
     p = jax.nn.softmax(s, axis=-1)
     out = jnp.einsum("bhqk,bhkd->bhqd", p, v)

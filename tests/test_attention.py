@@ -3,18 +3,18 @@ import numpy as np
 import pytest
 
 import mxnet_tpu as mx
-from mxnet_tpu import models, parallel
+from mxnet_tpu import parallel
 from mxnet_tpu import symbol as sym
 from mxnet_tpu.parallel.ring_attention import ring_attention
 
 
 def _ref_attention(q, k, v, causal):
-    # numpy oracle over (B,H,T,D)
+    # numpy oracle over (B,H,T,D); causal mask bottom-right aligned for S>=T
     d = q.shape[-1]
     s = np.einsum("bhqd,bhkd->bhqk", q, k) / np.sqrt(d)
     if causal:
-        T = s.shape[-1]
-        mask = np.tril(np.ones((T, T), bool))
+        T, S = s.shape[-2], s.shape[-1]
+        mask = np.tril(np.ones((T, S), bool), k=S - T)
         s = np.where(mask, s, -np.inf)
     s = s - s.max(-1, keepdims=True)
     p = np.exp(s)
@@ -30,6 +30,18 @@ def test_mha_op_matches_numpy(causal):
                                    causal=causal).asnumpy()
     np.testing.assert_allclose(out, _ref_attention(q, k, v, causal),
                                rtol=1e-4, atol=1e-5)
+
+
+def test_mha_causal_rectangular_decode():
+    # single-token decode: 1 query over 16 cached keys must see ALL of them
+    rs = np.random.RandomState(1)
+    q = rs.randn(1, 2, 1, 4).astype("float32")
+    k, v = (rs.randn(1, 2, 16, 4).astype("float32") for _ in range(2))
+    out = mx.nd.MultiHeadAttention(mx.nd.array(q), mx.nd.array(k), mx.nd.array(v),
+                                   causal=True).asnumpy()
+    np.testing.assert_allclose(out, _ref_attention(q, k, v, True),
+                               rtol=1e-4, atol=1e-5)
+    assert np.isfinite(out).all()
 
 
 @pytest.mark.parametrize("causal", [False, True])
@@ -64,7 +76,6 @@ def test_ring_attention_grad_flows():
 
 
 def test_transformer_builds_and_steps():
-    net = models.get_symbol if False else None
     from mxnet_tpu.models import transformer
 
     net = transformer.get_symbol(vocab_size=100, num_layers=2, num_heads=2,
