@@ -57,8 +57,12 @@ def get_mnist_iter(args, kv):
         "train_lbl": "train-labels-idx1-ubyte", "train_img": "train-images-idx3-ubyte",
         "val_lbl": "t10k-labels-idx1-ubyte", "val_img": "t10k-images-idx3-ubyte",
     }
-    paths = {k: os.path.join(data_dir, v) for k, v in names.items()}
-    if all(os.path.exists(p) or os.path.exists(p + ".gz") for p in paths.values()):
+    def resolve(p):
+        # prefer the plain idx file, fall back to the gzipped download name
+        return p if os.path.exists(p) else (p + ".gz" if os.path.exists(p + ".gz") else None)
+
+    paths = {k: resolve(os.path.join(data_dir, v)) for k, v in names.items()}
+    if all(p is not None for p in paths.values()):
         train_lbl, train_img = read_data(paths["train_lbl"], paths["train_img"])
         val_lbl, val_img = read_data(paths["val_lbl"], paths["val_img"])
     else:

@@ -70,6 +70,7 @@ def __getattr__(name):
         "init": ".initializer",
         "kvstore": ".kvstore",
         "kv": ".kvstore",
+        "dist": ".dist",
         "callback": ".callback",
         "monitor": ".monitor",
         "mon": ".monitor",
@@ -83,6 +84,8 @@ def __getattr__(name):
         "viz": ".visualization",
         "profiler": ".profiler",
         "recordio": ".recordio",
+        "image": ".image",
+        "img": ".image",
         "models": ".models",
     }
     if name in lazy:

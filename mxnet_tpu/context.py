@@ -71,7 +71,14 @@ class Context:
         import jax
 
         if self.device_type in ("cpu", "cpu_pinned"):
-            devs = jax.devices("cpu")
+            # local_devices: in a multi-process job each process may only
+            # address its own devices (jax.devices() lists the whole job's)
+            devs = [d for d in jax.local_devices() if d.platform == "cpu"]
+            if not devs:
+                try:
+                    devs = jax.local_devices(backend="cpu")
+                except RuntimeError:
+                    devs = jax.devices("cpu")
             return devs[self.device_id % len(devs)]
         accels = _accelerator_devices()
         if not accels:
@@ -88,7 +95,7 @@ def _accelerator_devices():
     import jax
 
     try:
-        devs = jax.devices()
+        devs = jax.local_devices()
     except RuntimeError:
         return []
     return [d for d in devs if d.platform != "cpu"]

@@ -1,0 +1,89 @@
+"""Multi-process runtime bootstrap.
+
+Counterpart of the reference's cluster env plumbing (`KVStore::InitPSEnv`,
+include/mxnet/kvstore.h:158-164, consuming DMLC_ROLE/DMLC_PS_ROOT_URI/... set
+by tools/launch.py). The ps-lite scheduler/server roles are gone — in the
+SPMD design every process runs the same program — so the only bootstrap
+needed is the JAX coordination service: ``tools/launch.py`` sets the three
+``MXNET_TPU_*`` env vars below and ``init()`` wires them into
+``jax.distributed.initialize``, after which ``jax.process_index()`` /
+``jax.process_count()`` back KVStore ``rank``/``num_workers`` and XLA
+collectives ride ICI/DCN across all hosts.
+"""
+from __future__ import annotations
+
+import logging
+import os
+
+__all__ = ["init", "is_initialized", "rank", "num_workers", "shutdown"]
+
+# env contract with tools/launch.py (the DMLC_* vars of the reference)
+ENV_COORDINATOR = "MXNET_TPU_COORDINATOR"  # host:port of process 0
+ENV_NUM_WORKERS = "MXNET_TPU_NUM_WORKERS"
+ENV_WORKER_ID = "MXNET_TPU_WORKER_ID"
+
+_initialized = False
+
+
+def is_initialized() -> bool:
+    return _initialized
+
+
+def init(coordinator_address=None, num_processes=None, process_id=None):
+    """Connect this process to the job's coordination service.
+
+    Arguments default to the ``MXNET_TPU_*`` env vars; no-op when neither is
+    present (single-process job) or when already initialized. Safe to call
+    multiple times.
+    """
+    global _initialized
+    if _initialized:
+        return
+    coordinator_address = coordinator_address or os.environ.get(ENV_COORDINATOR)
+    if coordinator_address is None:
+        return  # single-process
+    num_processes = int(num_processes if num_processes is not None
+                        else os.environ.get(ENV_NUM_WORKERS, "1"))
+    process_id = int(process_id if process_id is not None
+                     else os.environ.get(ENV_WORKER_ID, "0"))
+    import jax
+
+    try:
+        jax.distributed.initialize(
+            coordinator_address=coordinator_address,
+            num_processes=num_processes,
+            process_id=process_id,
+        )
+    except RuntimeError as e:
+        from .base import MXNetError
+
+        raise MXNetError(
+            "mxnet_tpu.dist.init() must run before any JAX computation. "
+            "Create the dist kvstore (mx.kv.create('dist_tpu_sync')) or call "
+            "mx.dist.init() at the top of the worker script, before building "
+            "NDArrays or binding modules. Original error: %s" % e
+        ) from e
+    _initialized = True
+    logging.info("mxnet_tpu.dist: worker %d/%d connected to %s",
+                 process_id, num_processes, coordinator_address)
+
+
+def rank() -> int:
+    import jax
+
+    return jax.process_index()
+
+
+def num_workers() -> int:
+    import jax
+
+    return jax.process_count()
+
+
+def shutdown():
+    global _initialized
+    if _initialized:
+        import jax
+
+        jax.distributed.shutdown()
+        _initialized = False

@@ -1,0 +1,446 @@
+"""Image data pipeline: decode, augment, batch.
+
+Counterpart of the reference's image stack — the C++ record iterators
+(src/io/iter_image_recordio_2.cc:559, src/io/image_aug_default.cc) and the
+python ``mxnet/image.py`` iterator. TPU-native design notes: decode + augment
+run on host CPU threads (a ThreadPoolExecutor per iterator — the reference's
+``preprocess_threads``), producing fixed-shape NCHW float32 batches so the
+device step compiles once; wrap with ``mx.io.PrefetchingIter`` (or pass
+``prefetch_buffer``) to overlap host decode with device compute the way the
+reference's PrefetcherIter does (src/io/iter_prefetcher.h:28).
+
+JPEG/PNG codec: cv2 when installed, else PIL (this image ships PIL).
+"""
+from __future__ import annotations
+
+import os
+import random as _random
+from concurrent.futures import ThreadPoolExecutor
+
+import numpy as np
+
+from .base import MXNetError
+from . import io as _io
+from . import ndarray as nd
+from .recordio import MXIndexedRecordIO, MXRecordIO, unpack, _decode_img
+
+__all__ = [
+    "imdecode", "imresize", "fixed_crop", "random_crop", "center_crop",
+    "color_normalize", "HorizontalFlipAug", "ResizeAug", "ForceResizeAug",
+    "RandomCropAug", "CenterCropAug", "BrightnessJitterAug",
+    "ContrastJitterAug", "SaturationJitterAug", "ColorNormalizeAug", "CastAug",
+    "CreateAugmenter", "ImageIter", "ImageRecordIter", "ImageDetIter",
+]
+
+
+# --------------------------------------------------------------------- codec
+def imdecode(buf, to_rgb=True, flag=1):
+    """Decode jpeg/png bytes to an HWC uint8 array (reference: image.py
+    imdecode over cv2; here cv2-or-PIL). Returns RGB by default."""
+    img = _decode_img(bytes(buf), 1 if flag else 0)
+    if img.ndim == 3 and to_rgb:
+        img = img[:, :, ::-1]  # disk convention is BGR (cv2-compatible)
+    return img
+
+
+def imresize(src, w, h, interp=2):
+    """Resize HWC array to (h, w) (reference: image.py resize_short/imresize)."""
+    try:
+        import cv2
+
+        return cv2.resize(src, (w, h), interpolation=interp)
+    except ImportError:
+        from PIL import Image
+
+        pil = Image.fromarray(np.asarray(src, np.uint8))
+        return np.asarray(pil.resize((w, h), Image.BILINEAR))
+
+
+def resize_short(src, size, interp=2):
+    h, w = src.shape[:2]
+    if h > w:
+        new_w, new_h = size, int(h * size / w)
+    else:
+        new_w, new_h = int(w * size / h), size
+    return imresize(src, new_w, new_h, interp)
+
+
+def fixed_crop(src, x0, y0, w, h):
+    return src[y0:y0 + h, x0:x0 + w]
+
+
+def random_crop(src, size, rng=None):
+    """(reference: image.py random_crop) size = (w, h)."""
+    rng = rng or _random
+    h, w = src.shape[:2]
+    cw, ch = size
+    if w < cw or h < ch:
+        src = imresize(src, max(w, cw), max(h, ch))
+        h, w = src.shape[:2]
+    x0 = rng.randint(0, w - cw) if w > cw else 0
+    y0 = rng.randint(0, h - ch) if h > ch else 0
+    return fixed_crop(src, x0, y0, cw, ch), (x0, y0, cw, ch)
+
+
+def center_crop(src, size):
+    h, w = src.shape[:2]
+    cw, ch = size
+    if w < cw or h < ch:
+        src = imresize(src, max(w, cw), max(h, ch))
+        h, w = src.shape[:2]
+    x0, y0 = (w - cw) // 2, (h - ch) // 2
+    return fixed_crop(src, x0, y0, cw, ch), (x0, y0, cw, ch)
+
+
+def color_normalize(src, mean, std=None):
+    src = src.astype(np.float32) - mean
+    if std is not None:
+        src /= std
+    return src
+
+
+# ----------------------------------------------------------------- augmenters
+class Augmenter:
+    """One augmentation step; called with an HWC float/uint8 array."""
+
+    def __call__(self, src, rng):
+        raise NotImplementedError
+
+
+class ResizeAug(Augmenter):
+    def __init__(self, size, interp=2):
+        self.size, self.interp = size, interp
+
+    def __call__(self, src, rng):
+        return resize_short(src, self.size, self.interp)
+
+
+class ForceResizeAug(Augmenter):
+    def __init__(self, size, interp=2):
+        self.size, self.interp = size, interp  # (w, h)
+
+    def __call__(self, src, rng):
+        return imresize(src, self.size[0], self.size[1], self.interp)
+
+
+class RandomCropAug(Augmenter):
+    def __init__(self, size, interp=2):
+        self.size = size
+
+    def __call__(self, src, rng):
+        return random_crop(src, self.size, rng)[0]
+
+
+class CenterCropAug(Augmenter):
+    def __init__(self, size, interp=2):
+        self.size = size
+
+    def __call__(self, src, rng):
+        return center_crop(src, self.size)[0]
+
+
+class HorizontalFlipAug(Augmenter):
+    def __init__(self, p=0.5):
+        self.p = p
+
+    def __call__(self, src, rng):
+        return src[:, ::-1] if rng.random() < self.p else src
+
+
+class BrightnessJitterAug(Augmenter):
+    def __init__(self, brightness):
+        self.brightness = brightness
+
+    def __call__(self, src, rng):
+        alpha = 1.0 + rng.uniform(-self.brightness, self.brightness)
+        return src.astype(np.float32) * alpha
+
+
+class ContrastJitterAug(Augmenter):
+    _coef = np.array([0.299, 0.587, 0.114], np.float32)
+
+    def __init__(self, contrast):
+        self.contrast = contrast
+
+    def __call__(self, src, rng):
+        alpha = 1.0 + rng.uniform(-self.contrast, self.contrast)
+        src = src.astype(np.float32)
+        gray = (src * self._coef).sum(axis=2, keepdims=True)
+        return src * alpha + gray.mean() * (1.0 - alpha)
+
+
+class SaturationJitterAug(Augmenter):
+    _coef = np.array([0.299, 0.587, 0.114], np.float32)
+
+    def __init__(self, saturation):
+        self.saturation = saturation
+
+    def __call__(self, src, rng):
+        alpha = 1.0 + rng.uniform(-self.saturation, self.saturation)
+        src = src.astype(np.float32)
+        gray = (src * self._coef).sum(axis=2, keepdims=True)
+        return src * alpha + gray * (1.0 - alpha)
+
+
+class ColorNormalizeAug(Augmenter):
+    def __init__(self, mean, std=None):
+        self.mean = np.asarray(mean, np.float32) if mean is not None else None
+        self.std = np.asarray(std, np.float32) if std is not None else None
+
+    def __call__(self, src, rng):
+        src = src.astype(np.float32)
+        if self.mean is not None:
+            src = src - self.mean
+        if self.std is not None:
+            src = src / self.std
+        return src
+
+
+class CastAug(Augmenter):
+    def __call__(self, src, rng):
+        return src.astype(np.float32)
+
+
+def CreateAugmenter(data_shape, resize=0, rand_crop=False, rand_resize=False,
+                    rand_mirror=False, mean=None, std=None, brightness=0,
+                    contrast=0, saturation=0, inter_method=2):
+    """Standard augmenter list (reference: image.py CreateAugmenter /
+    src/io/image_aug_default.cc pipeline order: resize → crop → mirror →
+    color jitter → normalize)."""
+    auglist = []
+    if resize > 0:
+        auglist.append(ResizeAug(resize, inter_method))
+    crop_size = (data_shape[2], data_shape[1])
+    if rand_crop:
+        auglist.append(RandomCropAug(crop_size, inter_method))
+    else:
+        auglist.append(CenterCropAug(crop_size, inter_method))
+    if rand_mirror:
+        auglist.append(HorizontalFlipAug(0.5))
+    auglist.append(CastAug())
+    if brightness:
+        auglist.append(BrightnessJitterAug(brightness))
+    if contrast:
+        auglist.append(ContrastJitterAug(contrast))
+    if saturation:
+        auglist.append(SaturationJitterAug(saturation))
+    if mean is True:
+        mean = np.array([123.68, 116.28, 103.53], np.float32)
+    if std is True:
+        std = np.array([58.395, 57.12, 57.375], np.float32)
+    if mean is not None or std is not None:
+        auglist.append(ColorNormalizeAug(mean, std))
+    return auglist
+
+
+# ------------------------------------------------------------------ iterators
+class _RecordSource:
+    """Random-access record source over a .rec (+optional .idx) pack.
+
+    Always offset-based (no .idx → one streaming scan collecting byte offsets,
+    never payloads, so arbitrarily large packs stay out of RAM). ``get`` locks
+    around the shared handle's seek+read so decode threads can fetch
+    concurrently; the expensive decode/augment work stays outside the lock.
+    """
+
+    def __init__(self, path_imgrec, path_imgidx=None):
+        import threading
+
+        if path_imgidx is None and os.path.exists(
+                os.path.splitext(path_imgrec)[0] + ".idx"):
+            path_imgidx = os.path.splitext(path_imgrec)[0] + ".idx"
+        if path_imgidx:
+            rec = MXIndexedRecordIO(path_imgidx, path_imgrec, "r")
+            self._offsets = [rec.idx[k] for k in rec.keys]
+            self._rec = rec
+        else:
+            rec = MXRecordIO(path_imgrec, "r")
+            self._offsets = []
+            while True:
+                pos = rec.tell()
+                if rec.read() is None:
+                    break
+                self._offsets.append(pos)
+            self._rec = rec
+        self._lock = threading.Lock()
+
+    def __len__(self):
+        return len(self._offsets)
+
+    def get(self, i):
+        with self._lock:
+            self._rec.handle.seek(self._offsets[i])
+            return self._rec.read()
+
+
+class ImageRecordIter(_io.DataIter):
+    """Batches of decoded+augmented images from a RecordIO pack
+    (reference: ImageRecordIter, src/io/iter_image_recordio_2.cc:559).
+
+    Parameters follow the reference's ImageRecordParam/augmenter params:
+    data_shape (C,H,W), shuffle, rand_crop, rand_mirror, mean_r/g/b,
+    std_r/g/b, pad, num_parts/part_index (sharding), preprocess_threads,
+    path_imgidx, label_width, round_batch. ``aug_list`` overrides the default
+    augmenter pipeline.
+    """
+
+    def __init__(self, path_imgrec, data_shape, batch_size, shuffle=False,
+                 rand_crop=False, rand_mirror=False, mean_r=0.0, mean_g=0.0,
+                 mean_b=0.0, std_r=1.0, std_g=1.0, std_b=1.0, pad=0, resize=0,
+                 brightness=0, contrast=0, saturation=0, num_parts=1,
+                 part_index=0, preprocess_threads=4, path_imgidx=None,
+                 label_width=1, round_batch=True, seed=0, aug_list=None,
+                 data_name="data", label_name="softmax_label", **kwargs):
+        super().__init__(batch_size)
+        if len(data_shape) != 3:
+            raise MXNetError("data_shape must be (C, H, W)")
+        self._source = _RecordSource(path_imgrec, path_imgidx)
+        n = len(self._source)
+        self._indices = list(range(n))[part_index::num_parts]
+        self._shuffle = shuffle
+        self._rng = _random.Random(seed)
+        self.data_shape = tuple(data_shape)
+        self._pad = pad
+        mean = np.array([mean_r, mean_g, mean_b], np.float32)
+        std = np.array([std_r, std_g, std_b], np.float32)
+        self._aug = aug_list if aug_list is not None else CreateAugmenter(
+            tuple(data_shape),
+            resize=resize, rand_crop=rand_crop, rand_mirror=rand_mirror,
+            mean=mean if mean.any() else None,
+            std=std if (std != 1.0).any() else None,
+            brightness=brightness, contrast=contrast, saturation=saturation)
+        self._label_width = label_width
+        self._round_batch = round_batch
+        self._pool = (ThreadPoolExecutor(preprocess_threads)
+                      if preprocess_threads > 1 else None)
+        self._cursor = 0
+        self.data_name, self.label_name = data_name, label_name
+        label_shape = (batch_size,) if label_width == 1 else (batch_size, label_width)
+        self.provide_data = [_io.DataDesc(data_name, (batch_size,) + self.data_shape)]
+        self.provide_label = [_io.DataDesc(label_name, label_shape)]
+        self.reset()
+
+    def reset(self):
+        if self._shuffle:
+            self._rng.shuffle(self._indices)
+        self._cursor = 0
+
+    def _load_one(self, i, seed):
+        header, payload = unpack(self._source.get(i))
+        img = imdecode(payload, to_rgb=True)
+        if img.ndim == 2:
+            img = np.stack([img] * 3, axis=2)
+        if self._pad:
+            img = np.pad(img, ((self._pad, self._pad), (self._pad, self._pad),
+                               (0, 0)), mode="constant")
+        rng = _random.Random(seed)
+        for aug in self._aug:
+            img = aug(img, rng)
+        chw = np.transpose(img.astype(np.float32), (2, 0, 1))
+        label = np.asarray(header.label, np.float32)
+        return chw, label
+
+    def next(self):
+        n_left = len(self._indices) - self._cursor
+        if n_left <= 0 or (not self._round_batch and n_left < self.batch_size):
+            raise StopIteration
+        take = min(self.batch_size, n_left)
+        idxs = [self._indices[self._cursor + j] for j in range(take)]
+        # pad the final short batch by cycling its own real members
+        # (round_batch semantics; safe for shards smaller than the batch)
+        while len(idxs) < self.batch_size:
+            idxs.append(idxs[(len(idxs) - take) % take])
+        seeds = [self._rng.getrandbits(32) for _ in idxs]
+        if self._pool is not None:
+            results = list(self._pool.map(self._load_one, idxs, seeds))
+        else:
+            results = [self._load_one(i, s) for i, s in zip(idxs, seeds)]
+        data = np.stack([r[0] for r in results])
+        labels = np.stack([self._scalar_label(r[1]) for r in results])
+        self._cursor += take
+        return _io.DataBatch(
+            data=[nd.array(data)], label=[nd.array(labels)],
+            pad=self.batch_size - take,
+            provide_data=self.provide_data, provide_label=self.provide_label)
+
+    def _scalar_label(self, label):
+        arr = np.atleast_1d(label)
+        if self._label_width == 1:
+            return np.float32(arr.flat[0])
+        return arr[: self._label_width].astype(np.float32)
+
+
+# reference alias: raw uint8 variant (same pipeline; cast happens in augs)
+ImageRecordUInt8Iter = ImageRecordIter
+
+
+class ImageDetIter(ImageRecordIter):
+    """Detection variant (reference: ImageDetRecordIter,
+    src/io/iter_image_det_recordio.cc:563): labels are variable-length
+    ``[cls, xmin, ymin, xmax, ymax]`` rows, padded with -1 to
+    ``(batch, max_objects, 5)``."""
+
+    def __init__(self, *args, max_objects=8, **kwargs):
+        self._max_objects = max_objects
+        kwargs.setdefault("label_name", "label")
+        super().__init__(*args, **kwargs)
+        self.provide_label = [_io.DataDesc(
+            self.label_name, (self.batch_size, max_objects, 5))]
+
+    def _scalar_label(self, label):
+        rows = np.asarray(label, np.float32).reshape(-1, 5)
+        out = -np.ones((self._max_objects, 5), np.float32)
+        out[: min(len(rows), self._max_objects)] = rows[: self._max_objects]
+        return out
+
+
+class ImageIter(_io.DataIter):
+    """Python-level image iterator over a .lst + image root (reference:
+    python/mxnet/image.py ImageIter). For .rec input use ImageRecordIter."""
+
+    def __init__(self, batch_size, data_shape, path_imglist=None,
+                 path_root=".", shuffle=False, aug_list=None, seed=0,
+                 data_name="data", label_name="softmax_label", **kwargs):
+        super().__init__(batch_size)
+        if path_imglist is None:
+            raise MXNetError("ImageIter needs path_imglist (or use ImageRecordIter)")
+        self._items = []
+        with open(path_imglist) as f:
+            for line in f:
+                parts = line.strip().split("\t")
+                if len(parts) >= 3:
+                    self._items.append((float(parts[1]),
+                                        os.path.join(path_root, parts[-1])))
+        self._shuffle = shuffle
+        self._rng = _random.Random(seed)
+        self.data_shape = tuple(data_shape)
+        self._aug = aug_list if aug_list is not None else CreateAugmenter(data_shape)
+        self._cursor = 0
+        self.provide_data = [_io.DataDesc(data_name, (batch_size,) + self.data_shape)]
+        self.provide_label = [_io.DataDesc(label_name, (batch_size,))]
+        self.reset()
+
+    def reset(self):
+        if self._shuffle:
+            self._rng.shuffle(self._items)
+        self._cursor = 0
+
+    def next(self):
+        if self._cursor + self.batch_size > len(self._items):
+            raise StopIteration
+        data, labels = [], []
+        for j in range(self.batch_size):
+            label, path = self._items[self._cursor + j]
+            with open(path, "rb") as f:
+                img = imdecode(f.read())
+            if img.ndim == 2:
+                img = np.stack([img] * 3, axis=2)
+            for aug in self._aug:
+                img = aug(img, self._rng)
+            data.append(np.transpose(img.astype(np.float32), (2, 0, 1)))
+            labels.append(label)
+        self._cursor += self.batch_size
+        return _io.DataBatch(data=[nd.array(np.stack(data))],
+                             label=[nd.array(np.asarray(labels, np.float32))],
+                             pad=0, provide_data=self.provide_data,
+                             provide_label=self.provide_label)

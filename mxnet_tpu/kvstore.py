@@ -66,12 +66,15 @@ class KVStore:
 
     # ------------------------------------------------------------------- api
     def init(self, key, value):
-        """(reference: kvstore_local.h:40 Init)"""
+        """(reference: kvstore_local.h:40 Init). In dist mode the stored value
+        is rank 0's — the reference only pushes init values from rank 0
+        (kvstore_dist.h Init), so every worker starts from identical weights
+        regardless of local RNG state."""
         keys, values = _key_value(key, value)
         for k, v in zip(keys, values):
             if k in self._store:
                 raise MXNetError("duplicate init of key %s" % k)
-            self._store[k] = v.copy()
+            self._store[k] = self._broadcast_rank0(v.copy())
 
     def push(self, key, value, priority=0):
         """Reduce values per key; apply updater or replace
@@ -109,6 +112,19 @@ class KVStore:
         if "dist" in self._type:
             merged = self._allreduce(merged)
         return merged
+
+    def _broadcast_rank0(self, arr: NDArray) -> NDArray:
+        """Every worker adopts rank 0's value (dist init parity)."""
+        if "dist" not in self._type:
+            return arr
+        import jax
+
+        if jax.process_count() == 1:
+            return arr
+        from jax.experimental.multihost_utils import process_allgather
+
+        gathered = process_allgather(arr._jax())
+        return NDArray(gathered[0], ctx=arr.context)
 
     def _allreduce(self, arr: NDArray) -> NDArray:
         """Cross-process all-reduce for dist_tpu_sync over DCN/ICI."""
@@ -185,4 +201,10 @@ def create(name="local") -> KVStore:
              "dist_tpu_sync", "dist_sync", "dist_device_sync", "dist_async")
     if name not in known:
         raise MXNetError("unknown KVStore type %r (known: %s)" % (name, known))
+    if "dist" in name:
+        # join the job's coordination service if tools/launch.py spawned us
+        # (reference: KVStore::InitPSEnv consuming the DMLC_* cluster env)
+        from . import dist
+
+        dist.init()
     return KVStore(name)

@@ -168,26 +168,59 @@ def unpack(s):
     return header, payload
 
 
-def pack_img(header, img, quality=95, img_fmt=".jpg"):
-    """JPEG/PNG-encode an image array and pack it (reference: recordio.py
-    pack_img; requires cv2)."""
+def _encode_img(img, quality, img_fmt):
+    """Encode an HWC uint8 array to jpeg/png bytes: cv2 when present, else
+    PIL (this image ships PIL, not opencv)."""
     try:
         import cv2
-    except ImportError as e:
-        raise MXNetError("pack_img requires opencv (cv2)") from e
-    encode_params = [cv2.IMWRITE_JPEG_QUALITY, quality] if img_fmt in (".jpg", ".jpeg") else None
-    ret, buf = cv2.imencode(img_fmt, img, encode_params)
-    if not ret:
-        raise MXNetError("failed to encode image")
-    return pack(header, buf.tobytes())
+
+        params = [cv2.IMWRITE_JPEG_QUALITY, quality] if img_fmt in (".jpg", ".jpeg") else None
+        ret, buf = cv2.imencode(img_fmt, img, params)
+        if not ret:
+            raise MXNetError("failed to encode image")
+        return buf.tobytes()
+    except ImportError:
+        import io as _io
+
+        from PIL import Image
+
+        arr = np.asarray(img, dtype=np.uint8)
+        if arr.ndim == 3 and arr.shape[2] == 3:
+            arr = arr[:, :, ::-1]  # keep the cv2 BGR disk convention
+        pil = Image.fromarray(arr)
+        fmt = "JPEG" if img_fmt in (".jpg", ".jpeg") else img_fmt.lstrip(".").upper()
+        bio = _io.BytesIO()
+        pil.save(bio, format=fmt, quality=quality)
+        return bio.getvalue()
+
+
+def _decode_img(payload, iscolor):
+    try:
+        import cv2
+
+        return cv2.imdecode(np.frombuffer(payload, dtype=np.uint8), iscolor)
+    except ImportError:
+        import io as _io
+
+        from PIL import Image
+
+        pil = Image.open(_io.BytesIO(payload))
+        if iscolor == 0:
+            return np.asarray(pil.convert("L"))
+        if iscolor < 0 and pil.mode == "L":
+            # IMREAD_UNCHANGED semantics: grayscale stays (H, W)
+            return np.asarray(pil)
+        arr = np.asarray(pil.convert("RGB"))
+        return arr[:, :, ::-1]  # BGR, matching the cv2 convention
+
+
+def pack_img(header, img, quality=95, img_fmt=".jpg"):
+    """JPEG/PNG-encode an image array (HWC, BGR like cv2) and pack it
+    (reference: recordio.py pack_img)."""
+    return pack(header, _encode_img(img, quality, img_fmt))
 
 
 def unpack_img(s, iscolor=-1):
-    """(reference: recordio.py unpack_img; requires cv2)"""
-    try:
-        import cv2
-    except ImportError as e:
-        raise MXNetError("unpack_img requires opencv (cv2)") from e
+    """(reference: recordio.py unpack_img) — returns (header, HWC BGR array)."""
     header, payload = unpack(s)
-    img = cv2.imdecode(np.frombuffer(payload, dtype=np.uint8), iscolor)
-    return header, img
+    return header, _decode_img(payload, iscolor)

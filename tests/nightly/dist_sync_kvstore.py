@@ -1,0 +1,57 @@
+"""Closed-form multi-worker KVStore sync test.
+
+Counterpart of the reference's tests/nightly/dist_sync_kvstore.py:30-44
+(test_sync_push_pull): every worker pushes a deterministic value, and after
+the synchronized reduce the pulled result must equal the closed-form
+arithmetic — here sum over ranks of (rank+1)·scale per round.
+
+Run under the launcher (this is how the reference runs it, via
+tools/launch.py --launcher local):
+
+    python tools/launch.py -n 2 --launcher local --cpu-devices 1 \
+        python tests/nightly/dist_sync_kvstore.py
+"""
+import os
+import sys
+
+import numpy as np
+
+sys.path.insert(0, os.path.abspath(os.path.join(os.path.dirname(__file__), "..", "..")))
+import mxnet_tpu as mx  # noqa: E402
+
+SHAPE = (4, 5)
+BIG_SHAPE = (1200, 1100)  # > the reference's BIGARRAY_BOUND analog: exercise big arrays
+KEYS = ["3", "5", "7"]
+NUM_ROUNDS = 3
+
+
+def main():
+    kv = mx.kv.create("dist_tpu_sync")
+    rank, nworker = kv.rank, kv.num_workers
+    assert nworker >= 1
+
+    for key in KEYS:
+        kv.init(key, mx.nd.zeros(SHAPE))
+    kv.init("99", mx.nd.zeros(BIG_SHAPE))
+
+    # sum over all ranks of (rank+1) = nworker(nworker+1)/2
+    rank_sum = nworker * (nworker + 1) // 2
+
+    for r in range(1, NUM_ROUNDS + 1):
+        for key in KEYS:
+            kv.push(key, mx.nd.ones(SHAPE) * (rank + 1) * r)
+            out = mx.nd.zeros(SHAPE)
+            kv.pull(key, out=out)
+            expected = rank_sum * r  # no updater: push replaces with reduced sum
+            np.testing.assert_allclose(out.asnumpy(), expected, rtol=1e-6)
+        kv.push("99", mx.nd.ones(BIG_SHAPE) * (rank + 1) * r)
+        out = mx.nd.zeros(BIG_SHAPE)
+        kv.pull("99", out=out)
+        np.testing.assert_allclose(out.asnumpy(), rank_sum * r, rtol=1e-6)
+        kv._barrier()
+
+    print("dist_sync_kvstore rank %d/%d: all closed-form checks passed" % (rank, nworker))
+
+
+if __name__ == "__main__":
+    main()

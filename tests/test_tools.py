@@ -1,0 +1,83 @@
+"""Tools coverage: im2rec packing, parse_log, multi-process launcher + dist
+kvstore closed-form sync (fast version of tests/nightly/dist_sync_kvstore.py,
+which the reference runs via tools/launch.py --launcher local)."""
+import os
+import subprocess
+import sys
+import textwrap
+
+import numpy as np
+import pytest
+
+ROOT = os.path.abspath(os.path.join(os.path.dirname(__file__), ".."))
+
+
+def test_im2rec_pack_and_read(tmp_path):
+    from PIL import Image
+
+    from mxnet_tpu import recordio
+
+    root = tmp_path / "imgs"
+    for cls in ("a", "b"):
+        (root / cls).mkdir(parents=True)
+        rs = np.random.RandomState(hash(cls) % 2**31)
+        for i in range(3):
+            Image.fromarray(rs.randint(0, 255, (24, 30, 3), dtype=np.uint8)).save(
+                str(root / cls / ("%d.jpg" % i)))
+
+    prefix = str(tmp_path / "pack")
+    subprocess.run(
+        [sys.executable, os.path.join(ROOT, "tools", "im2rec.py"), prefix, str(root),
+         "--resize", "16", "--center-crop", "--shuffle", "0"],
+        check=True, cwd=ROOT)
+
+    rec = recordio.MXIndexedRecordIO(prefix + ".idx", prefix + ".rec", "r")
+    header, img = recordio.unpack_img(rec.read_idx(0))
+    assert img.shape == (16, 16, 3)
+    assert header.label == 0.0
+    header5, _ = recordio.unpack_img(rec.read_idx(5))
+    assert header5.label == 1.0
+
+
+def test_parse_log(tmp_path):
+    log = tmp_path / "train.log"
+    log.write_text(textwrap.dedent("""\
+        INFO:root:Epoch[0] Batch [4]\tSpeed: 1000.00 samples/sec\tTrain-accuracy=0.5
+        INFO:root:Epoch[0] Train-accuracy=0.600000
+        INFO:root:Epoch[0] Time cost=1.500
+        INFO:root:Epoch[0] Validation-accuracy=0.700000
+        INFO:root:Epoch[1] Batch [4]\tSpeed: 2000.00 samples/sec\tTrain-accuracy=0.8
+        INFO:root:Epoch[1] Train-accuracy=0.900000
+    """))
+    out = subprocess.run(
+        [sys.executable, os.path.join(ROOT, "tools", "parse_log.py"), str(log),
+         "--format", "csv"],
+        check=True, capture_output=True, text=True, cwd=ROOT).stdout
+    lines = out.strip().splitlines()
+    assert lines[0].startswith("epoch,")
+    assert "0.6" in lines[1] and "0.7" in lines[1]
+    assert "0.9" in lines[2]
+
+
+@pytest.mark.slow
+def test_launcher_dist_sync():
+    """2-worker closed-form kvstore sync through tools/launch.py."""
+    script = textwrap.dedent("""
+        import numpy as np
+        import mxnet_tpu as mx
+        kv = mx.kv.create("dist_tpu_sync")
+        kv.init("k", mx.nd.zeros((3, 2)))
+        kv.push("k", mx.nd.ones((3, 2)) * (kv.rank + 1))
+        out = mx.nd.zeros((3, 2))
+        kv.pull("k", out=out)
+        expected = kv.num_workers * (kv.num_workers + 1) / 2
+        np.testing.assert_allclose(out.asnumpy(), expected)
+        print("worker", kv.rank, "ok")
+    """)
+    env = {k: v for k, v in os.environ.items()
+           if k not in ("XLA_FLAGS", "MXNET_TPU_COORDINATOR")}
+    proc = subprocess.run(
+        [sys.executable, os.path.join(ROOT, "tools", "launch.py"), "-n", "2",
+         "--launcher", "local", "--cpu-devices", "1", sys.executable, "-c", script],
+        capture_output=True, text=True, timeout=300, cwd=ROOT, env=env)
+    assert proc.returncode == 0, proc.stderr[-2000:]

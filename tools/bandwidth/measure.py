@@ -1,0 +1,75 @@
+#!/usr/bin/env python
+"""All-reduce bandwidth microbenchmark.
+
+Counterpart of the reference's tools/bandwidth/measure.py (KVStore push/pull
+bandwidth over ps-lite). Here the reduction IS an XLA psum over the device
+mesh (ICI on a pod, host shared-memory on the virtual CPU mesh), so the
+measured quantity is collective bandwidth per chip:
+
+    algo_bw   = 2 * (n-1)/n * bytes / time   (ring all-reduce wire traffic)
+
+Run on N virtual CPU devices:
+    XLA_FLAGS=--xla_force_host_platform_device_count=8 MXNET_DEFAULT_CONTEXT=cpu \
+        python tools/bandwidth/measure.py --sizes 1,16,64
+"""
+import argparse
+import os
+import sys
+import time
+
+import numpy as np
+
+sys.path.insert(0, os.path.abspath(os.path.join(os.path.dirname(__file__), "..", "..")))
+import mxnet_tpu  # noqa: E402,F401  (honors MXNET_DEFAULT_CONTEXT=cpu platform forcing)
+
+
+def measure(size_mb, n_iter=10):
+    import jax
+    import jax.numpy as jnp
+    from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+    try:
+        from jax import shard_map
+    except ImportError:  # older jax
+        from jax.experimental.shard_map import shard_map
+
+    devs = jax.local_devices()
+    n = len(devs)
+    mesh = Mesh(np.array(devs), ("x",))
+    elems = int(size_mb * 1e6 / 4)
+    elems -= elems % max(n, 1)
+    x = jnp.ones((elems,), jnp.float32)
+    x = jax.device_put(x, NamedSharding(mesh, P("x")))
+
+    @jax.jit
+    def allreduce(v):
+        return shard_map(lambda s: jax.lax.psum(s, "x"), mesh=mesh,
+                         in_specs=P("x"), out_specs=P(None))(v)
+
+    out = allreduce(x)  # compile + warmup
+    jax.block_until_ready(out)
+    t0 = time.perf_counter()
+    for _ in range(n_iter):
+        out = allreduce(x)
+    jax.block_until_ready(out)
+    dt = (time.perf_counter() - t0) / n_iter
+    nbytes = elems * 4
+    algo_bw = 2 * (n - 1) / max(n, 1) * nbytes / dt / 1e9
+    return dt, algo_bw, n
+
+
+def main():
+    parser = argparse.ArgumentParser(description="all-reduce bandwidth")
+    parser.add_argument("--sizes", type=str, default="1,4,16,64",
+                        help="comma-separated MB sizes")
+    parser.add_argument("--iters", type=int, default=10)
+    args = parser.parse_args()
+
+    print("%8s %12s %12s" % ("size_MB", "time_ms", "busbw_GB/s"))
+    for size in (float(s) for s in args.sizes.split(",")):
+        dt, bw, n = measure(size, args.iters)
+        print("%8g %12.3f %12.2f   (%d devices)" % (size, dt * 1e3, bw, n))
+
+
+if __name__ == "__main__":
+    main()

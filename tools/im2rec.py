@@ -1,0 +1,128 @@
+#!/usr/bin/env python
+"""Pack an image directory into a RecordIO file.
+
+Counterpart of the reference's tools/im2rec.py (and the C++ tools/im2rec.cc):
+two modes, matching the reference CLI —
+
+  * ``--list``: walk an image root, write a ``.lst`` index
+    (``idx \\t label \\t relpath`` per line, labels from subdirectory order);
+  * pack: read a ``.lst``, encode/resize each image, write ``prefix.rec`` +
+    ``prefix.idx`` via MXIndexedRecordIO so ImageRecordIter can seek.
+
+Examples:
+    python tools/im2rec.py --list data/train data/imgs
+    python tools/im2rec.py --resize 256 --quality 90 data/train data/imgs
+"""
+import argparse
+import os
+import random
+import sys
+
+import numpy as np
+
+sys.path.insert(0, os.path.abspath(os.path.join(os.path.dirname(__file__), "..")))
+from mxnet_tpu import recordio  # noqa: E402
+
+EXTS = (".jpg", ".jpeg", ".png", ".bmp")
+
+
+def make_list(args):
+    """Walk image root → .lst lines (reference: im2rec.py make_list)."""
+    entries = []
+    label_names = sorted(
+        d for d in os.listdir(args.root) if os.path.isdir(os.path.join(args.root, d))
+    )
+    if label_names:
+        label_of = {name: i for i, name in enumerate(label_names)}
+        for name in label_names:
+            subdir = os.path.join(args.root, name)
+            for fn in sorted(os.listdir(subdir)):
+                if fn.lower().endswith(EXTS):
+                    entries.append((label_of[name], os.path.join(name, fn)))
+    else:  # flat directory: label 0
+        for fn in sorted(os.listdir(args.root)):
+            if fn.lower().endswith(EXTS):
+                entries.append((0, fn))
+    if args.shuffle:
+        random.Random(args.seed).shuffle(entries)
+    lst_path = args.prefix + ".lst"
+    with open(lst_path, "w") as f:
+        for idx, (label, rel) in enumerate(entries):
+            f.write("%d\t%f\t%s\n" % (idx, float(label), rel))
+    print("wrote %d entries to %s" % (len(entries), lst_path))
+
+
+def read_list(lst_path):
+    with open(lst_path) as f:
+        for line in f:
+            parts = line.strip().split("\t")
+            if len(parts) < 3:
+                continue
+            idx = int(parts[0])
+            labels = [float(x) for x in parts[1:-1]]
+            yield idx, labels, parts[-1]
+
+
+def _load_resized(path, args):
+    from PIL import Image
+
+    from mxnet_tpu import image as mximg
+
+    img = np.asarray(Image.open(path).convert("RGB"))
+    if args.resize:
+        img = mximg.resize_short(img, args.resize)
+    if args.center_crop:
+        s = min(img.shape[:2])
+        img = mximg.center_crop(img, (s, s))[0]
+    return img[:, :, ::-1]  # HWC BGR, the rec disk convention
+
+
+def pack_records(args):
+    rec = recordio.MXIndexedRecordIO(args.prefix + ".idx", args.prefix + ".rec", "w")
+    count = 0
+    for idx, labels, rel in read_list(args.prefix + ".lst"):
+        path = os.path.join(args.root, rel)
+        try:
+            img = _load_resized(path, args)
+        except Exception as e:  # unreadable image: skip, like the reference
+            print("skipping %s: %s" % (path, e), file=sys.stderr)
+            continue
+        label = labels[0] if len(labels) == 1 else np.array(labels, np.float32)
+        header = recordio.IRHeader(0, label, idx, 0)
+        rec.write_idx(idx, recordio.pack_img(header, img, quality=args.quality,
+                                             img_fmt=args.encoding))
+        count += 1
+        if count % 1000 == 0:
+            print("packed %d images" % count)
+    rec.close()
+    print("wrote %d records to %s.rec (+.idx)" % (count, args.prefix))
+
+
+def main():
+    parser = argparse.ArgumentParser(
+        description="Create an image list / RecordIO pack",
+        formatter_class=argparse.ArgumentDefaultsHelpFormatter)
+    parser.add_argument("prefix", help="prefix of the .lst/.rec/.idx files")
+    parser.add_argument("root", help="image root directory")
+    parser.add_argument("--list", action="store_true",
+                        help="create a .lst file instead of packing records")
+    parser.add_argument("--shuffle", type=int, default=1)
+    parser.add_argument("--seed", type=int, default=0)
+    parser.add_argument("--resize", type=int, default=0,
+                        help="resize the shorter edge to this size")
+    parser.add_argument("--center-crop", action="store_true")
+    parser.add_argument("--quality", type=int, default=95)
+    parser.add_argument("--encoding", type=str, default=".jpg",
+                        choices=[".jpg", ".png"])
+    args = parser.parse_args()
+
+    if args.list:
+        make_list(args)
+    else:
+        if not os.path.exists(args.prefix + ".lst"):
+            make_list(args)
+        pack_records(args)
+
+
+if __name__ == "__main__":
+    main()

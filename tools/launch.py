@@ -1,0 +1,145 @@
+#!/usr/bin/env python
+"""Launch a multi-worker training job.
+
+Counterpart of the reference's tools/launch.py (dmlc-core tracker submitting
+scheduler+server+worker processes over ssh/mpi/sge/yarn). The TPU-native job
+has no scheduler or server roles — every worker runs the same SPMD program —
+so launching means: start N copies of the command with the ``MXNET_TPU_*``
+coordination env (see mxnet_tpu/dist.py), worker 0 hosting the coordination
+service.
+
+Launchers:
+  * ``local`` — N processes on this host (the reference's ``--launcher local``
+    used by tests/nightly/dist_sync_kvstore.py). With ``--cpu-devices K`` each
+    worker gets K virtual CPU devices (testing without TPU hardware).
+  * ``ssh``  — one worker per host from --hostfile via ssh (reference's ssh
+    tracker); workers see the coordinator via this host's address.
+
+On real TPU pods the platform's own job scheduler (GKE/ICI runtime) starts
+one process per host and this launcher is unnecessary — pass the coordinator
+env directly.
+
+Example:
+  python tools/launch.py -n 4 --launcher local --cpu-devices 2 \
+      python tests/nightly/dist_sync_kvstore.py
+"""
+import argparse
+import os
+import signal
+import socket
+import subprocess
+import sys
+
+
+def _free_port():
+    with socket.socket() as s:
+        s.bind(("", 0))
+        return s.getsockname()[1]
+
+
+def _worker_env(base, args, coordinator, rank):
+    env = dict(base)
+    env["MXNET_TPU_COORDINATOR"] = coordinator
+    env["MXNET_TPU_NUM_WORKERS"] = str(args.num_workers)
+    env["MXNET_TPU_WORKER_ID"] = str(rank)
+    if args.cpu_devices:
+        flags = env.get("XLA_FLAGS", "")
+        env["XLA_FLAGS"] = (
+            flags + " --xla_force_host_platform_device_count=%d" % args.cpu_devices
+        ).strip()
+        env["MXNET_DEFAULT_CONTEXT"] = "cpu"
+    return env
+
+
+def _wait_all(procs):
+    """Wait for every worker; if one fails, terminate the rest instead of
+    blocking forever on survivors stuck in collective init."""
+    import time
+
+    code = 0
+    live = list(procs)
+    while live:
+        for p in list(live):
+            rc = p.poll()
+            if rc is None:
+                continue
+            live.remove(p)
+            if rc != 0:
+                code = code or rc
+                for q in live:
+                    if q.poll() is None:
+                        q.send_signal(signal.SIGTERM)
+        time.sleep(0.2)
+    return code
+
+
+def launch_local(args, command):
+    coordinator = "127.0.0.1:%d" % _free_port()
+    procs = []
+    try:
+        for rank in range(args.num_workers):
+            env = _worker_env(os.environ, args, coordinator, rank)
+            procs.append(subprocess.Popen(command, env=env))
+        return _wait_all(procs)
+    finally:
+        for p in procs:
+            if p.poll() is None:
+                p.send_signal(signal.SIGTERM)
+
+
+def launch_ssh(args, command):
+    with open(args.hostfile) as f:
+        hosts = [h.strip() for h in f if h.strip()]
+    assert len(hosts) >= args.num_workers, "hostfile has fewer hosts than -n"
+    # worker 0 hosts the coordination service, so advertise ITS address; the
+    # port cannot be probed remotely — use a fixed high port (reference's
+    # tracker likewise picks a port for the root role)
+    coordinator = "%s:%d" % (hosts[0], args.port)
+    procs = []
+    try:
+        for rank in range(args.num_workers):
+            import shlex
+
+            env = _worker_env({}, args, coordinator, rank)
+            envstr = " ".join("%s=%s" % (k, shlex.quote(v)) for k, v in env.items())
+            remote = "cd %s && env %s %s" % (
+                shlex.quote(os.getcwd()), envstr,
+                " ".join(shlex.quote(w) for w in command))
+            procs.append(subprocess.Popen(["ssh", "-o",
+                                           "StrictHostKeyChecking=no",
+                                           hosts[rank], remote]))
+        return _wait_all(procs)
+    finally:
+        for p in procs:
+            if p.poll() is None:
+                p.send_signal(signal.SIGTERM)
+
+
+def main():
+    parser = argparse.ArgumentParser(
+        description="Launch a multi-worker mxnet_tpu job",
+        formatter_class=argparse.ArgumentDefaultsHelpFormatter)
+    parser.add_argument("-n", "--num-workers", type=int, required=True,
+                        help="number of worker processes")
+    parser.add_argument("--launcher", type=str, default="local",
+                        choices=["local", "ssh"])
+    parser.add_argument("--hostfile", type=str, default=None,
+                        help="(ssh) file with one host per line")
+    parser.add_argument("--port", type=int, default=29400,
+                        help="(ssh) coordination-service port on the first host")
+    parser.add_argument("--cpu-devices", type=int, default=0,
+                        help="give each worker this many virtual CPU devices "
+                             "(multi-host testing without TPU hardware)")
+    parser.add_argument("command", nargs=argparse.REMAINDER,
+                        help="the training command to run on every worker")
+    args = parser.parse_args()
+    if not args.command:
+        parser.error("no command given")
+
+    if args.launcher == "local":
+        sys.exit(launch_local(args, args.command))
+    sys.exit(launch_ssh(args, args.command))
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,67 @@
+#!/usr/bin/env python
+"""Parse a training log into a per-epoch table.
+
+Counterpart of the reference's tools/parse_log.py: reads the logging format
+emitted by fit.py/Speedometer and prints markdown with train/val accuracy and
+mean speed per epoch.
+
+    python tools/parse_log.py train.log [--format markdown|csv]
+"""
+import argparse
+import re
+import sys
+from collections import defaultdict
+
+
+EPOCH_METRIC = re.compile(
+    r"Epoch\[(\d+)\] (Train|Validation)-([\w-]+)=([\d.eE+-]+)")
+SPEED = re.compile(r"Epoch\[(\d+)\] Batch \[\d+\]\s+Speed: ([\d.]+) samples/sec")
+TIME = re.compile(r"Epoch\[(\d+)\] Time cost=([\d.]+)")
+
+
+def parse(fname):
+    rows = defaultdict(dict)
+    speeds = defaultdict(list)
+    with open(fname) as f:
+        for line in f:
+            m = EPOCH_METRIC.search(line)
+            if m:
+                ep, phase, metric, val = m.groups()
+                rows[int(ep)]["%s-%s" % (phase.lower(), metric)] = float(val)
+            m = SPEED.search(line)
+            if m:
+                speeds[int(m.group(1))].append(float(m.group(2)))
+            m = TIME.search(line)
+            if m:
+                rows[int(m.group(1))]["time"] = float(m.group(2))
+    for ep, sp in speeds.items():
+        rows[ep]["speed"] = sum(sp) / len(sp)
+    return rows
+
+
+def main():
+    parser = argparse.ArgumentParser(description="parse a training log")
+    parser.add_argument("logfile")
+    parser.add_argument("--format", choices=["markdown", "csv"], default="markdown")
+    args = parser.parse_args()
+
+    rows = parse(args.logfile)
+    if not rows:
+        print("no epochs found in %s" % args.logfile, file=sys.stderr)
+        sys.exit(1)
+    cols = sorted({k for r in rows.values() for k in r})
+    if args.format == "markdown":
+        print("| epoch | " + " | ".join(cols) + " |")
+        print("| --- | " + " | ".join("---" for _ in cols) + " |")
+        for ep in sorted(rows):
+            cells = ["%.6g" % rows[ep][c] if c in rows[ep] else "" for c in cols]
+            print("| %d | " % ep + " | ".join(cells) + " |")
+    else:
+        print("epoch," + ",".join(cols))
+        for ep in sorted(rows):
+            print("%d," % ep + ",".join(
+                "%.6g" % rows[ep][c] if c in rows[ep] else "" for c in cols))
+
+
+if __name__ == "__main__":
+    main()
