@@ -71,6 +71,9 @@ def __getattr__(name):
         "kvstore": ".kvstore",
         "kv": ".kvstore",
         "dist": ".dist",
+        "engine": ".engine",
+        "predictor": ".predictor",
+        "rtc": ".rtc",
         "callback": ".callback",
         "monitor": ".monitor",
         "mon": ".monitor",

@@ -160,6 +160,18 @@ class KVStore:
 
                 sync_global_devices("mxnet_tpu_kvstore_barrier")
 
+    def get_num_dead_node(self, node_id=0, timeout=3):
+        """Dead-worker count (reference: kvstore.h:234-244 — a ps-lite
+        heartbeat scan, meaningful because that topology tolerated dead
+        workers). The SPMD runtime is gang-scheduled (SURVEY.md §5.3): the
+        JAX coordination service heartbeats every process itself and a dead
+        peer aborts the whole job with a runtime error rather than leaving it
+        degraded. So while this process is running, the worker set is by
+        construction fully live — return 0. Failure recovery is
+        checkpoint-resume (``mx.model.resume_or_init``), not elastic
+        membership."""
+        return 0
+
     def save_optimizer_states(self, fname):
         assert self._updater is not None, "Cannot save states for distributed training"
         with open(fname, "wb") as fout:

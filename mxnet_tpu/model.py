@@ -25,7 +25,8 @@ try:
 except ImportError:
     pass
 
-__all__ = ["save_checkpoint", "load_checkpoint", "FeedForward"]
+__all__ = ["save_checkpoint", "load_checkpoint", "find_last_checkpoint",
+           "resume_or_init", "FeedForward"]
 
 
 def save_checkpoint(prefix, epoch, symbol, arg_params, aux_params):
@@ -37,6 +38,38 @@ def save_checkpoint(prefix, epoch, symbol, arg_params, aux_params):
     param_name = "%s-%04d.params" % (prefix, epoch)
     nd.save(param_name, save_dict)
     logging.info('Saved checkpoint to "%s"', param_name)
+
+
+def find_last_checkpoint(prefix):
+    """Latest saved epoch for ``prefix``, or None. Backs crash-resume
+    (SURVEY.md §5.3/§5.4: failure recovery on gang-scheduled pods is
+    checkpoint-resume, not elastic membership)."""
+    import glob
+    import re
+
+    best = None
+    for path in glob.glob(glob.escape(prefix) + "-*.params"):
+        m = re.search(r"-(\d{4,})\.params$", path)
+        if m:
+            ep = int(m.group(1))
+            best = ep if best is None else max(best, ep)
+    return best
+
+
+def resume_or_init(prefix):
+    """(begin_epoch, arg_params, aux_params) from the newest checkpoint, or
+    (0, None, None) when none exists — feed straight into ``Module.fit``::
+
+        begin, args, auxs = mx.model.resume_or_init("ckpt/resnet")
+        mod.fit(..., begin_epoch=begin, arg_params=args, aux_params=auxs,
+                epoch_end_callback=mx.callback.do_checkpoint("ckpt/resnet"))
+    """
+    last = find_last_checkpoint(prefix)
+    if last is None:
+        return 0, None, None
+    _, arg_params, aux_params = load_checkpoint(prefix, last)
+    logging.info("Resuming from %s epoch %d", prefix, last)
+    return last, arg_params, aux_params
 
 
 def load_checkpoint(prefix, epoch):

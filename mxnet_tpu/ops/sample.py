@@ -107,3 +107,89 @@ _reg_sampler(
     _gen_neg_binomial,
     aliases=("_sample_gennegbinomial", "generalized_negative_binomial"),
 )
+
+
+# ---------------------------------------------------------------- multisample
+# Reference: src/operator/tensor/multisample_op.* — per-row distribution
+# parameters come as input arrays of shape (n,) (or (n, m)); output is
+# params.shape + shape. TPU-native: one vectorized draw with the parameter
+# arrays broadcast against the trailing sample axes (no per-row loop — the
+# whole batch lowers to a single fused XLA kernel).
+
+
+def _bshape(param, shape):
+    # empty shape attr → output shape == params shape (reference:
+    # tensor/multisample_op.h default TShape)
+    return tuple(param.shape) + tuple(shape)
+
+
+def _expand(param, shape):
+    return jnp.reshape(param, tuple(param.shape) + (1,) * len(tuple(shape)))
+
+
+def _reg_multisample(name, input_names, draw):
+    def fn(attrs, *inputs, rng=None):
+        shape = tuple(attrs["shape"])
+        dtype = attrs["dtype"] if attrs["dtype"] is not None else inputs[0].dtype
+        if rng is None:
+            rng = jax.random.PRNGKey(np.random.randint(0, 2**31 - 1))
+        return draw(rng, shape, dtype, *inputs)
+
+    fn.__doc__ = ("Per-row parameterized samples (reference: "
+                  "tensor/multisample_op.cc %s)." % name)
+    register(
+        name,
+        attrs={"shape": AttrSpec("shape", default=()),
+               "dtype": AttrSpec("dtype", default=None)},
+        input_names=input_names,
+        needs_rng=True,
+    )(fn)
+
+
+_reg_multisample(
+    "sample_uniform", ("low", "high"),
+    lambda k, s, d, low, high: _expand(low, s) + (_expand(high, s) - _expand(low, s))
+    * jax.random.uniform(k, _bshape(low, s), dtype=d),
+)
+_reg_multisample(
+    "sample_normal", ("mu", "sigma"),
+    lambda k, s, d, mu, sigma: _expand(mu, s) + _expand(sigma, s)
+    * jax.random.normal(k, _bshape(mu, s), dtype=d),
+)
+_reg_multisample(
+    "sample_gamma", ("alpha", "beta"),
+    lambda k, s, d, alpha, beta: _expand(beta, s)
+    * jax.random.gamma(k, _expand(alpha, s), _bshape(alpha, s), dtype=d),
+)
+_reg_multisample(
+    "sample_exponential", ("lam",),
+    lambda k, s, d, lam: jax.random.exponential(k, _bshape(lam, s), dtype=d)
+    / _expand(lam, s),
+)
+_reg_multisample(
+    "sample_poisson", ("lam",),
+    lambda k, s, d, lam: jax.random.poisson(k, _expand(lam, s),
+                                            _bshape(lam, s)).astype(d or jnp.float32),
+)
+
+
+def _ms_negbinomial(k, s, d, kparam, p):
+    k1, k2 = jax.random.split(k)
+    lam = jax.random.gamma(k1, _expand(kparam, s), _bshape(kparam, s)) \
+        * (1.0 - _expand(p, s)) / _expand(p, s)
+    return jax.random.poisson(k2, lam, _bshape(kparam, s)).astype(d or jnp.float32)
+
+
+_reg_multisample("sample_negative_binomial", ("k", "p"), _ms_negbinomial)
+
+
+def _ms_gen_negbinomial(k, s, d, mu, alpha):
+    k1, k2 = jax.random.split(k)
+    r = 1.0 / jnp.maximum(_expand(alpha, s), 1e-8)
+    p = r / (r + _expand(mu, s))
+    lam = jax.random.gamma(k1, r, _bshape(mu, s)) * (1.0 - p) / p
+    return jax.random.poisson(k2, lam, _bshape(mu, s)).astype(d or jnp.float32)
+
+
+_reg_multisample("sample_generalized_negative_binomial", ("mu", "alpha"),
+                 _ms_gen_negbinomial)

@@ -1,0 +1,126 @@
+"""Deployment predictor: minimal inference API over a saved checkpoint.
+
+Counterpart of the reference's C predict API (include/mxnet/c_predict_api.h,
+src/c_api/c_predict_api.cc: MXPredCreate / MXPredSetInput / MXPredForward /
+MXPredGetOutput / MXPredReshape) — the surface its amalgamation/mobile builds
+ship. TPU-native: "create" compiles the whole inference graph into one XLA
+executable at bind time; reshape re-binds (recompiles once per new shape,
+then cached by XLA's compile cache).
+
+    pred = Predictor(open("m-symbol.json").read(), open("m-0010.params","rb").read(),
+                     {"data": (1, 3, 224, 224)})
+    pred.forward(data=batch)
+    probs = pred.get_output(0)
+"""
+from __future__ import annotations
+
+from typing import Dict, Sequence
+
+import numpy as np
+
+from .base import MXNetError
+from . import ndarray as nd
+from . import symbol as sym
+
+__all__ = ["Predictor", "load_ndarray_file"]
+
+
+def load_ndarray_file(binary: bytes):
+    """Parse a .params blob into {name: NDArray} (reference:
+    MXNDListCreate, c_predict_api.cc)."""
+    import io as _io
+
+    return nd._load_stream(_io.BytesIO(binary)) if hasattr(nd, "_load_stream") \
+        else _load_params_bytes(binary)
+
+
+def _load_params_bytes(binary: bytes):
+    import os
+    import tempfile
+
+    fd, path = tempfile.mkstemp(suffix=".params")
+    try:
+        with os.fdopen(fd, "wb") as f:
+            f.write(binary)
+        return nd.load(path)
+    finally:
+        os.unlink(path)
+
+
+class Predictor:
+    """(reference: c_predict_api.h MXPredCreate → PredictorHandle)"""
+
+    def __init__(self, symbol_json: str, param_bytes: bytes,
+                 input_shapes: Dict[str, Sequence[int]], ctx=None,
+                 output_names=None):
+        net = sym.load_json(symbol_json)
+        if output_names:  # MXPredCreatePartialOut semantics
+            outputs = net.list_outputs()
+            chosen = []
+            for name in output_names:
+                if name not in outputs:
+                    raise MXNetError("output %r not in %s" % (name, outputs))
+                chosen.append(net[outputs.index(name)])
+            net = sym.Group(chosen)
+        self._sym = net
+        params = load_ndarray_file(param_bytes) if param_bytes else {}
+        # the saved dict uses the reference's "arg:name"/"aux:name" prefixes
+        self._arg_params, self._aux_params = {}, {}
+        for k, v in params.items():
+            if k.startswith("arg:"):
+                self._arg_params[k[4:]] = v
+            elif k.startswith("aux:"):
+                self._aux_params[k[4:]] = v
+            else:
+                self._arg_params[k] = v
+        from .context import current_context
+
+        self._ctx = ctx or current_context()
+        self._input_shapes = {k: tuple(v) for k, v in input_shapes.items()}
+        self._bind()
+
+    def _bind(self):
+        arg_names = self._sym.list_arguments()
+        shapes = dict(self._input_shapes)
+        for k, v in self._arg_params.items():
+            if k in arg_names and k not in shapes:
+                shapes[k] = v.shape
+        self._exe = self._sym.simple_bind(self._ctx, grad_req="null", **shapes)
+        for k, v in self._arg_params.items():
+            if k in self._exe.arg_dict:
+                self._exe.arg_dict[k][:] = v
+        for k, v in self._aux_params.items():
+            if k in self._exe.aux_dict:
+                self._exe.aux_dict[k][:] = v
+        self._dirty = False
+
+    def set_input(self, key, data):
+        """(reference: MXPredSetInput)"""
+        if key not in self._input_shapes:
+            raise MXNetError("unknown input %r" % key)
+        self._exe.arg_dict[key][:] = np.asarray(data, np.float32)
+
+    def forward(self, **inputs):
+        """(reference: MXPredForward; kwargs are a convenience over
+        set_input + forward)"""
+        for k, v in inputs.items():
+            self.set_input(k, v)
+        self._exe.forward(is_train=False)
+
+    def reshape(self, new_input_shapes):
+        """(reference: MXPredReshape) — re-bind with new shapes; the old
+        executable stays in XLA's compile cache."""
+        self._input_shapes.update({k: tuple(v) for k, v in new_input_shapes.items()})
+        # preserve current (possibly updated) params
+        for k in self._arg_params:
+            if k in self._exe.arg_dict:
+                self._arg_params[k] = self._exe.arg_dict[k].copy()
+        self._bind()
+
+    def get_output(self, index) -> np.ndarray:
+        """(reference: MXPredGetOutput — copies out to host)"""
+        return self._exe.outputs[index].asnumpy()
+
+    @property
+    def num_outputs(self):
+        return len(self._exe.outputs)

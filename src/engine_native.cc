@@ -1,0 +1,268 @@
+// Native dependency engine: async host-side scheduler with var-based
+// read/write dependency tracking.
+//
+// TPU-native counterpart of the reference's engine layer
+// (include/mxnet/engine.h:37-229, src/engine/threaded_engine.{h,cc},
+// threaded_engine_perdevice.cc). On TPU the *device* scheduling job — stream
+// ordering, kernel overlap — belongs to XLA/PJRT async dispatch, so this
+// engine schedules the HOST side of the runtime: data-pipeline stages,
+// checkpoint writes, callback fans, anything expressed as "run fn when these
+// vars' pending writes drain". The dependency discipline matches the
+// reference: readers of a var run concurrently between writes, writers
+// serialize in push order (threaded_engine.h ThreadedVar AppendRead/Write).
+//
+// Differences by design, not omission: no per-device worker pools (host work
+// only — one pool; device pools are XLA's), no FnProperty/priority lanes
+// (XLA orders device work by data dependency), vars are int64 handles not
+// pointers (ctypes-friendly ABI).
+//
+// Scheduling model: each var keeps a FIFO of pending ops. An op is eligible
+//   - as a reader of v: no running writer on v and nothing but readers ahead
+//     of it in v's queue;
+//   - as a writer of v: v fully idle and the op is at v's queue head.
+// An op runs when eligible on ALL its vars; claiming removes it from every
+// queue and marks it running, so per-var eligibility is monotone until claim
+// (new pushes only append). Completion re-scans affected queues.
+//
+// Exported C ABI (ctypes, see mxnet_tpu/engine.py):
+//   mxeng_create(num_workers) -> handle
+//   mxeng_new_var(h) -> var id
+//   mxeng_push(h, fn, arg, const_vars*, n_const, mut_vars*, n_mut)
+//   mxeng_wait_for_var(h, var)
+//   mxeng_wait_for_all(h)
+//   mxeng_pending(h) -> number of unfinished ops
+//   mxeng_destroy(h)
+
+#include <condition_variable>
+#include <cstdint>
+#include <deque>
+#include <memory>
+#include <mutex>
+#include <thread>
+#include <unordered_map>
+#include <vector>
+
+namespace {
+
+typedef void (*OpFn)(void*);
+
+struct Op {
+  OpFn fn;
+  void* arg;
+  std::vector<int64_t> const_vars;
+  std::vector<int64_t> mut_vars;
+
+  bool reads(int64_t v) const {
+    for (int64_t c : const_vars)
+      if (c == v) return true;
+    return false;
+  }
+};
+
+struct Var {
+  std::deque<Op*> queue;   // pending ops, program order
+  int running_readers = 0;
+  bool writer_running = false;
+
+  bool idle() const {
+    return queue.empty() && running_readers == 0 && !writer_running;
+  }
+};
+
+class Engine {
+ public:
+  explicit Engine(int num_workers) {
+    if (num_workers < 1) num_workers = 1;
+    for (int i = 0; i < num_workers; ++i)
+      workers_.emplace_back([this] { WorkerLoop(); });
+  }
+
+  ~Engine() {
+    WaitForAll();
+    {
+      std::unique_lock<std::mutex> lk(mu_);
+      shutdown_ = true;
+      ready_cv_.notify_all();
+    }
+    for (auto& t : workers_) t.join();
+  }
+
+  int64_t NewVar() {
+    std::unique_lock<std::mutex> lk(mu_);
+    int64_t id = next_var_++;
+    vars_.emplace(id, std::make_unique<Var>());
+    return id;
+  }
+
+  void Push(OpFn fn, void* arg, const int64_t* cvars, int nc,
+            const int64_t* mvars, int nm) {
+    auto* op = new Op{fn, arg, {}, {}};
+    // dedup; a var both read and mutated counts as mutated only (the
+    // reference's CheckDuplicate rejects overlap; we resolve it)
+    op->mut_vars.reserve(nm);
+    for (int i = 0; i < nm; ++i) {
+      bool dup = false;
+      for (int64_t seen : op->mut_vars)
+        if (seen == mvars[i]) { dup = true; break; }
+      if (!dup) op->mut_vars.push_back(mvars[i]);
+    }
+    op->const_vars.reserve(nc);
+    for (int i = 0; i < nc; ++i) {
+      bool dup = false;
+      for (int64_t seen : op->mut_vars)
+        if (seen == cvars[i]) { dup = true; break; }
+      for (int64_t seen : op->const_vars)
+        if (seen == cvars[i]) { dup = true; break; }
+      if (!dup) op->const_vars.push_back(cvars[i]);
+    }
+    std::unique_lock<std::mutex> lk(mu_);
+    ++pending_;
+    for (int64_t v : op->const_vars) GetVar(v)->queue.push_back(op);
+    for (int64_t v : op->mut_vars) GetVar(v)->queue.push_back(op);
+    TryClaim(op);
+  }
+
+  void WaitForVar(int64_t var) {
+    std::unique_lock<std::mutex> lk(mu_);
+    Var* v = GetVar(var);
+    done_cv_.wait(lk, [&] { return v->idle(); });
+  }
+
+  void WaitForAll() {
+    std::unique_lock<std::mutex> lk(mu_);
+    done_cv_.wait(lk, [&] { return pending_ == 0; });
+  }
+
+  int64_t Pending() {
+    std::unique_lock<std::mutex> lk(mu_);
+    return pending_;
+  }
+
+ private:
+  Var* GetVar(int64_t id) {
+    auto it = vars_.find(id);
+    if (it == vars_.end())
+      it = vars_.emplace(id, std::make_unique<Var>()).first;
+    return it->second.get();
+  }
+
+  // mu_ held. Eligibility of `op` on one of its vars.
+  bool Eligible(int64_t vid, Op* op) {
+    Var* v = GetVar(vid);
+    if (v->writer_running) return false;
+    bool as_reader = op->reads(vid);
+    if (!as_reader && v->running_readers > 0) return false;
+    for (Op* q : v->queue) {
+      if (q == op) return true;           // nothing blocking ahead
+      if (!as_reader) return false;       // writers claim only from the head
+      if (!q->reads(vid)) return false;   // a writer is queued ahead
+    }
+    return false;  // op not queued on this var (claimed elsewhere) — bug guard
+  }
+
+  // mu_ held. Claim + enqueue to ready if eligible everywhere.
+  void TryClaim(Op* op) {
+    for (int64_t vid : op->const_vars)
+      if (!Eligible(vid, op)) return;
+    for (int64_t vid : op->mut_vars)
+      if (!Eligible(vid, op)) return;
+    for (int64_t vid : op->const_vars) {
+      Var* v = GetVar(vid);
+      ++v->running_readers;
+      Remove(v, op);
+    }
+    for (int64_t vid : op->mut_vars) {
+      Var* v = GetVar(vid);
+      v->writer_running = true;
+      Remove(v, op);
+    }
+    ready_.push_back(op);
+    ready_cv_.notify_one();
+  }
+
+  static void Remove(Var* v, Op* op) {
+    for (auto it = v->queue.begin(); it != v->queue.end(); ++it)
+      if (*it == op) {
+        v->queue.erase(it);
+        return;
+      }
+  }
+
+  // mu_ held. After a var's state change, walk its queue: try the leading
+  // run of readers (each may be blocked elsewhere — skipping is safe, queue
+  // order between readers is free), stop at the first writer, trying it
+  // only if it heads the queue.
+  void RescanVar(int64_t vid) {
+    Var* v = GetVar(vid);
+    // snapshot: TryClaim mutates the queue while we walk
+    std::vector<Op*> snapshot(v->queue.begin(), v->queue.end());
+    for (Op* q : snapshot) {
+      if (q->reads(vid)) {
+        TryClaim(q);
+      } else {
+        TryClaim(q);
+        break;  // ops behind a queued writer stay blocked on this var
+      }
+    }
+  }
+
+  void OnComplete(Op* op) {
+    std::unique_lock<std::mutex> lk(mu_);
+    for (int64_t vid : op->const_vars) --GetVar(vid)->running_readers;
+    for (int64_t vid : op->mut_vars) GetVar(vid)->writer_running = false;
+    for (int64_t vid : op->const_vars) RescanVar(vid);
+    for (int64_t vid : op->mut_vars) RescanVar(vid);
+    --pending_;
+    delete op;
+    done_cv_.notify_all();
+  }
+
+  void WorkerLoop() {
+    for (;;) {
+      Op* op;
+      {
+        std::unique_lock<std::mutex> lk(mu_);
+        ready_cv_.wait(lk, [&] { return shutdown_ || !ready_.empty(); });
+        if (shutdown_ && ready_.empty()) return;
+        op = ready_.front();
+        ready_.pop_front();
+      }
+      op->fn(op->arg);
+      OnComplete(op);
+    }
+  }
+
+  std::mutex mu_;
+  std::condition_variable ready_cv_, done_cv_;
+  std::deque<Op*> ready_;
+  std::unordered_map<int64_t, std::unique_ptr<Var>> vars_;
+  std::vector<std::thread> workers_;
+  int64_t next_var_ = 1;
+  int64_t pending_ = 0;
+  bool shutdown_ = false;
+};
+
+}  // namespace
+
+extern "C" {
+
+void* mxeng_create(int num_workers) { return new Engine(num_workers); }
+
+int64_t mxeng_new_var(void* h) { return static_cast<Engine*>(h)->NewVar(); }
+
+void mxeng_push(void* h, void (*fn)(void*), void* arg, const int64_t* cvars,
+                int nc, const int64_t* mvars, int nm) {
+  static_cast<Engine*>(h)->Push(fn, arg, cvars, nc, mvars, nm);
+}
+
+void mxeng_wait_for_var(void* h, int64_t var) {
+  static_cast<Engine*>(h)->WaitForVar(var);
+}
+
+void mxeng_wait_for_all(void* h) { static_cast<Engine*>(h)->WaitForAll(); }
+
+int64_t mxeng_pending(void* h) { return static_cast<Engine*>(h)->Pending(); }
+
+void mxeng_destroy(void* h) { delete static_cast<Engine*>(h); }
+
+}  // extern "C"

@@ -1,0 +1,174 @@
+"""Predictor (c_predict_api parity), rtc Pallas kernels, multisample ops,
+PythonModule, checkpoint auto-resume, failure-detection probe."""
+import os
+
+import numpy as np
+import pytest
+
+import mxnet_tpu as mx
+from mxnet_tpu import models
+
+
+def test_predictor_matches_module(tmp_path):
+    """Save a trained-ish lenet checkpoint, reload through Predictor, and
+    match Module.predict outputs (reference: c_predict_api flow)."""
+    net = models.get_symbol("lenet", num_classes=3)
+    mod = mx.mod.Module(net, context=mx.cpu())
+    mod.bind(data_shapes=[("data", (2, 1, 28, 28))],
+             label_shapes=[("softmax_label", (2,))])
+    mod.init_params(initializer=mx.init.Xavier())
+    prefix = str(tmp_path / "m")
+    mod.save_checkpoint(prefix, 1)
+
+    rs = np.random.RandomState(0)
+    x = rs.rand(2, 1, 28, 28).astype("float32")
+
+    from mxnet_tpu.predictor import Predictor
+
+    with open(prefix + "-symbol.json") as f:
+        sym_json = f.read()
+    with open(prefix + "-0001.params", "rb") as f:
+        params = f.read()
+    pred = Predictor(sym_json, params, {"data": (2, 1, 28, 28)}, ctx=mx.cpu())
+    pred.forward(data=x)
+    out = pred.get_output(0)
+
+    batch = mx.io.DataBatch(data=[mx.nd.array(x)], label=None, pad=0)
+    mod_infer = mx.mod.Module(net, context=mx.cpu(), label_names=None)
+    mod_infer.bind(data_shapes=[("data", (2, 1, 28, 28))], for_training=False)
+    arg_p, aux_p = mod.get_params()
+    mod_infer.set_params(arg_p, aux_p, allow_missing=True)
+    mod_infer.forward(batch, is_train=False)
+    want = mod_infer.get_outputs()[0].asnumpy()
+    np.testing.assert_allclose(out, want, rtol=1e-5, atol=1e-6)
+    assert pred.num_outputs == 1
+
+
+def test_predictor_reshape(tmp_path):
+    net = models.get_symbol("mlp", num_classes=4)
+    mod = mx.mod.Module(net, context=mx.cpu())
+    mod.bind(data_shapes=[("data", (2, 16))], label_shapes=[("softmax_label", (2,))])
+    mod.init_params(initializer=mx.init.Xavier())
+    prefix = str(tmp_path / "m")
+    mod.save_checkpoint(prefix, 0)
+
+    from mxnet_tpu.predictor import Predictor
+
+    pred = Predictor(open(prefix + "-symbol.json").read(),
+                     open(prefix + "-0000.params", "rb").read(),
+                     {"data": (2, 16)}, ctx=mx.cpu())
+    pred.forward(data=np.zeros((2, 16), "float32"))
+    first = pred.get_output(0)
+    pred.reshape({"data": (5, 16)})
+    pred.forward(data=np.zeros((5, 16), "float32"))
+    second = pred.get_output(0)
+    assert second.shape == (5, 4)
+    np.testing.assert_allclose(second[0], first[0], rtol=1e-5)
+
+
+def test_rtc_pallas_kernel():
+    src = """
+def kernel(x_ref, o_ref):
+    o_ref[:] = x_ref[:] * 2.0 + 1.0
+"""
+    k = mx.rtc.Rtc("axpb", src)
+    x = mx.nd.array(np.arange(8, dtype="float32").reshape(2, 4))
+    (y,) = k.push([x], out_shapes=[(2, 4)])
+    np.testing.assert_allclose(y.asnumpy(), x.asnumpy() * 2 + 1)
+
+
+def test_rtc_two_inputs():
+    src = """
+def kernel(a_ref, b_ref, o_ref):
+    o_ref[:] = a_ref[:] + b_ref[:] * 3.0
+"""
+    k = mx.rtc.Rtc("fma", src)
+    a = mx.nd.ones((4, 4))
+    b = mx.nd.ones((4, 4))
+    (y,) = k.push([a, b], out_shapes=[(4, 4)])
+    np.testing.assert_allclose(y.asnumpy(), 4.0)
+
+
+def test_rtc_bad_source_raises():
+    with pytest.raises(mx.MXNetError):
+        mx.rtc.Rtc("broken", "def kernel(x_ref, o_ref:\n  pass")
+
+
+def test_multisample_moments():
+    rs = np.random.RandomState(0)
+    mu = mx.nd.array(np.array([0.0, 5.0], "float32"))
+    sigma = mx.nd.array(np.array([1.0, 0.1], "float32"))
+    s = mx.nd.sample_normal(mu, sigma, shape=(4000,)).asnumpy()
+    assert s.shape == (2, 4000)
+    assert abs(s[0].mean()) < 0.1 and abs(s[1].mean() - 5.0) < 0.05
+    assert abs(s[0].std() - 1.0) < 0.1 and abs(s[1].std() - 0.1) < 0.02
+
+    lam = mx.nd.array(np.array([1.0, 8.0], "float32"))
+    p = mx.nd.sample_poisson(lam, shape=(4000,)).asnumpy()
+    assert abs(p[0].mean() - 1.0) < 0.2 and abs(p[1].mean() - 8.0) < 0.5
+
+
+def test_multisample_empty_shape_matches_params():
+    # reference semantics (multisample_op.h): empty shape → output == params
+    low = mx.nd.array(np.zeros(3, "float32"))
+    high = mx.nd.array(np.ones(3, "float32"))
+    s = mx.nd.sample_uniform(low, high)
+    assert s.shape == (3,)
+
+
+def test_rtc_more_outputs_than_inputs():
+    src = """
+def kernel(x_ref, o1_ref, o2_ref):
+    o1_ref[:] = x_ref[:] + 1.0
+    o2_ref[:] = x_ref[:] - 1.0
+"""
+    k = mx.rtc.Rtc("split", src)
+    x = mx.nd.ones((2, 2))
+    y1, y2 = k.push([x], out_shapes=[(2, 2), (2, 2)])
+    np.testing.assert_allclose(y1.asnumpy(), 2.0)
+    np.testing.assert_allclose(y2.asnumpy(), 0.0)
+
+
+def test_python_loss_module():
+    from mxnet_tpu.module import PythonLossModule
+
+    mod = PythonLossModule(grad_func=lambda scores, labels:
+                           scores.asnumpy() - labels.asnumpy())
+    mod.bind(data_shapes=[("data", (4, 3))], label_shapes=[("softmax_label", (4, 3))])
+    mod.init_params()
+    rs = np.random.RandomState(0)
+    scores = rs.rand(4, 3).astype("float32")
+    labels = rs.rand(4, 3).astype("float32")
+    batch = mx.io.DataBatch(data=[mx.nd.array(scores)],
+                            label=[mx.nd.array(labels)], pad=0)
+    mod.forward(batch, is_train=True)
+    np.testing.assert_allclose(mod.get_outputs()[0].asnumpy(), scores)
+    mod.backward()
+    np.testing.assert_allclose(mod.get_input_grads()[0].asnumpy(),
+                               scores - labels, rtol=1e-6)
+
+
+def test_resume_or_init(tmp_path):
+    prefix = str(tmp_path / "ck")
+    begin, args, auxs = mx.model.resume_or_init(prefix)
+    assert (begin, args, auxs) == (0, None, None)
+
+    net = models.get_symbol("mlp", num_classes=2)
+    mod = mx.mod.Module(net, context=mx.cpu())
+    mod.bind(data_shapes=[("data", (2, 8))], label_shapes=[("softmax_label", (2,))])
+    mod.init_params(initializer=mx.init.Xavier())
+    mod.save_checkpoint(prefix, 3)
+    mod.save_checkpoint(prefix, 7)
+
+    begin, args, auxs = mx.model.resume_or_init(prefix)
+    assert begin == 7 and args
+    ref, _ = mod.get_params()
+    np.testing.assert_allclose(args[sorted(args)[0]].asnumpy(),
+                               ref[sorted(ref)[0]].asnumpy())
+
+
+def test_get_num_dead_node_single_process():
+    kv = mx.kv.create("local")
+    assert kv.get_num_dead_node() == 0
+    kvd = mx.kv.create("dist_tpu_sync")
+    assert kvd.get_num_dead_node(timeout=1) == 0
