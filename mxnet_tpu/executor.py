@@ -37,9 +37,26 @@ class _GraphProgram:
     """The traced interpretation of a Symbol: pure functions over arg/aux
     tuples, compiled lazily per (is_train, shapes) by jax.jit."""
 
-    def __init__(self, symbol):
+    def __init__(self, symbol, group2ctx=None):
         self.symbol = symbol
         self.topo = symbol._topo()
+        self.group2ctx = dict(group2ctx or {})
+        # PlaceDevice-pass analogue (reference: graph_executor.cc:242
+        # AssignContext → nnvm PlaceDevice inserting _CrossDeviceCopy): map
+        # each node carrying a __ctx_group__ attr to its concrete device;
+        # interpret() transfers that node's inputs there, so under jit XLA
+        # compiles a multi-device program with real transfers at the group
+        # boundaries (example: example/model-parallel-lstm in the reference).
+        self._node_devices = {}
+        if self.group2ctx:
+            from .context import Context as _Ctx
+
+            for node in self.topo:
+                group = node.attrs.get("__ctx_group__") if node.op else None
+                if group and group in self.group2ctx:
+                    ctx = self.group2ctx[group]
+                    ctx = ctx if isinstance(ctx, _Ctx) else _Ctx(ctx)
+                    self._node_devices[id(node)] = ctx.jax_device
         args, auxs = symbol._classified_variables()
         self.arg_names = [n.name for n in args]
         self.aux_names = [n.name for n in auxs]
@@ -74,6 +91,10 @@ class _GraphProgram:
             parsed = node.parsed_attrs()
             n_aux = len(opdef.aux_names(parsed))
             ins = [vals[(id(inp), oi)] for inp, oi in node.inputs]
+            dev = self._node_devices.get(id(node))
+            if dev is not None:
+                # cross-device copy at a ctx-group boundary
+                ins = [jax.device_put(x, dev) for x in ins]
             node_rng = None
             if opdef.needs_rng:
                 node_rng = jax.random.fold_in(rng, self._rng_ids[id(node)])
@@ -330,12 +351,14 @@ def _normalize_grad_req(grad_req, arg_names):
     raise TypeError("grad_req must be str/list/dict")
 
 
-def bind(symbol, ctx, args, args_grad=None, grad_req="write", aux_states=None, shared_exec=None):
+def bind(symbol, ctx, args, args_grad=None, grad_req="write", aux_states=None, shared_exec=None, group2ctx=None):
     """Bind NDArrays to a symbol's arguments (reference: symbol.py:917 bind →
     Executor::Bind, graph_executor.cc:936)."""
-    prog = _GraphProgram(symbol) if shared_exec is None else shared_exec._prog
-    if shared_exec is not None and shared_exec._symbol is not symbol:
-        prog = _GraphProgram(symbol)
+    if shared_exec is not None and shared_exec._symbol is symbol \
+            and shared_exec._prog.group2ctx == dict(group2ctx or {}):
+        prog = shared_exec._prog
+    else:
+        prog = _GraphProgram(symbol, group2ctx=group2ctx)
     arg_names = prog.arg_names
     aux_names = prog.aux_names
     ctx = Context(ctx) if not isinstance(ctx, Context) else ctx
@@ -405,4 +428,5 @@ def simple_bind(symbol, ctx, grad_req="write", type_dict=None, group2ctx=None, s
         grad_req=reqs,
         aux_states=aux_arrays,
         shared_exec=shared_exec,
+        group2ctx=group2ctx,
     )

@@ -28,7 +28,19 @@ from .registry import AttrSpec, register
 )
 def _multi_head_attention(attrs, query, key, value):
     """softmax(QKᵀ·scale + mask)V over (B, H, T, D) tensors. Computation in
-    fp32 for a stable softmax regardless of the IO dtype (bf16 fast path)."""
+    fp32 for a stable softmax regardless of the IO dtype (bf16 fast path).
+    ``MXNET_USE_PALLAS_ATTENTION=1`` routes to the hand-tiled flash kernel
+    (ops/pallas_attention.py) on TPU when the shapes tile cleanly."""
+    import os
+
+    if os.environ.get("MXNET_USE_PALLAS_ATTENTION", "0") == "1":
+        from . import pallas_attention as pa
+
+        if pa.supported(query.shape, key.shape):
+            on_tpu = jax.default_backend() == "tpu"
+            return pa.flash_attention(
+                query, key, value, causal=attrs["causal"],
+                scale=max(attrs["scale"], 0.0), interpret=not on_tpu)
     d = query.shape[-1]
     scale = attrs["scale"] if attrs["scale"] > 0 else 1.0 / np.sqrt(d)
     q = query.astype("float32")

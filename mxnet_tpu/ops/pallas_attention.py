@@ -1,0 +1,105 @@
+"""Flash attention as a Pallas TPU kernel.
+
+The fused-softmax-attention hot path, hand-tiled for VMEM: queries stream in
+``block_q`` tiles (one per grid step), keys/values stream through an online-
+softmax ``fori_loop`` in ``block_k`` tiles, so the (T, S) score matrix never
+materializes in HBM — O(T·D) memory instead of O(T·S). This is the kernel
+counterpart of the reference's cuDNN-fused attention-era ops; the pure-XLA
+path (ops/attention.py) remains the default, and this kernel is opted in
+with ``MXNET_USE_PALLAS_ATTENTION=1`` on TPU (it also runs anywhere under
+Pallas interpret mode, which is how the tests exercise it on CPU).
+
+Layout: (B, H, T, D) folded to (B*H, T, D); grid = (B*H, T/block_q); the
+causal mask is bottom-right aligned for rectangular S >= T (decode) shapes,
+matching ops/attention.py.
+"""
+from __future__ import annotations
+
+import functools
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+__all__ = ["flash_attention", "supported"]
+
+_NEG_INF = -1e30
+
+
+def supported(q_shape, k_shape, block_q=128, block_k=128):
+    """Whether shapes tile cleanly onto the kernel grid."""
+    B, H, T, D = q_shape
+    S = k_shape[2]
+    return T % block_q == 0 and S % block_k == 0 and D % 8 == 0
+
+
+def _kernel(q_ref, k_ref, v_ref, o_ref, *, scale, causal, block_k, seq_k,
+            block_q):
+    from jax.experimental import pallas as pl
+
+    iq = pl.program_id(1)
+    q = q_ref[0].astype(jnp.float32) * scale  # (block_q, D)
+    nk = seq_k // block_k
+
+    def body(j, carry):
+        m, l, acc = carry
+        k = k_ref[0, pl.ds(j * block_k, block_k), :].astype(jnp.float32)
+        v = v_ref[0, pl.ds(j * block_k, block_k), :].astype(jnp.float32)
+        s = jax.lax.dot_general(q, k, (((1,), (1,)), ((), ())),
+                                preferred_element_type=jnp.float32)
+        if causal:
+            # bottom-right aligned: query row r may see key cols <= r + (S-T)
+            rows = iq * block_q + jax.lax.broadcasted_iota(
+                jnp.int32, (block_q, block_k), 0)
+            cols = j * block_k + jax.lax.broadcasted_iota(
+                jnp.int32, (block_q, block_k), 1)
+            offset = seq_k - pl.num_programs(1) * block_q
+            s = jnp.where(cols <= rows + offset, s, _NEG_INF)
+        m_new = jnp.maximum(m, s.max(axis=-1, keepdims=True))
+        alpha = jnp.exp(m - m_new)
+        p = jnp.exp(s - m_new)
+        l_new = l * alpha + p.sum(axis=-1, keepdims=True)
+        acc_new = acc * alpha + jax.lax.dot_general(
+            p, v, (((1,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32)
+        return m_new, l_new, acc_new
+
+    D = q.shape[-1]
+    init = (jnp.full((block_q, 1), _NEG_INF, jnp.float32),
+            jnp.zeros((block_q, 1), jnp.float32),
+            jnp.zeros((block_q, D), jnp.float32))
+    m, l, acc = jax.lax.fori_loop(0, nk, body, init)
+    o_ref[0] = (acc / jnp.maximum(l, 1e-30)).astype(o_ref.dtype)
+
+
+@functools.partial(jax.jit, static_argnames=("causal", "scale", "block_q",
+                                             "block_k", "interpret"))
+def flash_attention(q, k, v, causal=False, scale=0.0, block_q=128,
+                    block_k=128, interpret=False):
+    """softmax(QKᵀ·scale)V over (B, H, T, D), streamed through VMEM."""
+    from jax.experimental import pallas as pl
+
+    B, H, T, D = q.shape
+    S = k.shape[2]
+    if scale <= 0:
+        scale = 1.0 / np.sqrt(D)
+    block_q = min(block_q, T)
+    block_k = min(block_k, S)
+    qf = q.reshape(B * H, T, D)
+    kf = k.reshape(B * H, S, D)
+    vf = v.reshape(B * H, S, D)
+
+    out = pl.pallas_call(
+        functools.partial(_kernel, scale=scale, causal=causal,
+                          block_k=block_k, seq_k=S, block_q=block_q),
+        grid=(B * H, T // block_q),
+        in_specs=[
+            pl.BlockSpec((1, block_q, D), lambda bh, iq: (bh, iq, 0)),
+            pl.BlockSpec((1, S, D), lambda bh, iq: (bh, 0, 0)),
+            pl.BlockSpec((1, S, D), lambda bh, iq: (bh, 0, 0)),
+        ],
+        out_specs=pl.BlockSpec((1, block_q, D), lambda bh, iq: (bh, iq, 0)),
+        out_shape=jax.ShapeDtypeStruct((B * H, T, D), q.dtype),
+        interpret=interpret,
+    )(qf, kf, vf)
+    return out.reshape(B, H, T, D)

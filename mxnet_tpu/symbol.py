@@ -431,7 +431,7 @@ class Symbol:
     def bind(self, ctx, args, args_grad=None, grad_req="write", aux_states=None, group2ctx=None, shared_exec=None):
         from .executor import bind as _bind
 
-        return _bind(self, ctx, args, args_grad=args_grad, grad_req=grad_req, aux_states=aux_states, shared_exec=shared_exec)
+        return _bind(self, ctx, args, args_grad=args_grad, grad_req=grad_req, aux_states=aux_states, shared_exec=shared_exec, group2ctx=group2ctx)
 
     def eval(self, ctx=None, **kwargs):
         """One-shot forward on NDArray kwargs (reference: symbol.py eval)."""

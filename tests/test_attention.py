@@ -115,3 +115,29 @@ def test_transformer_spmd_trains():
     for _ in range(2):
         outs = tr.step({"data": jnp.asarray(x)}, {"softmax_label": y})
     assert np.isfinite(np.asarray(outs[0])).all()
+
+
+@pytest.mark.parametrize("causal", [False, True])
+def test_pallas_flash_attention_matches_oracle(causal):
+    import jax.numpy as jnp
+
+    from mxnet_tpu.ops import pallas_attention as pa
+
+    rs = np.random.RandomState(2)
+    q = rs.randn(2, 2, 16, 8).astype("float32")
+    k, v = (rs.randn(2, 2, 32, 8).astype("float32") for _ in range(2))
+    out = np.asarray(pa.flash_attention(
+        jnp.asarray(q), jnp.asarray(k), jnp.asarray(v), causal=causal,
+        block_q=8, block_k=8, interpret=True))
+    np.testing.assert_allclose(out, _ref_attention(q, k, v, causal),
+                               rtol=1e-4, atol=1e-5)
+
+
+def test_pallas_flash_attention_env_gate(monkeypatch):
+    monkeypatch.setenv("MXNET_USE_PALLAS_ATTENTION", "1")
+    rs = np.random.RandomState(3)
+    q, k, v = (rs.randn(1, 2, 16, 8).astype("float32") for _ in range(3))
+    out = mx.nd.MultiHeadAttention(mx.nd.array(q), mx.nd.array(k),
+                                   mx.nd.array(v), causal=True).asnumpy()
+    np.testing.assert_allclose(out, _ref_attention(q, k, v, True),
+                               rtol=1e-4, atol=1e-5)

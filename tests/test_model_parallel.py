@@ -1,0 +1,87 @@
+"""Model parallelism via ctx groups (reference:
+tests/python/unittest/test_model_parallel.py:12-31,
+test_multi_device_exec.py:4-33 — ctx groups mapped to cpu(i) so placement and
+cross-device-copy logic run without special hardware; here cpu(i) are the
+virtual XLA host devices from conftest)."""
+import numpy as np
+
+import mxnet_tpu as mx
+
+
+def _build_net():
+    with mx.AttrScope(ctx_group="dev1"):
+        data = mx.sym.Variable("data")
+        fc1 = mx.sym.FullyConnected(data, num_hidden=16, name="fc1")
+        act1 = mx.sym.Activation(fc1, act_type="relu", name="relu1")
+    with mx.AttrScope(ctx_group="dev2"):
+        fc2 = mx.sym.FullyConnected(act1, num_hidden=4, name="fc2")
+        net = mx.sym.LinearRegressionOutput(fc2, mx.sym.Variable("label"),
+                                            name="loss")
+    return net
+
+
+def test_ctxgroup_attr_propagates():
+    net = _build_net()
+    topo_attrs = {}
+    for node in net._topo():
+        if node.op:
+            topo_attrs[node.name] = node.attrs.get("__ctx_group__")
+    assert topo_attrs["fc1"] == "dev1" and topo_attrs["relu1"] == "dev1"
+    assert topo_attrs["fc2"] == "dev2"
+
+
+def test_model_parallel_forward_backward_matches_single_device():
+    net = _build_net()
+    shapes = {"data": (8, 10), "label": (8, 4)}
+    rs = np.random.RandomState(0)
+    arrays = {n: rs.rand(*s).astype("float32")
+              for n, s in zip(net.list_arguments(),
+                              net.infer_shape(**shapes)[0])}
+
+    def run(group2ctx):
+        exe = net.simple_bind(mx.cpu(0), grad_req="write",
+                              group2ctx=group2ctx, **shapes)
+        for k, v in arrays.items():
+            exe.arg_dict[k][:] = v
+        exe.forward(is_train=True)
+        out = exe.outputs[0].asnumpy()
+        exe.backward()
+        grads = {k: g.asnumpy() for k, g in exe.grad_dict.items()
+                 if g is not None}
+        return out, grads
+
+    out_single, grads_single = run(None)
+    out_mp, grads_mp = run({"dev1": mx.cpu(0), "dev2": mx.cpu(1)})
+    np.testing.assert_allclose(out_mp, out_single, rtol=1e-5, atol=1e-6)
+    for k in grads_single:
+        np.testing.assert_allclose(grads_mp[k], grads_single[k],
+                                   rtol=1e-5, atol=1e-6)
+
+
+def test_model_parallel_trains():
+    """2-group net trains end-to-end through Module (placement is invisible
+    to the training API, as in the reference)."""
+    net = _build_net()
+    rs = np.random.RandomState(1)
+    x = rs.rand(32, 10).astype("float32")
+    w = rs.rand(10, 4).astype("float32")
+    y = x @ w
+    exe = net.simple_bind(mx.cpu(0), grad_req="write",
+                          group2ctx={"dev1": mx.cpu(0), "dev2": mx.cpu(1)},
+                          data=(32, 10), label=(32, 4))
+    for name in exe.arg_dict:
+        if name not in ("data", "label"):
+            exe.arg_dict[name][:] = rs.uniform(-0.3, 0.3,
+                                               exe.arg_dict[name].shape)
+    exe.arg_dict["data"][:] = x
+    exe.arg_dict["label"][:] = y
+    losses = []
+    for _ in range(60):
+        exe.forward(is_train=True)
+        losses.append(float(np.square(exe.outputs[0].asnumpy() - y).mean()))
+        exe.backward()
+        for name, grad in exe.grad_dict.items():
+            if grad is not None and name not in ("data", "label"):
+                exe.arg_dict[name][:] = exe.arg_dict[name].asnumpy() \
+                    - 0.05 * grad.asnumpy()
+    assert losses[-1] < losses[0] * 0.5, losses[::20]
