@@ -9,6 +9,9 @@ steps/sec. Baseline: the reference's published ResNet-50 training speed of
 Prints ONE JSON line: {"metric", "value", "unit", "vs_baseline", ...}.
 """
 import json
+import os
+import subprocess
+import sys
 import time
 
 import numpy as np
@@ -19,18 +22,69 @@ BASELINE_IMG_S = 109.0  # reference README.md:149-156, resnet-50, 1x K80, b32
 _PEAK = {
     "TPU v4": 275e12,
     "TPU v5 lite": 197e12,
+    "TPU v5e": 197e12,
     "TPU v5": 459e12,
     "TPU v5p": 459e12,
     "TPU v6 lite": 918e12,
     "TPU v6e": 918e12,
+    "TPU v7": 2307e12,
 }
+
+
+def _peak_flops(device_kind):
+    """bf16 peak for a device kind, tolerant of naming variants."""
+    if device_kind in _PEAK:
+        return _PEAK[device_kind]
+    # longest-prefix fuzzy match ("TPU v5p slice" → "TPU v5p", …); never the
+    # reverse direction — a truncated/generic kind must yield None, not a guess
+    best = None
+    for kind, peak in _PEAK.items():
+        if device_kind.startswith(kind):
+            if best is None or len(kind) > len(best[0]):
+                best = (kind, peak)
+    return best[1] if best else None
+
 
 # ResNet-50 @224: ~4.09 GFLOP forward per image (2*MACs); training ≈ 3× fwd
 _TRAIN_FLOPS_PER_IMG = 3 * 4.09e9
 
 
+def _probe_backend(timeout=180):
+    """Check (in a subprocess, with a hard timeout) that the ambient JAX
+    platform can actually initialize. Round-2 failure mode: the preset
+    ``JAX_PLATFORMS=axon`` backend either raised at init or hung forever —
+    probing out-of-process means a hang costs ``timeout`` seconds instead of
+    the driver's whole budget. Returns True if the ambient platform works."""
+    code = "import jax; d = jax.devices(); print(d[0].platform)"
+    for attempt in range(3):
+        if attempt:
+            time.sleep(5 * attempt)
+        try:
+            out = subprocess.run(
+                [sys.executable, "-c", code], capture_output=True,
+                timeout=timeout, text=True,
+            )
+            if out.returncode == 0 and out.stdout.strip():
+                return True
+            sys.stderr.write("bench: backend probe attempt %d failed: %s\n"
+                             % (attempt, out.stderr.strip()[-500:]))
+        except subprocess.TimeoutExpired:
+            sys.stderr.write("bench: backend probe attempt %d timed out\n" % attempt)
+            return False  # a hang won't heal by retrying in-process
+    return False
+
+
 def main():
+    # nothing to probe when the platform is already pinned to CPU
+    if os.environ.get("JAX_PLATFORMS", "") != "cpu" and not _probe_backend():
+        # ambient (axon/TPU) backend unusable — fall back to CPU so the
+        # bench still records *a* number plus an explicit platform note
+        os.environ["JAX_PLATFORMS"] = "cpu"
+
     import jax
+
+    if os.environ.get("JAX_PLATFORMS") == "cpu":
+        jax.config.update("jax_platforms", "cpu")
 
     from mxnet_tpu import models, parallel
 
@@ -87,7 +141,7 @@ def main():
     img_s = batch * n_steps / dt
     # scale the FLOPs model with the benched resolution (FLOPs ∝ area)
     flops_per_img = _TRAIN_FLOPS_PER_IMG * (image / 224.0) ** 2
-    peak = _PEAK.get(dev.device_kind)
+    peak = _peak_flops(dev.device_kind)
     mfu = (img_s * flops_per_img / peak) if peak else None
 
     result = {
@@ -98,13 +152,31 @@ def main():
         "batch": batch,
         "image_size": image,
         "device": dev.device_kind,
+        "platform": dev.platform,
         "steps_timed": n_steps,
         "step_ms": round(1000 * dt / n_steps, 2),
     }
     if mfu is not None:
         result["mfu"] = round(mfu, 4)
+    elif on_tpu:
+        # unknown device kind — record what we saw so the peak table can grow
+        result["mfu"] = None
+        result["mfu_note"] = "no bf16 peak known for device_kind=%r" % dev.device_kind
     print(json.dumps(result))
 
 
 if __name__ == "__main__":
-    main()
+    try:
+        main()
+    except BaseException as exc:  # always leave ONE JSON line for the driver
+        import traceback
+
+        traceback.print_exc()
+        print(json.dumps({
+            "metric": "resnet50_train_throughput",
+            "value": None,
+            "unit": "img/s",
+            "vs_baseline": None,
+            "error": "%s: %s" % (type(exc).__name__, exc),
+        }))
+        raise SystemExit(1)
