@@ -122,6 +122,7 @@ class BucketingModule(BaseModule):
             logger=self.logger,
             context=self._context,
             work_load_list=self._work_load_list,
+            fused_step=False,
         )
         module.bind(
             data_shapes,
@@ -149,6 +150,7 @@ class BucketingModule(BaseModule):
                 logger=self.logger,
                 context=self._context,
                 work_load_list=self._work_load_list,
+                fused_step=False,
             )
             module.bind(
                 data_shapes,

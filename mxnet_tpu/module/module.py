@@ -33,8 +33,14 @@ class Module(BaseModule):
         context=None,
         work_load_list=None,
         fixed_param_names=None,
+        fused_step=True,
     ):
         super().__init__(logger=logger)
+        # fused_step=False keeps the legacy per-device + kvstore execution
+        # even when a mesh is available (used by BucketingModule, whose
+        # param sharing runs through shared executors)
+        self._fused_step_ok = bool(fused_step)
+        self._spmd = None
         if context is None:
             context = current_context()
         if isinstance(context, Context):
@@ -105,7 +111,10 @@ class Module(BaseModule):
         return (self._arg_params, self._aux_params)
 
     def _sync_params_from_devices(self):
-        self._exec_group.get_params(self._arg_params, self._aux_params)
+        if self._spmd is not None and self._spmd.params_dirty:
+            self._spmd.export_params(self._arg_params, self._aux_params)
+        else:
+            self._exec_group.get_params(self._arg_params, self._aux_params)
         self._params_dirty = False
 
     def init_params(self, initializer=None, arg_params=None, aux_params=None, allow_missing=False, force_init=False):
@@ -148,6 +157,10 @@ class Module(BaseModule):
         self.params_initialized = True
         self._params_dirty = False
         self._exec_group.set_params(self._arg_params, self._aux_params)
+        if self._spmd is not None:
+            # params (re)loaded after the fused step was set up — the trainer
+            # must adopt them or training would continue from stale weights
+            self._spmd.adopt_params(self._arg_params, self._aux_params)
 
     def set_params(self, arg_params, aux_params, allow_missing=False, force_init=True):
         if not allow_missing:
@@ -164,6 +177,8 @@ class Module(BaseModule):
         self._exec_group.set_params(arg_params, aux_params)
         self._params_dirty = True
         self.params_initialized = True
+        if self._spmd is not None:
+            self._spmd.adopt_params(arg_params or {}, aux_params or {})
 
     # --------------------------------------------------------------- binding
     def bind(self, data_shapes, label_shapes=None, for_training=True, inputs_need_grad=False, force_rebind=False, shared_module=None, grad_req="write"):
@@ -269,6 +284,25 @@ class Module(BaseModule):
         self._update_on_kvstore = update_on_kvstore
         self._updater = None
 
+        # TPU hot path: when the contexts form a mesh (or the kvstore is a
+        # dist sync type), lower forward_backward+update onto ONE jitted
+        # sharded step — no per-key host reduction (SURVEY §3.1 TPU mapping)
+        from . import spmd_adapter
+
+        self._spmd = spmd_adapter.try_create(self, kvstore_obj)
+        if self._spmd is not None:
+            self.logger.info(
+                "Module: fused SPMD step active over %d device(s)%s",
+                self._spmd.trainer.mesh.devices.size,
+                " (multi-process)" if self._spmd.trainer._spans_processes else "",
+            )
+            self._update_on_kvstore = False
+            self.optimizer_initialized = True
+            if self._preload_opt_states is not None:
+                self.load_optimizer_states(self._preload_opt_states)
+                self._preload_opt_states = None
+            return
+
         if kvstore_obj:
             # copy initialized params into the store; updates flow through it
             from ..kvstore_helper import initialize_kvstore
@@ -295,6 +329,9 @@ class Module(BaseModule):
         """Share optimizer/updater with another module (reference:
         module.py borrow_optimizer, used by BucketingModule)."""
         assert shared_module.optimizer_initialized
+        assert shared_module._spmd is None, (
+            "cannot borrow a fused-SPMD optimizer; create the shared module "
+            "with fused_step=False")
         self._optimizer = shared_module._optimizer
         self._kvstore = shared_module._kvstore
         self._update_on_kvstore = shared_module._update_on_kvstore
@@ -304,6 +341,15 @@ class Module(BaseModule):
     # ------------------------------------------------------------- train step
     def forward(self, data_batch, is_train=None):
         assert self.binded and self.params_initialized
+        if self._spmd is not None:
+            if self._spmd.params_dirty:
+                # SPMD steps update the trainer's params; refresh the bound
+                # executors before a plain forward (score/predict after fit)
+                self._sync_params_from_devices()
+                self._exec_group.set_params(self._arg_params, self._aux_params)
+            # this forward's outputs now own get_outputs/update_metric —
+            # drop the stale fused-step outputs
+            self._spmd._outputs = None
         self._exec_group.forward(data_batch, is_train)
 
     def backward(self, out_grads=None):
@@ -311,13 +357,29 @@ class Module(BaseModule):
         self._exec_group.backward(out_grads=out_grads)
 
     def forward_backward(self, data_batch):
-        """Fused per-device step — ONE XLA computation per device."""
+        """Fused step — ONE XLA computation per device (or, in SPMD mode,
+        one computation over the whole mesh including grad sync + update)."""
         assert self.binded and self.params_initialized
+        if self._spmd is not None:
+            self._params_dirty = True
+            self._spmd.step(data_batch)
+            return
         self._exec_group.forward_backward(data_batch)
 
     def update(self):
         """(reference: module.py update → model.py _update_params[_on_kvstore])"""
         assert self.binded and self.params_initialized and self.optimizer_initialized
+        if self._spmd is not None:
+            if not self._spmd.consume_pending_step():
+                # manual forward()/backward() ran through the exec_group —
+                # the fused step never fired, so silently returning would
+                # train nothing. Fail loudly instead of no-opping.
+                raise MXNetError(
+                    "update() without forward_backward() in fused-SPMD mode: "
+                    "use forward_backward(), or build the Module with "
+                    "fused_step=False (or MXNET_MODULE_FUSED_STEP=0) for the "
+                    "manual forward/backward/update loop")
+            return  # the optimizer already ran inside the fused step
         self._params_dirty = True
         if self._update_on_kvstore:
             from ..kvstore_helper import update_params_on_kvstore
@@ -338,6 +400,9 @@ class Module(BaseModule):
 
     def get_outputs(self, merge_multi_context=True):
         assert self.binded and self.params_initialized
+        if self._spmd is not None and self._spmd._outputs is not None:
+            outs = self._spmd.get_outputs()
+            return outs if merge_multi_context else [[o] for o in outs]
         return self._exec_group.get_outputs(merge_multi_context=merge_multi_context)
 
     def get_input_grads(self, merge_multi_context=True):
@@ -345,16 +410,28 @@ class Module(BaseModule):
         return self._exec_group.get_input_grads(merge_multi_context=merge_multi_context)
 
     def update_metric(self, eval_metric, labels):
+        if self._spmd is not None and self._spmd._outputs is not None:
+            eval_metric.update(labels, self._spmd.get_outputs())
+            return
         self._exec_group.update_metric(eval_metric, labels)
 
     def install_monitor(self, mon):
         assert self.binded
+        self._monitor_installed = True
+        if self._spmd is not None:
+            self.logger.warning(
+                "Monitor stats are not collected by the fused SPMD step; "
+                "build the Module with fused_step=False to monitor per-op "
+                "outputs")
         self._exec_group.install_monitor(mon)
 
     # ----------------------------------------------------------- persistence
     def save_optimizer_states(self, fname):
         assert self.optimizer_initialized
-        if self._update_on_kvstore:
+        if self._spmd is not None:
+            with open(fname, "wb") as f:
+                f.write(self._spmd.get_states())
+        elif self._update_on_kvstore:
             with open(fname, "wb") as f:
                 f.write(self._kvstore._updater.get_states())
         else:
@@ -365,7 +442,9 @@ class Module(BaseModule):
         assert self.optimizer_initialized
         with open(fname, "rb") as f:
             states = f.read()
-        if self._update_on_kvstore:
+        if self._spmd is not None:
+            self._spmd.set_states(states)
+        elif self._update_on_kvstore:
             self._kvstore._updater.set_states(states)
         else:
             self._updater.set_states(states)

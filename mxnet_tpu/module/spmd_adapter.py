@@ -1,0 +1,199 @@
+"""Lower Module's forward_backward+update onto one jitted SPMD step.
+
+The reference's hot path (SURVEY.md §3.1) runs per-device executors and then
+a per-key KVStore push/pull; on a TPU mesh that becomes host-side reduction,
+which can never feed the MFU target. When a ``Module`` spans more than one
+device — or its kvstore is ``dist_tpu_sync`` across processes — this adapter
+replaces the exec-group + kvstore loop with ``parallel.SPMDTrainer``:
+forward + backward + gradient all-reduce + optimizer update compile into ONE
+``jax.jit`` over the mesh, with XLA inserting the psum over ICI/DCN. The
+Module API (``fit``/``forward_backward``/``update``/``get_outputs``/metrics/
+checkpointing) is unchanged — only the execution strategy moves.
+
+The legacy per-device path remains for: inference-only modules,
+``inputs_need_grad``, fixed params, non-uniform work loads, custom grad_req,
+optimizers without a functional lowering, and bucketing (``fused_step=False``).
+"""
+from __future__ import annotations
+
+import logging
+import os
+import pickle
+
+import numpy as np
+
+__all__ = ["SPMDStepAdapter"]
+
+
+class SPMDStepAdapter:
+    def __init__(self, module, mesh, fn_opt, lr_of_step):
+        from ..parallel.trainer import SPMDTrainer
+
+        self._lr_of_step = lr_of_step
+        self._data_names = list(module._data_names)
+        self._label_names = list(module._label_names)
+        self.trainer = SPMDTrainer(
+            module._symbol,
+            mesh,
+            data_names=tuple(self._data_names),
+            label_names=tuple(self._label_names),
+            optimizer=fn_opt,
+        )
+        self._optimizer = module._optimizer
+        self._outputs = None
+        self.params_dirty = False  # trainer params newer than exec_group's
+        self._pending_step = False  # a fused step ran, update() not yet seen
+        self.adopt_params(module._arg_params, module._aux_params)
+
+    def consume_pending_step(self):
+        """True iff a fused step ran since the last update() — lets update()
+        distinguish the fit() pairing from a manual fwd/bwd loop."""
+        pending, self._pending_step = self._pending_step, False
+        return pending
+
+    # ------------------------------------------------------------------ params
+    def adopt_params(self, arg_params, aux_params):
+        """Take the module's host params as the trainer's state. In dist mode
+        every worker adopts rank 0's values (the reference's kvstore-init
+        broadcast, kvstore_dist.h Init)."""
+        import jax
+
+        arg = {k: np.asarray(v.asnumpy() if hasattr(v, "asnumpy") else v)
+               for k, v in (arg_params or {}).items()}
+        aux = {k: np.asarray(v.asnumpy() if hasattr(v, "asnumpy") else v)
+               for k, v in (aux_params or {}).items()}
+        if jax.process_count() > 1:
+            from jax.experimental.multihost_utils import broadcast_one_to_all
+
+            arg = {k: np.asarray(broadcast_one_to_all(v)) for k, v in arg.items()}
+            aux = {k: np.asarray(broadcast_one_to_all(v)) for k, v in aux.items()}
+        self.trainer.set_params(arg, aux)
+
+    def export_params(self, arg_params, aux_params):
+        """Write the trainer's current params back into the module's host
+        NDArray dicts (checkpointing / get_params)."""
+        arg, aux = self.trainer.get_params()
+        for k, v in arg.items():
+            arg_params[k][:] = v
+        for k, v in aux.items():
+            aux_params[k][:] = v
+        self.params_dirty = False
+
+    # ------------------------------------------------------------------ step
+    def step(self, data_batch):
+        """The fused train step: fwd + bwd + all-reduce + update."""
+
+        def host(v):
+            return v._jax() if hasattr(v, "_jax") else np.asarray(v)
+
+        data = {n: host(v) for n, v in zip(self._data_names, data_batch.data)}
+        label = {}
+        if self._label_names and data_batch.label is not None:
+            label = {n: host(v) for n, v in zip(self._label_names, data_batch.label)}
+        opt = self._optimizer
+        # legacy ordering (optimizer.py _update_count → _get_lr): the counter
+        # increments BEFORE the schedule is read, so schedules fire on the
+        # same step here as on the per-device path
+        opt.num_update += 1
+        lr = self._lr_of_step(opt.num_update)
+        self._outputs = self.trainer.step(data, label, lr=lr)
+        self.params_dirty = True
+        self._pending_step = True
+
+    def get_outputs(self):
+        """Step outputs as NDArrays. Multi-host: each process sees its own
+        rows (the ones it fed), so update_metric(labels) pairs correctly."""
+        import jax
+
+        from ..ndarray import NDArray
+
+        if self._outputs is None:
+            return []
+        outs = []
+        for o in self._outputs:
+            if self.trainer._spans_processes:
+                from jax.experimental.multihost_utils import (
+                    global_array_to_host_local_array,
+                )
+
+                o = global_array_to_host_local_array(
+                    o, self.trainer.mesh,
+                    self.trainer.rules.batch_spec(o.shape))
+            outs.append(NDArray(o))
+        return outs
+
+    # ------------------------------------------------------------- opt states
+    def get_states(self):
+        import jax
+
+        return pickle.dumps(jax.device_get(self.trainer.opt_state))
+
+    def set_states(self, blob):
+        import jax.numpy as jnp
+
+        state = pickle.loads(blob)
+        self.trainer.opt_state = _tree_jnp(state, jnp)
+
+
+def _tree_jnp(x, jnp):
+    if isinstance(x, dict):
+        return {k: _tree_jnp(v, jnp) for k, v in x.items()}
+    return jnp.asarray(x)
+
+
+def try_create(module, kvstore_obj):
+    """Create an adapter when the Module's configuration supports the fused
+    SPMD step; otherwise return None (→ legacy per-device + kvstore path).
+
+    Triggers: multi-device context, a ``dist*`` sync kvstore, or
+    ``MXNET_MODULE_FUSED_STEP=1``. ``MXNET_MODULE_FUSED_STEP=0`` disables."""
+    flag = os.environ.get("MXNET_MODULE_FUSED_STEP", "")
+    if flag == "0" or not getattr(module, "_fused_step_ok", True):
+        return None
+    if getattr(module, "_monitor_installed", False):
+        return None  # per-op monitor needs the exec-group path
+    if not module.for_training or module.inputs_need_grad:
+        return None
+    if module._fixed_param_names:
+        return None
+    wl = module._work_load_list
+    if wl and len(set(wl)) > 1:
+        return None
+    if any(module._exec_group.grad_req.get(n) != "write"
+           for n in module._param_names):
+        return None
+
+    dist = (kvstore_obj is not None and "dist" in kvstore_obj.type
+            and "async" not in kvstore_obj.type)
+    multi_dev = len(module._context) > 1
+    if not (dist or multi_dev or flag == "1"):
+        return None
+
+    from ..parallel.optim import functional_from_optimizer
+
+    fn = functional_from_optimizer(module._optimizer, set(module._param_names))
+    if fn is None:
+        logging.warning(
+            "fused SPMD step unavailable for optimizer %s — falling back to "
+            "the per-device kvstore path", type(module._optimizer).__name__)
+        return None
+    init, apply, lr_of_step = fn
+
+    import jax
+
+    from ..parallel.mesh import make_mesh
+
+    if dist and jax.process_count() > 1:
+        devices = list(jax.devices())  # global mesh: every process's chips
+    else:
+        try:
+            devices = [ctx.jax_device for ctx in module._context]
+        except Exception:
+            return None
+        if len({id(d) for d in devices}) != len(devices):
+            return None
+    if module._exec_group.batch_size % len(module._context):
+        return None  # data axis must split the per-process batch evenly
+
+    mesh = make_mesh((len(devices),), ("data",), devices)
+    return SPMDStepAdapter(module, mesh, (init, apply), lr_of_step)

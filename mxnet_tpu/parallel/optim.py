@@ -5,26 +5,33 @@ fine for the Module/KVStore path, but the SPMD trainer needs the update
 *inside* the jitted step (the reference's ``update_on_kvstore`` moved the
 optimizer onto ps-lite servers, kvstore_dist_server.h:164-198; SPMD moves it
 into the compiled program). These return pure ``(init, apply)`` pairs over
-parameter pytrees, mirroring the fused-op semantics of ops/optimizer_ops.py.
+parameter dicts, mirroring the fused-op semantics of ops/optimizer_ops.py.
+
+``apply(params, grads, state, lr=None)`` — ``lr`` is an optional traced
+scalar overriding the static learning rate, so an ``mx.lr_scheduler`` can
+drive the fused step without retracing (the schedule value is just another
+input to the compiled program).
 """
 from __future__ import annotations
 
-__all__ = ["make_functional_optimizer"]
+__all__ = ["make_functional_optimizer", "functional_from_optimizer"]
 
 
 def make_functional_optimizer(name="sgd", learning_rate=0.01, wd=0.0,
                               rescale_grad=1.0, clip_gradient=None,
                               momentum=0.9, beta1=0.9, beta2=0.999,
-                              epsilon=1e-8, **_ignored):
+                              epsilon=1e-8, lr_mult=None, wd_mult=None,
+                              **_ignored):
     """Return ``(init_fn, apply_fn)``.
 
-    ``init_fn(params) -> state``; ``apply_fn(params, grads, state) ->
-    (new_params, new_state)``. All pure jax, so the whole update fuses into
-    the training step's XLA computation."""
-    import jax
+    ``init_fn(params) -> state``; ``apply_fn(params, grads, state, lr=None)
+    -> (new_params, new_state)``. All pure jax, so the whole update fuses
+    into the training step's XLA computation. ``lr_mult``/``wd_mult`` are
+    optional name→float dicts (reference: optimizer.py _get_lr/_get_wd)."""
     import jax.numpy as jnp
 
-    lr, mom = learning_rate, momentum
+    lr_mult = dict(lr_mult or {})
+    wd_mult = dict(wd_mult or {})
 
     def prep(g):
         g = g * rescale_grad
@@ -32,32 +39,39 @@ def make_functional_optimizer(name="sgd", learning_rate=0.01, wd=0.0,
             g = jnp.clip(g, -clip_gradient, clip_gradient)
         return g
 
+    def k_lr(lr_now, k):
+        return lr_now * lr_mult.get(k, 1.0)
+
+    def k_wd(k):
+        return wd * wd_mult.get(k, 1.0)
+
     if name in ("sgd", "nag"):
-        use_mom = mom > 0
+        use_mom = momentum > 0
 
         def init(params):
-            t = jnp.zeros((), "int32")
-            if not use_mom:
-                return {"t": t}
-            return {"t": t, "mom": jax.tree.map(jnp.zeros_like, params)}
-
-        def apply(params, grads, state):
-            def upd(w, g, m=None):
-                g = prep(g) + wd * w
-                if m is None:
-                    return w - lr * g, None
-                new_m = mom * m - lr * g
-                if name == "nag":  # Nesterov lookahead (reference optimizer.py NAG)
-                    return w + mom * new_m - lr * g, new_m
-                return w + new_m, new_m
-
+            state = {"t": jnp.zeros((), "int32")}
             if use_mom:
-                out = jax.tree.map(lambda w, g, m: upd(w, g, m), params, grads, state["mom"])
-                new_params = jax.tree.map(lambda o: o[0], out, is_leaf=lambda x: isinstance(x, tuple))
-                new_mom = jax.tree.map(lambda o: o[1], out, is_leaf=lambda x: isinstance(x, tuple))
-                return new_params, {"t": state["t"] + 1, "mom": new_mom}
-            new_params = jax.tree.map(lambda w, g: upd(w, g)[0], params, grads)
-            return new_params, {"t": state["t"] + 1}
+                state["mom"] = {k: jnp.zeros_like(v) for k, v in params.items()}
+            return state
+
+        def apply(params, grads, state, lr=None):
+            lr_now = learning_rate if lr is None else lr
+            new_params, new_mom = {}, {}
+            for k, w in params.items():
+                g = prep(grads[k]) + k_wd(k) * w
+                if not use_mom:
+                    new_params[k] = w - k_lr(lr_now, k) * g
+                    continue
+                m = momentum * state["mom"][k] - k_lr(lr_now, k) * g
+                new_mom[k] = m
+                if name == "nag":  # Nesterov lookahead (reference optimizer.py NAG)
+                    new_params[k] = w + momentum * m - k_lr(lr_now, k) * g
+                else:
+                    new_params[k] = w + m
+            new_state = {"t": state["t"] + 1}
+            if use_mom:
+                new_state["mom"] = new_mom
+            return new_params, new_state
 
         return init, apply
 
@@ -66,26 +80,74 @@ def make_functional_optimizer(name="sgd", learning_rate=0.01, wd=0.0,
         def init(params):
             return {
                 "t": jnp.zeros((), "int32"),
-                "m": jax.tree.map(jnp.zeros_like, params),
-                "v": jax.tree.map(jnp.zeros_like, params),
+                "m": {k: jnp.zeros_like(v) for k, v in params.items()},
+                "v": {k: jnp.zeros_like(v) for k, v in params.items()},
             }
 
-        def apply(params, grads, state):
+        def apply(params, grads, state, lr=None):
+            lr_now = learning_rate if lr is None else lr
             t = state["t"] + 1
             # bias-corrected step size, as the reference Adam computes lr_t
-            lr_t = lr * jnp.sqrt(1.0 - beta2 ** t.astype("float32")) / (
+            correction = jnp.sqrt(1.0 - beta2 ** t.astype("float32")) / (
                 1.0 - beta1 ** t.astype("float32"))
-
-            def upd(w, g, m, v):
-                g = prep(g) + wd * w
-                m = beta1 * m + (1 - beta1) * g
-                v = beta2 * v + (1 - beta2) * g * g
-                return w - lr_t * m / (jnp.sqrt(v) + epsilon), m, v
-
-            out = jax.tree.map(upd, params, grads, state["m"], state["v"])
-            first = lambda i: jax.tree.map(lambda o: o[i], out, is_leaf=lambda x: isinstance(x, tuple))
-            return first(0), {"t": t, "m": first(1), "v": first(2)}
+            new_params, new_m, new_v = {}, {}, {}
+            for k, w in params.items():
+                g = prep(grads[k]) + k_wd(k) * w
+                m = beta1 * state["m"][k] + (1 - beta1) * g
+                v = beta2 * state["v"][k] + (1 - beta2) * g * g
+                new_m[k], new_v[k] = m, v
+                new_params[k] = w - k_lr(lr_now, k) * correction * m / (
+                    jnp.sqrt(v) + epsilon)
+            return new_params, {"t": t, "m": new_m, "v": new_v}
 
         return init, apply
 
     raise ValueError("unknown functional optimizer %r (have sgd/nag/adam)" % name)
+
+
+_SUPPORTED_CLASSES = {"SGD": "sgd", "NAG": "nag", "Adam": "adam"}
+
+
+def functional_from_optimizer(optimizer, param_names):
+    """Lower an ``mxnet_tpu.optimizer.Optimizer`` instance to a functional
+    ``(init, apply, lr_of_step)`` triple, or return ``None`` when its class
+    or per-param configuration has no in-step equivalent.
+
+    ``lr_of_step(t)`` evaluates the schedule on host — its value feeds the
+    jitted step as a traced scalar each iteration."""
+    kind = _SUPPORTED_CLASSES.get(type(optimizer).__name__)
+    if kind is None:
+        return None
+
+    def mult_by_name(mult):
+        out = {}
+        for key, val in (mult or {}).items():
+            name = optimizer.idx2name.get(key, key) if isinstance(key, int) else key
+            if name in param_names:
+                out[str(name)] = float(val)
+        return out
+
+    kwargs = dict(
+        learning_rate=optimizer.lr,
+        wd=getattr(optimizer, "wd", 0.0),
+        rescale_grad=getattr(optimizer, "rescale_grad", 1.0),
+        clip_gradient=getattr(optimizer, "clip_gradient", None),
+        lr_mult=mult_by_name(optimizer.lr_mult),
+        wd_mult=mult_by_name(optimizer.wd_mult),
+    )
+    if kind in ("sgd", "nag"):
+        kwargs["momentum"] = getattr(optimizer, "momentum", 0.0)
+    if kind == "adam":
+        kwargs.update(
+            beta1=getattr(optimizer, "beta1", 0.9),
+            beta2=getattr(optimizer, "beta2", 0.999),
+            epsilon=getattr(optimizer, "epsilon", 1e-8),
+        )
+    init, apply = make_functional_optimizer(kind, **kwargs)
+
+    def lr_of_step(t):
+        if optimizer.lr_scheduler is not None:
+            return float(optimizer.lr_scheduler(int(t)))
+        return float(optimizer.lr)
+
+    return init, apply, lr_of_step

@@ -59,8 +59,18 @@ class SPMDTrainer:
         self.param_names = [n for n in arg_names if n not in self.input_names]
         self.aux_names = self._prog.aux_names
 
-        self._opt_init, self._opt_apply = make_functional_optimizer(
-            optimizer, **dict(optimizer_params or {}))
+        opt_kwargs = dict(optimizer_params or {})
+        if isinstance(optimizer, str):
+            # mirror make_functional_optimizer's default lr
+            self._opt_static_lr = float(opt_kwargs.get("learning_rate", 0.01))
+            self._opt_init, self._opt_apply = make_functional_optimizer(
+                optimizer, **opt_kwargs)
+        else:
+            # pre-built (init, apply) pair, e.g. from functional_from_optimizer;
+            # its learning rate is baked into the closure — pass lr=None
+            # through so apply() uses it, unless the caller overrides per step
+            self._opt_static_lr = None
+            self._opt_init, self._opt_apply = optimizer
 
         self.params: Dict = {}
         self.aux: Dict = {}
@@ -69,6 +79,7 @@ class SPMDTrainer:
         self._step_count = 0
         self._seed = 0
         self._base_key = None
+        self._spans_cache = None
 
     # ------------------------------------------------------------------ init
     def init_params(self, data_shapes, label_shapes=None, initializer=None,
@@ -104,14 +115,27 @@ class SPMDTrainer:
         self.params = {}
         for name in self.param_names:
             spec = self.rules.param_spec(name, arg_map[name])
-            host = host_init(name, arg_map[name])
-            self.params[name] = jax.device_put(jnp.asarray(host), self.rules.named(spec))
+            self.params[name] = self._put_global(host_init(name, arg_map[name]), spec)
         self.aux = {}
         for name in self.aux_names:
-            host = host_init(name, aux_map[name])
-            self.aux[name] = jax.device_put(jnp.asarray(host), self.rules.named(_replicated(self.rules)))
+            self.aux[name] = self._put_global(
+                host_init(name, aux_map[name]), _replicated(self.rules))
         self.opt_state = self._opt_init(self.params)
         return self
+
+    def _put_global(self, host, spec):
+        """Place a full host copy of an array onto the mesh. Works across
+        processes because every process holds the complete value and serves
+        just its addressable shards."""
+        import jax
+        import jax.numpy as jnp
+
+        host = np.asarray(host)
+        sharding = self.rules.named(spec)
+        if self._spans_processes:
+            return jax.make_array_from_callback(
+                host.shape, sharding, lambda idx: host[idx])
+        return jax.device_put(jnp.asarray(host), sharding)
 
     # ------------------------------------------------------------------ step
     def _build_step(self):
@@ -144,7 +168,7 @@ class SPMDTrainer:
         if self._remat:
             fwd = jax.checkpoint(fwd, static_argnums=())
 
-        def step(params, aux, opt_state, inputs, base_key):
+        def step(params, aux, opt_state, inputs, base_key, lr):
             # derive the per-step key on device from the optimizer counter —
             # no host→device key transfer inside the training loop
             rng = jax.random.fold_in(base_key, opt_state["t"])
@@ -163,14 +187,40 @@ class SPMDTrainer:
             for k in params:
                 if k not in grads:
                     grads[k] = jnp.zeros_like(params[k])
-            new_params, new_opt = opt_apply(params, grads, opt_state)
+            new_params, new_opt = opt_apply(params, grads, opt_state, lr=lr)
             new_aux_d = dict(zip(aux_names, new_aux))
             return new_params, new_aux_d, new_opt, outs
 
         return jax.jit(step, donate_argnums=(0, 1, 2))
 
-    def step(self, data: Dict, label: Optional[Dict] = None):
-        """Run one training step; returns the head outputs (jax arrays)."""
+    @property
+    def _spans_processes(self):
+        """True when the mesh covers devices of more than one process —
+        inputs must then be assembled from per-process local shards."""
+        if self._spans_cache is None:
+            import jax
+
+            self._spans_cache = any(d.process_index != jax.process_index()
+                                    for d in self.mesh.devices.flat)
+        return self._spans_cache
+
+    def _place_input(self, v, spec):
+        """Lay a host batch out on the mesh. Multi-host: each process holds
+        its local rows — ``make_array_from_process_local_data`` glues them
+        into one global array along the data axis (SPMD analogue of the
+        per-worker batches the reference feeds through kvstore ranks)."""
+        import jax
+
+        if self._spans_processes:
+            return jax.make_array_from_process_local_data(
+                self.rules.named(spec), np.asarray(v))
+        return jax.device_put(v, self.rules.named(spec))
+
+    def step(self, data: Dict, label: Optional[Dict] = None, lr=None):
+        """Run one training step; returns the head outputs (jax arrays).
+
+        ``lr`` optionally overrides the optimizer's static learning rate for
+        this step (drives lr schedules without retracing)."""
         import jax
         import jax.numpy as jnp
 
@@ -186,14 +236,16 @@ class SPMDTrainer:
                 raise MXNetError("missing input %r" % n)
             v = inputs[n]
             v = v if hasattr(v, "dtype") and not isinstance(v, np.ndarray) else jnp.asarray(np.asarray(v))
-            spec = self.rules.batch_spec(v.shape)
-            placed[n] = jax.device_put(v, self.rules.named(spec))
+            placed[n] = self._place_input(v, self.rules.batch_spec(v.shape))
         if getattr(self, "_base_key", None) is None:
             self._base_key = jax.device_put(
                 jax.random.PRNGKey(self._seed), self.rules.named(_replicated(self.rules)))
+        if lr is None:
+            lr = self._opt_static_lr  # may stay None → apply() uses its own lr
         self._step_count += 1
         self.params, self.aux, self.opt_state, outs = self._step_fn(
-            self.params, self.aux, self.opt_state, placed, self._base_key)
+            self.params, self.aux, self.opt_state, placed, self._base_key,
+            None if lr is None else jnp.asarray(lr, "float32"))
         return outs
 
     # ------------------------------------------------------------------ misc
@@ -201,20 +253,23 @@ class SPMDTrainer:
         """Gather params/aux to host numpy (for checkpointing / Module interop)."""
         import jax
 
-        gather = lambda d: {k: np.asarray(jax.device_get(v)) for k, v in d.items()}
+        if self._spans_processes:
+            from jax.experimental.multihost_utils import process_allgather
+
+            fetch = lambda v: np.asarray(process_allgather(v, tiled=True))
+        else:
+            fetch = lambda v: np.asarray(jax.device_get(v))
+        gather = lambda d: {k: fetch(v) for k, v in d.items()}
         return gather(self.params), gather(self.aux)
 
     def set_params(self, arg_params, aux_params=None):
-        import jax
-        import jax.numpy as jnp
-
         for name, v in (arg_params or {}).items():
             if name in self.param_names:
                 spec = self.rules.param_spec(name, np.shape(v))
-                self.params[name] = jax.device_put(jnp.asarray(np.asarray(v)), self.rules.named(spec))
+                self.params[name] = self._put_global(np.asarray(v), spec)
         for name, v in (aux_params or {}).items():
             if name in self.aux_names:
-                self.aux[name] = jax.device_put(jnp.asarray(np.asarray(v)), self.rules.named(_replicated(self.rules)))
+                self.aux[name] = self._put_global(np.asarray(v), _replicated(self.rules))
         if self.opt_state is None and self.params:
             self.opt_state = self._opt_init(self.params)
 

@@ -1,0 +1,163 @@
+"""Module-API training lowered onto the fused SPMD step.
+
+VERDICT r2 item 3: `Module(ctx=<8 devices>)` must run ONE jitted sharded
+step (fwd+bwd+psum+update), not per-key host reduction — and produce the
+same numbers as the legacy single-device path. Oracles: exact parameter
+parity against the unfused path after N steps, plus a convergence check
+through `fit()` (reference analogue: tests/python/train/test_mlp.py).
+"""
+import numpy as np
+import pytest
+
+import mxnet_tpu as mx
+
+
+def _mlp(hidden=32, classes=4):
+    data = mx.sym.Variable("data")
+    h = mx.sym.FullyConnected(data, name="fc1", num_hidden=hidden)
+    h = mx.sym.Activation(h, name="relu1", act_type="relu")
+    h = mx.sym.FullyConnected(h, name="fc2", num_hidden=classes)
+    return mx.sym.SoftmaxOutput(h, name="softmax")
+
+
+def _fit_params(symbol, ctxs, batches, optimizer="sgd", opt_params=None,
+                fused=None):
+    """Train the same batches through a Module on the given contexts and
+    return the final params (numpy dict)."""
+    import os
+
+    mx.random.seed(7)  # identical init across the runs being compared
+    mod = mx.mod.Module(symbol, context=ctxs,
+                        **({} if fused is None else {"fused_step": fused}))
+    b0 = batches[0]
+    mod.bind(data_shapes=[("data", b0.data[0].shape)],
+             label_shapes=[("softmax_label", b0.label[0].shape)])
+    mod.init_params(initializer=mx.init.Xavier(magnitude=2.0))
+    mod.init_optimizer(kvstore="local", optimizer=optimizer,
+                       optimizer_params=opt_params
+                       or (("learning_rate", 0.1), ("momentum", 0.9)))
+    for batch in batches:
+        mod.forward_backward(batch)
+        mod.update()
+    args, _ = mod.get_params()
+    return mod, {k: v.asnumpy() for k, v in args.items()}
+
+
+def _batches(n, batch=16, feat=8, classes=4, seed=0):
+    rs = np.random.RandomState(seed)
+    out = []
+    for _ in range(n):
+        x = rs.rand(batch, feat).astype("float32")
+        y = rs.randint(0, classes, (batch,)).astype("float32")
+        out.append(mx.io.DataBatch(data=[mx.nd.array(x)], label=[mx.nd.array(y)]))
+    return out
+
+
+class TestFusedStepParity:
+    def test_fused_path_is_active_on_multi_device(self):
+        sym = _mlp()
+        mod, _ = _fit_params(sym, [mx.cpu(i) for i in range(4)], _batches(1))
+        assert mod._spmd is not None, "fused SPMD step should be active"
+
+    def test_single_device_stays_legacy(self):
+        sym = _mlp()
+        mod, _ = _fit_params(sym, [mx.cpu(0)], _batches(1))
+        assert mod._spmd is None
+
+    @pytest.mark.parametrize("optimizer,opt_params", [
+        ("sgd", (("learning_rate", 0.1), ("momentum", 0.9))),
+        ("sgd", (("learning_rate", 0.05), ("momentum", 0.0), ("wd", 1e-3))),
+        ("adam", (("learning_rate", 0.01),)),
+    ])
+    def test_params_match_legacy_path(self, optimizer, opt_params):
+        """Same data, same init → fused multi-device params == legacy
+        single-device params (the psum over shards equals the full-batch
+        gradient)."""
+        sym = _mlp()
+        batches = _batches(5)
+        _, fused = _fit_params(sym, [mx.cpu(i) for i in range(8)], batches,
+                               optimizer, opt_params)
+        _, legacy = _fit_params(sym, [mx.cpu(0)], batches,
+                                optimizer, opt_params)
+        assert set(fused) == set(legacy)
+        for k in fused:
+            np.testing.assert_allclose(
+                fused[k], legacy[k], rtol=2e-4, atol=2e-5,
+                err_msg="param %s diverged between fused and legacy" % k)
+
+    def test_outputs_match_legacy_path(self):
+        sym = _mlp()
+        batches = _batches(1)
+        modf, _ = _fit_params(sym, [mx.cpu(i) for i in range(4)], batches)
+        modl, _ = _fit_params(sym, [mx.cpu(0)], batches)
+        of = modf.get_outputs()[0].asnumpy()
+        ol = modl.get_outputs()[0].asnumpy()
+        np.testing.assert_allclose(of, ol, rtol=1e-4, atol=1e-5)
+
+    def test_lr_scheduler_drives_fused_step(self):
+        """A FactorScheduler must change the effective lr inside the fused
+        step: with factor=0 after step 1 the params freeze."""
+        sym = _mlp()
+        batches = _batches(4, seed=3)
+        sched = mx.lr_scheduler.FactorScheduler(step=1, factor=1e-8)
+        mod = mx.mod.Module(sym, context=[mx.cpu(i) for i in range(4)])
+        mod.bind(data_shapes=[("data", batches[0].data[0].shape)],
+                 label_shapes=[("softmax_label", batches[0].label[0].shape)])
+        mod.init_params(initializer=mx.init.Xavier())
+        mod.init_optimizer(optimizer="sgd", optimizer_params=(
+            ("learning_rate", 0.5), ("momentum", 0.0),
+            ("lr_scheduler", sched)))
+        assert mod._spmd is not None
+        mod.forward_backward(batches[0])
+        mod.update()
+        after_1, _ = mod.get_params()
+        after_1 = {k: v.asnumpy().copy() for k, v in after_1.items()}
+        for b in batches[1:]:
+            mod.forward_backward(b)
+            mod.update()
+        after_n, _ = mod.get_params()
+        for k, v in after_n.items():
+            np.testing.assert_allclose(v.asnumpy(), after_1[k], rtol=0, atol=1e-6)
+
+    def test_fit_converges_and_scores(self):
+        """End-to-end fit() on separable data through the fused path, then
+        score() (which must see the SPMD-updated params via forward)."""
+        rs = np.random.RandomState(0)
+        n, feat = 256, 16
+        w = rs.randn(feat, 2).astype("float32")
+        x = rs.randn(n, feat).astype("float32")
+        y = np.argmax(x @ w, axis=1).astype("float32")
+        it = mx.io.NDArrayIter(x, y, batch_size=32, shuffle=False,
+                               label_name="softmax_label")
+        sym = _mlp(hidden=32, classes=2)
+        mod = mx.mod.Module(sym, context=[mx.cpu(i) for i in range(8)])
+        mod.fit(it, num_epoch=12, optimizer="sgd",
+                optimizer_params=(("learning_rate", 0.5), ("momentum", 0.9)),
+                initializer=mx.init.Xavier(magnitude=2.0),
+                eval_metric="acc", kvstore="local")
+        assert mod._spmd is not None
+        it.reset()
+        score = mod.score(it, mx.metric.Accuracy())
+        acc = dict(score)["accuracy"]
+        assert acc > 0.95, "fused-path fit failed to converge: acc=%.3f" % acc
+
+    def test_checkpoint_roundtrip_with_spmd_states(self, tmp_path):
+        sym = _mlp()
+        batches = _batches(2)
+        mod, params = _fit_params(sym, [mx.cpu(i) for i in range(4)], batches)
+        prefix = str(tmp_path / "spmd")
+        mod.save_checkpoint(prefix, 1, save_optimizer_states=True)
+        loaded = mx.mod.Module.load(prefix, 1, load_optimizer_states=True,
+                                    context=[mx.cpu(i) for i in range(4)])
+        loaded.bind(data_shapes=[("data", batches[0].data[0].shape)],
+                    label_shapes=[("softmax_label", batches[0].label[0].shape)])
+        loaded.init_params()
+        loaded.init_optimizer(optimizer="sgd", optimizer_params=(
+            ("learning_rate", 0.1), ("momentum", 0.9)))
+        args, _ = loaded.get_params()
+        for k, v in args.items():
+            np.testing.assert_allclose(v.asnumpy(), params[k], rtol=1e-6)
+        # the momentum state survived the round-trip into the fused step
+        assert loaded._spmd is not None
+        mom = loaded._spmd.trainer.opt_state.get("mom")
+        assert mom and any(np.abs(np.asarray(m)).sum() > 0 for m in mom.values())
