@@ -59,25 +59,36 @@ def initialize_kvstore(kvstore, param_arrays, arg_params, param_names, update_on
 
 def update_params_on_kvstore(param_arrays, grad_arrays, kvstore):
     """(reference: model.py:88 _update_params_on_kvstore) — push grads (store
-    reduces + runs the optimizer), pull fresh weights back to every device."""
-    for index, pair in enumerate(zip(param_arrays, grad_arrays)):
-        arg_list, grad_list = pair
+    reduces + runs the optimizer), pull fresh weights back to every device.
+
+    All keys go in ONE push and ONE pull: in dist mode the store batches the
+    whole round into a single compiled all-reduce (the reference instead
+    hand-ordered per-key transfers with priority=-index; the batched
+    collective makes that scheduling XLA's problem)."""
+    keys, grads, args = [], [], []
+    for index, (arg_list, grad_list) in enumerate(zip(param_arrays, grad_arrays)):
         if grad_list[0] is None:
             continue
-        kvstore.push(index, grad_list, priority=-index)
-        kvstore.pull(index, arg_list, priority=-index)
+        keys.append(index)
+        grads.append(grad_list)
+        args.append(arg_list)
+    if not keys:
+        return
+    kvstore.push(keys, grads)
+    kvstore.pull(keys, args)
 
 
 def update_params(param_arrays, grad_arrays, updater, num_device, kvstore=None):
     """(reference: model.py:99 _update_params) — optionally reduce via kvstore,
     then run the updater per device copy."""
-    for index, pair in enumerate(zip(param_arrays, grad_arrays)):
-        arg_list, grad_list = pair
-        if grad_list[0] is None:
-            continue
-        if kvstore:
-            kvstore.push(index, grad_list, priority=-index)
-            kvstore.pull(index, grad_list, priority=-index)
+    live = [(i, a, g) for i, (a, g) in enumerate(zip(param_arrays, grad_arrays))
+            if g[0] is not None]
+    if kvstore and live:
+        # one batched reduce round for every key (dist: one collective)
+        keys = [i for i, _, _ in live]
+        kvstore.push(keys, [g for _, _, g in live])
+        kvstore.pull(keys, [g for _, _, g in live])
+    for index, arg_list, grad_list in live:
         for k, p, g in zip(range(len(arg_list)), arg_list, grad_list):
             # use a unique integer key per (param, device) for updater state
             updater(index * num_device + k, g, p)

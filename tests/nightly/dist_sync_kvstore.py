@@ -50,6 +50,15 @@ def main():
         np.testing.assert_allclose(out.asnumpy(), rank_sum * r, rtol=1e-6)
         kv._barrier()
 
+    # batched multi-key push: all keys of the call ride ONE compiled
+    # all-reduce (flatten-concat); closed form must still hold per key
+    kv.push(KEYS, [mx.nd.ones(SHAPE) * (rank + 1) * int(k) for k in KEYS])
+    outs = [mx.nd.zeros(SHAPE) for _ in KEYS]
+    kv.pull(KEYS, out=outs)
+    for k, out in zip(KEYS, outs):
+        np.testing.assert_allclose(out.asnumpy(), rank_sum * int(k), rtol=1e-6)
+    kv._barrier()
+
     print("dist_sync_kvstore rank %d/%d: all closed-form checks passed" % (rank, nworker))
 
 

@@ -58,16 +58,78 @@ def measure(size_mb, n_iter=10):
     return dt, algo_bw, n
 
 
+def measure_kvstore(size_mb, n_iter=10, legacy=False):
+    """Measure the *KVStore* dist allreduce path (push+pull round-trip of one
+    key), the quantity BASELINE.md tracks. Run under tools/launch.py so
+    multiple processes join the collective:
+
+        python tools/launch.py -n 8 --launcher local --cpu-devices 1 \\
+            python tools/bandwidth/measure.py --kvstore --sizes 16
+
+    ``legacy=True`` measures the round-2 per-key host allgather+sum instead
+    of the compiled collective, for comparison."""
+    import mxnet_tpu as mx
+    from mxnet_tpu.ndarray import NDArray
+
+    kv = mx.kv.create("dist_tpu_sync")
+    n = kv.num_workers
+    elems = int(size_mb * 1e6 / 4)
+    val = mx.nd.ones((elems,))
+    kv.init("bw", mx.nd.zeros((elems,)))
+
+    if legacy:
+        def allgather_sum(arr):
+            import jax.numpy as jnp
+            from jax.experimental.multihost_utils import process_allgather
+
+            gathered = process_allgather(arr._jax())
+            return NDArray(jnp.sum(gathered, axis=0), ctx=arr.context)
+
+        def round_trip():
+            kv._store["bw"] = allgather_sum(val)
+            out = mx.nd.zeros((elems,))
+            kv.pull("bw", out=out)
+            return out
+    else:
+        def round_trip():
+            kv.push("bw", val)
+            out = mx.nd.zeros((elems,))
+            kv.pull("bw", out=out)
+            return out
+
+    out = round_trip()  # warmup/compile
+    out.wait_to_read()
+    kv._barrier()
+    t0 = time.perf_counter()
+    for _ in range(n_iter):
+        out = round_trip()
+    out.wait_to_read()
+    dt = (time.perf_counter() - t0) / n_iter
+    nbytes = elems * 4
+    algo_bw = 2 * (n - 1) / max(n, 1) * nbytes / dt / 1e9
+    return dt, algo_bw, n
+
+
 def main():
     parser = argparse.ArgumentParser(description="all-reduce bandwidth")
     parser.add_argument("--sizes", type=str, default="1,4,16,64",
                         help="comma-separated MB sizes")
     parser.add_argument("--iters", type=int, default=10)
+    parser.add_argument("--kvstore", action="store_true",
+                        help="measure the dist KVStore push/pull path "
+                             "(run under tools/launch.py)")
+    parser.add_argument("--legacy-allgather", action="store_true",
+                        help="with --kvstore: measure the host allgather "
+                             "path instead of the compiled collective")
     args = parser.parse_args()
 
     print("%8s %12s %12s" % ("size_MB", "time_ms", "busbw_GB/s"))
     for size in (float(s) for s in args.sizes.split(",")):
-        dt, bw, n = measure(size, args.iters)
+        if args.kvstore:
+            dt, bw, n = measure_kvstore(size, args.iters,
+                                        legacy=args.legacy_allgather)
+        else:
+            dt, bw, n = measure(size, args.iters)
         print("%8g %12.3f %12.2f   (%d devices)" % (size, dt * 1e3, bw, n))
 
 
