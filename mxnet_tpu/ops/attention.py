@@ -36,7 +36,7 @@ def _multi_head_attention(attrs, query, key, value):
     if os.environ.get("MXNET_USE_PALLAS_ATTENTION", "0") == "1":
         from . import pallas_attention as pa
 
-        if pa.supported(query.shape, key.shape):
+        if pa.supported(query.shape, key.shape, causal=attrs["causal"]):
             on_tpu = jax.default_backend() == "tpu"
             return pa.flash_attention(
                 query, key, value, causal=attrs["causal"],

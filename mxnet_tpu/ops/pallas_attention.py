@@ -1,17 +1,26 @@
-"""Flash attention as a Pallas TPU kernel.
+"""Flash attention as a differentiable Pallas TPU kernel.
 
-The fused-softmax-attention hot path, hand-tiled for VMEM: queries stream in
-``block_q`` tiles (one per grid step), keys/values stream through an online-
-softmax ``fori_loop`` in ``block_k`` tiles, so the (T, S) score matrix never
-materializes in HBM — O(T·D) memory instead of O(T·S). This is the kernel
-counterpart of the reference's cuDNN-fused attention-era ops; the pure-XLA
-path (ops/attention.py) remains the default, and this kernel is opted in
-with ``MXNET_USE_PALLAS_ATTENTION=1`` on TPU (it also runs anywhere under
-Pallas interpret mode, which is how the tests exercise it on CPU).
+The fused-softmax-attention hot path, hand-tiled for VMEM. Queries tile over
+one grid axis and keys/values stream over the innermost grid axis in
+``block_k`` tiles — each grid step DMAs one (block_k, D) K/V tile from HBM,
+with the online-softmax running state (m, l, acc) carried across the k steps
+in VMEM scratch. The (T, S) score matrix never materializes and K/V never
+occupy more than one tile of VMEM, so long-S shapes stream instead of
+blowing VMEM. O(T·D) memory instead of O(T·S).
 
-Layout: (B, H, T, D) folded to (B*H, T, D); grid = (B*H, T/block_q); the
-causal mask is bottom-right aligned for rectangular S >= T (decode) shapes,
-matching ops/attention.py.
+Training-ready: ``jax.custom_vjp`` with recompute-style flash backward
+kernels (dq and dk/dv passes re-derive the probabilities from the saved
+logsumexp rather than storing P), the same structure cuDNN-era fused
+attention used on GPU. This is the kernel counterpart of the reference's
+cuDNN attention ops; the pure-XLA path (ops/attention.py) remains the
+default, and this kernel is opted in with ``MXNET_USE_PALLAS_ATTENTION=1``
+on TPU (it also runs anywhere under Pallas interpret mode, which is how the
+tests exercise it on CPU).
+
+Layout: (B, H, T, D) folded to (B*H, T, D). The causal mask is bottom-right
+aligned for rectangular S >= T (decode) shapes, matching ops/attention.py;
+causal with S < T is rejected by ``supported()`` (fully-masked rows would
+poison the online softmax).
 """
 from __future__ import annotations
 
@@ -26,80 +35,289 @@ __all__ = ["flash_attention", "supported"]
 _NEG_INF = -1e30
 
 
-def supported(q_shape, k_shape, block_q=128, block_k=128):
+def supported(q_shape, k_shape, causal=False, block_q=128, block_k=128):
     """Whether shapes tile cleanly onto the kernel grid."""
     B, H, T, D = q_shape
     S = k_shape[2]
-    return T % block_q == 0 and S % block_k == 0 and D % 8 == 0
+    if causal and S < T:
+        # bottom-right alignment would fully mask rows r < T-S; the online
+        # softmax has no valid key for them — use the XLA path instead
+        return False
+    bq, bk = min(block_q, T), min(block_k, S)
+    # block dims must stay sublane-aligned (8 for f32) or Mosaic rejects them
+    return (T % bq == 0 and S % bk == 0 and bq % 8 == 0 and bk % 8 == 0
+            and D % 8 == 0)
 
 
-def _kernel(q_ref, k_ref, v_ref, o_ref, *, scale, causal, block_k, seq_k,
-            block_q):
+def _causal_mask(s, iq, jk, block_q, block_k, offset):
+    """Bottom-right-aligned causal mask for one (block_q, block_k) tile:
+    query row r sees key cols <= r + (S - T)."""
+    rows = iq * block_q + jax.lax.broadcasted_iota(
+        jnp.int32, (block_q, block_k), 0)
+    cols = jk * block_k + jax.lax.broadcasted_iota(
+        jnp.int32, (block_q, block_k), 1)
+    return jnp.where(cols <= rows + offset, s, _NEG_INF)
+
+
+# --------------------------------------------------------------------- forward
+def _fwd_kernel(q_ref, k_ref, v_ref, o_ref, lse_ref, m_scr, l_scr, acc_scr,
+                *, scale, causal, block_q, block_k, nk, offset):
     from jax.experimental import pallas as pl
 
-    iq = pl.program_id(1)
-    q = q_ref[0].astype(jnp.float32) * scale  # (block_q, D)
-    nk = seq_k // block_k
+    iq, jk = pl.program_id(1), pl.program_id(2)
 
-    def body(j, carry):
-        m, l, acc = carry
-        k = k_ref[0, pl.ds(j * block_k, block_k), :].astype(jnp.float32)
-        v = v_ref[0, pl.ds(j * block_k, block_k), :].astype(jnp.float32)
-        s = jax.lax.dot_general(q, k, (((1,), (1,)), ((), ())),
-                                preferred_element_type=jnp.float32)
-        if causal:
-            # bottom-right aligned: query row r may see key cols <= r + (S-T)
-            rows = iq * block_q + jax.lax.broadcasted_iota(
-                jnp.int32, (block_q, block_k), 0)
-            cols = j * block_k + jax.lax.broadcasted_iota(
-                jnp.int32, (block_q, block_k), 1)
-            offset = seq_k - pl.num_programs(1) * block_q
-            s = jnp.where(cols <= rows + offset, s, _NEG_INF)
-        m_new = jnp.maximum(m, s.max(axis=-1, keepdims=True))
-        alpha = jnp.exp(m - m_new)
-        p = jnp.exp(s - m_new)
-        l_new = l * alpha + p.sum(axis=-1, keepdims=True)
-        acc_new = acc * alpha + jax.lax.dot_general(
-            p, v, (((1,), (0,)), ((), ())),
-            preferred_element_type=jnp.float32)
-        return m_new, l_new, acc_new
+    @pl.when(jk == 0)
+    def _init():
+        m_scr[...] = jnp.full_like(m_scr, _NEG_INF)
+        l_scr[...] = jnp.zeros_like(l_scr)
+        acc_scr[...] = jnp.zeros_like(acc_scr)
 
-    D = q.shape[-1]
-    init = (jnp.full((block_q, 1), _NEG_INF, jnp.float32),
-            jnp.zeros((block_q, 1), jnp.float32),
-            jnp.zeros((block_q, D), jnp.float32))
-    m, l, acc = jax.lax.fori_loop(0, nk, body, init)
-    o_ref[0] = (acc / jnp.maximum(l, 1e-30)).astype(o_ref.dtype)
+    q = q_ref[0].astype(jnp.float32) * scale          # (block_q, D)
+    k = k_ref[0].astype(jnp.float32)                  # (block_k, D)
+    v = v_ref[0].astype(jnp.float32)
+    s = jax.lax.dot_general(q, k, (((1,), (1,)), ((), ())),
+                            preferred_element_type=jnp.float32)
+    if causal:
+        s = _causal_mask(s, iq, jk, block_q, block_k, offset)
+
+    m_prev, l_prev = m_scr[...], l_scr[...]
+    m_new = jnp.maximum(m_prev, s.max(axis=-1, keepdims=True))
+    alpha = jnp.exp(m_prev - m_new)
+    p = jnp.exp(s - m_new)
+    m_scr[...] = m_new
+    l_scr[...] = l_prev * alpha + p.sum(axis=-1, keepdims=True)
+    acc_scr[...] = acc_scr[...] * alpha + jax.lax.dot_general(
+        p, v, (((1,), (0,)), ((), ())), preferred_element_type=jnp.float32)
+
+    @pl.when(jk == nk - 1)
+    def _finish():
+        l = jnp.maximum(l_scr[...], 1e-30)
+        o_ref[0] = (acc_scr[...] / l).astype(o_ref.dtype)
+        lse_ref[0] = (m_scr[...] + jnp.log(l)).astype(lse_ref.dtype)
+
+
+# ------------------------------------------------------------------- backward
+def _dq_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref, dq_ref,
+               dq_scr, *, scale, causal, block_q, block_k, nk, offset):
+    from jax.experimental import pallas as pl
+
+    iq, jk = pl.program_id(1), pl.program_id(2)
+
+    @pl.when(jk == 0)
+    def _init():
+        dq_scr[...] = jnp.zeros_like(dq_scr)
+
+    q = q_ref[0].astype(jnp.float32) * scale
+    k = k_ref[0].astype(jnp.float32)
+    v = v_ref[0].astype(jnp.float32)
+    do = do_ref[0].astype(jnp.float32)                # (block_q, D)
+    lse = lse_ref[0].astype(jnp.float32)              # (block_q, 1)
+    delta = delta_ref[0].astype(jnp.float32)          # (block_q, 1)
+
+    s = jax.lax.dot_general(q, k, (((1,), (1,)), ((), ())),
+                            preferred_element_type=jnp.float32)
+    if causal:
+        s = _causal_mask(s, iq, jk, block_q, block_k, offset)
+    p = jnp.exp(s - lse)                              # recomputed probs
+    dp = jax.lax.dot_general(do, v, (((1,), (1,)), ((), ())),
+                             preferred_element_type=jnp.float32)
+    ds = p * (dp - delta)
+    dq_scr[...] += jax.lax.dot_general(
+        ds, k, (((1,), (0,)), ((), ())), preferred_element_type=jnp.float32)
+
+    @pl.when(jk == nk - 1)
+    def _finish():
+        dq_ref[0] = (dq_scr[...] * scale).astype(dq_ref.dtype)
+
+
+def _dkv_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
+                dk_ref, dv_ref, dk_scr, dv_scr,
+                *, scale, causal, block_q, block_k, nq, offset):
+    from jax.experimental import pallas as pl
+
+    jk, iq = pl.program_id(1), pl.program_id(2)      # q streams innermost
+
+    @pl.when(iq == 0)
+    def _init():
+        dk_scr[...] = jnp.zeros_like(dk_scr)
+        dv_scr[...] = jnp.zeros_like(dv_scr)
+
+    q = q_ref[0].astype(jnp.float32) * scale
+    k = k_ref[0].astype(jnp.float32)
+    v = v_ref[0].astype(jnp.float32)
+    do = do_ref[0].astype(jnp.float32)
+    lse = lse_ref[0].astype(jnp.float32)
+    delta = delta_ref[0].astype(jnp.float32)
+
+    s = jax.lax.dot_general(q, k, (((1,), (1,)), ((), ())),
+                            preferred_element_type=jnp.float32)
+    if causal:
+        s = _causal_mask(s, iq, jk, block_q, block_k, offset)
+    p = jnp.exp(s - lse)                              # (block_q, block_k)
+    dv_scr[...] += jax.lax.dot_general(
+        p, do, (((0,), (0,)), ((), ())), preferred_element_type=jnp.float32)
+    dp = jax.lax.dot_general(do, v, (((1,), (1,)), ((), ())),
+                             preferred_element_type=jnp.float32)
+    ds = p * (dp - delta)                             # (block_q, block_k)
+    dk_scr[...] += jax.lax.dot_general(
+        ds, q, (((0,), (0,)), ((), ())), preferred_element_type=jnp.float32)
+
+    @pl.when(iq == nq - 1)
+    def _finish():
+        # q already carries the scale factor; dk needs none on top
+        dk_ref[0] = dk_scr[...].astype(dk_ref.dtype)
+        dv_ref[0] = dv_scr[...].astype(dv_ref.dtype)
+
+
+# ---------------------------------------------------------------- pallas glue
+def _compiler_params(n_parallel):
+    from jax.experimental.pallas import tpu as pltpu
+
+    sem = (pltpu.GridDimensionSemantics.PARALLEL,) * n_parallel + (
+        pltpu.GridDimensionSemantics.ARBITRARY,)
+    return pltpu.CompilerParams(dimension_semantics=sem)
+
+
+def _fwd_call(q, k, v, causal, scale, block_q, block_k, interpret):
+    from jax.experimental import pallas as pl
+    from jax.experimental.pallas import tpu as pltpu
+
+    BH, T, D = q.shape
+    S = k.shape[1]
+    nq, nk = T // block_q, S // block_k
+    offset = S - T
+    kern = functools.partial(
+        _fwd_kernel, scale=scale, causal=causal, block_q=block_q,
+        block_k=block_k, nk=nk, offset=offset)
+    o, lse = pl.pallas_call(
+        kern,
+        grid=(BH, nq, nk),
+        in_specs=[
+            pl.BlockSpec((1, block_q, D), lambda bh, iq, jk: (bh, iq, 0)),
+            pl.BlockSpec((1, block_k, D), lambda bh, iq, jk: (bh, jk, 0)),
+            pl.BlockSpec((1, block_k, D), lambda bh, iq, jk: (bh, jk, 0)),
+        ],
+        out_specs=[
+            pl.BlockSpec((1, block_q, D), lambda bh, iq, jk: (bh, iq, 0)),
+            pl.BlockSpec((1, block_q, 1), lambda bh, iq, jk: (bh, iq, 0)),
+        ],
+        out_shape=[
+            jax.ShapeDtypeStruct((BH, T, D), q.dtype),
+            jax.ShapeDtypeStruct((BH, T, 1), jnp.float32),
+        ],
+        scratch_shapes=[
+            pltpu.VMEM((block_q, 1), jnp.float32),
+            pltpu.VMEM((block_q, 1), jnp.float32),
+            pltpu.VMEM((block_q, D), jnp.float32),
+        ],
+        compiler_params=None if interpret else _compiler_params(2),
+        interpret=interpret,
+    )(q, k, v)
+    return o, lse
+
+
+def _bwd_call(q, k, v, o, lse, do, causal, scale, block_q, block_k, interpret):
+    from jax.experimental import pallas as pl
+    from jax.experimental.pallas import tpu as pltpu
+
+    BH, T, D = q.shape
+    S = k.shape[1]
+    nq, nk = T // block_q, S // block_k
+    offset = S - T
+    # delta_i = sum_d dO_i O_i — cheap elementwise, fused by XLA
+    delta = jnp.sum(do.astype(jnp.float32) * o.astype(jnp.float32),
+                    axis=-1, keepdims=True)
+
+    dq = pl.pallas_call(
+        functools.partial(_dq_kernel, scale=scale, causal=causal,
+                          block_q=block_q, block_k=block_k, nk=nk,
+                          offset=offset),
+        grid=(BH, nq, nk),
+        in_specs=[
+            pl.BlockSpec((1, block_q, D), lambda bh, iq, jk: (bh, iq, 0)),
+            pl.BlockSpec((1, block_k, D), lambda bh, iq, jk: (bh, jk, 0)),
+            pl.BlockSpec((1, block_k, D), lambda bh, iq, jk: (bh, jk, 0)),
+            pl.BlockSpec((1, block_q, D), lambda bh, iq, jk: (bh, iq, 0)),
+            pl.BlockSpec((1, block_q, 1), lambda bh, iq, jk: (bh, iq, 0)),
+            pl.BlockSpec((1, block_q, 1), lambda bh, iq, jk: (bh, iq, 0)),
+        ],
+        out_specs=pl.BlockSpec((1, block_q, D), lambda bh, iq, jk: (bh, iq, 0)),
+        out_shape=jax.ShapeDtypeStruct((BH, T, D), q.dtype),
+        scratch_shapes=[pltpu.VMEM((block_q, D), jnp.float32)],
+        compiler_params=None if interpret else _compiler_params(2),
+        interpret=interpret,
+    )(q, k, v, do, lse, delta)
+
+    dk, dv = pl.pallas_call(
+        functools.partial(_dkv_kernel, scale=scale, causal=causal,
+                          block_q=block_q, block_k=block_k, nq=nq,
+                          offset=offset),
+        grid=(BH, nk, nq),
+        in_specs=[
+            pl.BlockSpec((1, block_q, D), lambda bh, jk, iq: (bh, iq, 0)),
+            pl.BlockSpec((1, block_k, D), lambda bh, jk, iq: (bh, jk, 0)),
+            pl.BlockSpec((1, block_k, D), lambda bh, jk, iq: (bh, jk, 0)),
+            pl.BlockSpec((1, block_q, D), lambda bh, jk, iq: (bh, iq, 0)),
+            pl.BlockSpec((1, block_q, 1), lambda bh, jk, iq: (bh, iq, 0)),
+            pl.BlockSpec((1, block_q, 1), lambda bh, jk, iq: (bh, iq, 0)),
+        ],
+        out_specs=[
+            pl.BlockSpec((1, block_k, D), lambda bh, jk, iq: (bh, jk, 0)),
+            pl.BlockSpec((1, block_k, D), lambda bh, jk, iq: (bh, jk, 0)),
+        ],
+        out_shape=[
+            jax.ShapeDtypeStruct((BH, S, D), k.dtype),
+            jax.ShapeDtypeStruct((BH, S, D), v.dtype),
+        ],
+        scratch_shapes=[
+            pltpu.VMEM((block_k, D), jnp.float32),
+            pltpu.VMEM((block_k, D), jnp.float32),
+        ],
+        compiler_params=None if interpret else _compiler_params(2),
+        interpret=interpret,
+    )(q, k, v, do, lse, delta)
+    return dq, dk, dv
+
+
+# ------------------------------------------------------------------ custom vjp
+@functools.partial(jax.custom_vjp, nondiff_argnums=(3, 4, 5, 6, 7))
+def _flash(q, k, v, causal, scale, block_q, block_k, interpret):
+    o, _ = _fwd_call(q, k, v, causal, scale, block_q, block_k, interpret)
+    return o
+
+
+def _flash_fwd(q, k, v, causal, scale, block_q, block_k, interpret):
+    o, lse = _fwd_call(q, k, v, causal, scale, block_q, block_k, interpret)
+    return o, (q, k, v, o, lse)
+
+
+def _flash_bwd(causal, scale, block_q, block_k, interpret, res, do):
+    q, k, v, o, lse = res
+    return _bwd_call(q, k, v, o, lse, do, causal, scale, block_q, block_k,
+                     interpret)
+
+
+_flash.defvjp(_flash_fwd, _flash_bwd)
 
 
 @functools.partial(jax.jit, static_argnames=("causal", "scale", "block_q",
                                              "block_k", "interpret"))
 def flash_attention(q, k, v, causal=False, scale=0.0, block_q=128,
                     block_k=128, interpret=False):
-    """softmax(QKᵀ·scale)V over (B, H, T, D), streamed through VMEM."""
-    from jax.experimental import pallas as pl
-
+    """softmax(QKᵀ·scale)V over (B, H, T, D), streamed through VMEM.
+    Differentiable (custom_vjp flash backward)."""
     B, H, T, D = q.shape
     S = k.shape[2]
+    if causal and S < T:
+        raise ValueError(
+            "flash_attention(causal=True) requires S >= T (got T=%d, S=%d): "
+            "bottom-right alignment would fully mask rows < T-S; use the "
+            "XLA attention path for these shapes" % (T, S))
     if scale <= 0:
         scale = 1.0 / np.sqrt(D)
     block_q = min(block_q, T)
     block_k = min(block_k, S)
-    qf = q.reshape(B * H, T, D)
-    kf = k.reshape(B * H, S, D)
-    vf = v.reshape(B * H, S, D)
-
-    out = pl.pallas_call(
-        functools.partial(_kernel, scale=scale, causal=causal,
-                          block_k=block_k, seq_k=S, block_q=block_q),
-        grid=(B * H, T // block_q),
-        in_specs=[
-            pl.BlockSpec((1, block_q, D), lambda bh, iq: (bh, iq, 0)),
-            pl.BlockSpec((1, S, D), lambda bh, iq: (bh, 0, 0)),
-            pl.BlockSpec((1, S, D), lambda bh, iq: (bh, 0, 0)),
-        ],
-        out_specs=pl.BlockSpec((1, block_q, D), lambda bh, iq: (bh, iq, 0)),
-        out_shape=jax.ShapeDtypeStruct((B * H, T, D), q.dtype),
-        interpret=interpret,
-    )(qf, kf, vf)
+    out = _flash(q.reshape(B * H, T, D), k.reshape(B * H, S, D),
+                 v.reshape(B * H, S, D), causal, float(scale),
+                 block_q, block_k, interpret)
     return out.reshape(B, H, T, D)
