@@ -18,6 +18,8 @@ import jax
 import jax.numpy as jnp
 import numpy as np
 
+import functools
+
 from ..base import MXNetError
 from .registry import AttrSpec, register
 
@@ -285,6 +287,76 @@ def _bn_outputs(attrs):
     return 3 if attrs.get("output_mean_var") else 1
 
 
+@functools.lru_cache(maxsize=None)
+def _bn_train_core(ndim, eps, fix_gamma):
+    """Hand-derived BN fwd/bwd as a custom_vjp.
+
+    Why not plain autodiff: differentiating through the fp32 stats view of the
+    activation makes XLA materialise fp32 cotangents of every BN input in the
+    backward pass — on a ResNet-50/224 b256 step that was ~28% of device time
+    in `multiply_reduce`/`add_any` fusions (see docs/PERF.md, round-4 profile).
+    Here every elementwise pass stays in the activation dtype (bf16 on the MXU
+    fast path) and fp32 appears only inside reduction accumulators — the
+    canonical memory-bound-TPU formulation. Math matches the reference's
+    batch_norm-inl.h Forward/Backward (biased batch variance, dgamma=0 under
+    fix_gamma).
+    """
+    axes = (0,) + tuple(range(2, ndim))
+
+    def stats(x):
+        # one fused pass: sum(x) and sum(x^2) with fp32 accumulators
+        cnt = 1
+        for a in axes:
+            cnt *= x.shape[a]
+        x32 = x.astype(jnp.float32)
+        mean = jnp.sum(x32, axis=axes) / cnt
+        var = jnp.sum(jnp.square(x32), axis=axes) / cnt - jnp.square(mean)
+        return mean, var
+
+    def fwd_impl(x, gamma, beta):
+        bshape = (1, -1) + (1,) * (ndim - 2)
+        mean, var = stats(x)
+        invstd = jax.lax.rsqrt(var + eps)
+        m = mean.astype(x.dtype)
+        istd = invstd.astype(x.dtype)
+        xhat = (x - m.reshape(bshape)) * istd.reshape(bshape)
+        if fix_gamma:
+            out = xhat + beta.reshape(bshape)
+        else:
+            out = xhat * gamma.reshape(bshape) + beta.reshape(bshape)
+        return out, mean, var, m, istd
+
+    @jax.custom_vjp
+    def bn(x, gamma, beta):
+        out, mean, var, _, _ = fwd_impl(x, gamma, beta)
+        return out, mean, var
+
+    def bn_fwd(x, gamma, beta):
+        out, mean, var, m, istd = fwd_impl(x, gamma, beta)
+        return (out, mean, var), (x, gamma, m, istd)
+
+    def bn_bwd(res, cts):
+        dy = cts[0]  # mean/var head cotangents are zero in training graphs
+        x, gamma, m, istd = res
+        bshape = (1, -1) + (1,) * (ndim - 2)
+        cnt = 1
+        for a in axes:
+            cnt *= x.shape[a]
+        xhat = (x - m.reshape(bshape)) * istd.reshape(bshape)
+        # both reductions in one fused pass, fp32 accumulators
+        dbeta32 = jnp.sum(dy.astype(jnp.float32), axis=axes)
+        dgamma32 = jnp.sum((dy * xhat).astype(jnp.float32), axis=axes)
+        g_istd = (istd if fix_gamma else gamma * istd).astype(x.dtype)
+        c1 = (dbeta32 / cnt).astype(x.dtype)
+        c2 = (dgamma32 / cnt).astype(x.dtype)
+        dx = g_istd.reshape(bshape) * (dy - c1.reshape(bshape) - xhat * c2.reshape(bshape))
+        dgamma = (jnp.zeros_like(dgamma32) if fix_gamma else dgamma32).astype(gamma.dtype)
+        return dx, dgamma, dbeta32.astype(gamma.dtype)
+
+    bn.defvjp(bn_fwd, bn_bwd)
+    return bn
+
+
 @register(
     "BatchNorm",
     attrs={
@@ -308,25 +380,22 @@ def _batch_norm(attrs, inputs, aux, is_train=False):
     data, gamma, beta = inputs
     moving_mean, moving_var = aux
     eps, momentum = attrs["eps"], attrs["momentum"]
-    if attrs["fix_gamma"]:
-        gamma = jax.lax.stop_gradient(jnp.ones_like(gamma))
     bshape = (1, -1) + (1,) * (data.ndim - 2)
-    axes = (0,) + tuple(range(2, data.ndim))
     if is_train and not attrs["use_global_stats"]:
-        x32 = data.astype(jnp.float32)
-        mean = jnp.mean(x32, axis=axes)
-        var = jnp.mean(jnp.square(x32), axis=axes) - jnp.square(mean)
+        bn = _bn_train_core(data.ndim, float(eps), bool(attrs["fix_gamma"]))
+        out, mean, var = bn(data, gamma, beta)
         new_mean = moving_mean * momentum + jax.lax.stop_gradient(mean) * (1 - momentum)
         new_var = moving_var * momentum + jax.lax.stop_gradient(var) * (1 - momentum)
         m, v = mean.astype(data.dtype), var.astype(data.dtype)
-        new_aux = (new_mean, new_var)
-    else:
-        m, v = moving_mean, moving_var
-        new_aux = (moving_mean, moving_var)
+        outs = (out, m, v) if attrs["output_mean_var"] else (out,)
+        return outs, (new_mean, new_var)
+    if attrs["fix_gamma"]:
+        gamma = jax.lax.stop_gradient(jnp.ones_like(gamma))
+    m, v = moving_mean, moving_var
     out = (data - m.reshape(bshape)) * jax.lax.rsqrt(v.reshape(bshape) + eps)
     out = out * gamma.reshape(bshape) + beta.reshape(bshape)
     outs = (out, m, v) if attrs["output_mean_var"] else (out,)
-    return outs, new_aux
+    return outs, (moving_mean, moving_var)
 
 
 # --- Loss/output layers (custom-vjp: ignore head gradient) --------------------
@@ -367,9 +436,6 @@ def _softmax_output_grad(prob, label, attrs):
     elif norm == "valid":
         grad = grad / jnp.maximum(jnp.sum(valid), 1.0)
     return grad * scale
-
-
-import functools
 
 
 @functools.lru_cache(maxsize=None)

@@ -1,0 +1,74 @@
+"""Perf lab: measure ResNet-50 step time on the chip under different knobs.
+
+Usage: python tools/perf_lab.py [--batch N] [--layout nchw|nhwc] [--profile DIR]
+
+Not part of the public API — the experimental harness behind docs/PERF.md.
+"""
+import argparse
+import json
+import os
+import sys
+import time
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+
+def main():
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--batch", type=int, default=256)
+    ap.add_argument("--image", type=int, default=224)
+    ap.add_argument("--net", default="resnet-50")
+    ap.add_argument("--steps", type=int, default=10)
+    ap.add_argument("--profile", default=None, help="capture jax trace to DIR")
+    ap.add_argument("--compute-dtype", default="bfloat16")
+    args = ap.parse_args()
+
+    import jax
+    import numpy as np
+
+    from mxnet_tpu import models, parallel
+
+    dev = jax.devices()[0]
+    mesh = parallel.make_mesh((1,), axis_names=("data",), devices=[dev])
+    net = models.get_symbol(args.net, num_classes=1000,
+                            image_shape="3,%d,%d" % (args.image, args.image))
+    trainer = parallel.SPMDTrainer(
+        net, mesh, optimizer="sgd",
+        optimizer_params={"learning_rate": 0.1, "momentum": 0.9},
+        compute_dtype=args.compute_dtype or None)
+    b = args.batch
+    trainer.init_params({"data": (b, 3, args.image, args.image)},
+                        {"softmax_label": (b,)}, seed=0)
+    rs = np.random.RandomState(0)
+    x = jax.device_put(rs.rand(b, 3, args.image, args.image).astype("float32"),
+                       trainer.rules.named(trainer.rules.batch_spec((b, 3, args.image, args.image))))
+    y = jax.device_put(rs.randint(0, 1000, (b,)).astype("float32"),
+                       trainer.rules.named(trainer.rules.batch_spec((b,))))
+    for _ in range(3):
+        outs = trainer.step({"data": x}, {"softmax_label": y})
+    jax.block_until_ready(outs)
+    jax.block_until_ready(trainer.params)
+
+    if args.profile:
+        jax.profiler.start_trace(args.profile)
+    t0 = time.perf_counter()
+    for _ in range(args.steps):
+        outs = trainer.step({"data": x}, {"softmax_label": y})
+    jax.block_until_ready(outs)
+    jax.block_until_ready(trainer.params)
+    dt = time.perf_counter() - t0
+    if args.profile:
+        jax.profiler.stop_trace()
+
+    img_s = b * args.steps / dt
+    flops = 3 * 4.09e9 * (args.image / 224.0) ** 2
+    peak = 197e12 if "v5 lite" in dev.device_kind else None
+    out = {"batch": b, "step_ms": round(1000 * dt / args.steps, 2),
+           "img_s": round(img_s, 1), "device": dev.device_kind,
+           "layout_env": os.environ.get("MXNET_CONV_LAYOUT", ""),
+           "mfu": round(img_s * flops / peak, 4) if peak else None}
+    print(json.dumps(out))
+
+
+if __name__ == "__main__":
+    main()
