@@ -213,13 +213,22 @@ class _Collective:
     replicated-output sum over axis 0 is the sum over workers, and XLA
     lowers it to an all-reduce riding ICI/DCN."""
 
-    _instance = None
+    _instances = {}
 
     @classmethod
     def get(cls):
-        if cls._instance is None:
-            cls._instance = cls()
-        return cls._instance
+        # keyed on backend identity + device topology: a second KVStore after
+        # a mesh/backend change (including an in-process backend restart with
+        # identical topology) must not reuse a stale worker mesh
+        import jax
+
+        devs = jax.devices()
+        key = (id(devs[0].client),
+               tuple(sorted((d.process_index, d.id) for d in devs)))
+        inst = cls._instances.get(key)
+        if inst is None:
+            inst = cls._instances[key] = cls()
+        return inst
 
     def __init__(self):
         import functools
@@ -293,6 +302,13 @@ def create(name="local") -> KVStore:
              "dist_tpu_sync", "dist_sync", "dist_device_sync", "dist_async")
     if name not in known:
         raise MXNetError("unknown KVStore type %r (known: %s)" % (name, known))
+    if name == "dist_async":
+        import logging
+
+        logging.warning(
+            "KVStore 'dist_async' runs as SYNCHRONOUS all-reduce here: the "
+            "SPMD collective design has no parameter server to absorb stale "
+            "updates. Convergence semantics are those of dist_sync.")
     if "dist" in name:
         # join the job's coordination service if tools/launch.py spawned us
         # (reference: KVStore::InitPSEnv consuming the DMLC_* cluster env)

@@ -147,27 +147,37 @@ def try_create(module, kvstore_obj):
 
     Triggers: multi-device context, a ``dist*`` sync kvstore, or
     ``MXNET_MODULE_FUSED_STEP=1``. ``MXNET_MODULE_FUSED_STEP=0`` disables."""
-    flag = os.environ.get("MXNET_MODULE_FUSED_STEP", "")
-    if flag == "0" or not getattr(module, "_fused_step_ok", True):
-        return None
-    if getattr(module, "_monitor_installed", False):
-        return None  # per-op monitor needs the exec-group path
-    if not module.for_training or module.inputs_need_grad:
-        return None
-    if module._fixed_param_names:
-        return None
-    wl = module._work_load_list
-    if wl and len(set(wl)) > 1:
-        return None
-    if any(module._exec_group.grad_req.get(n) != "write"
-           for n in module._param_names):
+    def rejected(why):
+        # one findable log line naming the trigger — a user asking why their
+        # pod runs the slow per-device path deserves the reason by name
+        logging.warning("fused SPMD step disabled: %s — using the legacy "
+                        "per-device + kvstore path", why)
         return None
 
+    flag = os.environ.get("MXNET_MODULE_FUSED_STEP", "")
+    if flag == "0":
+        return None  # explicit opt-out, no warning needed
     dist = (kvstore_obj is not None and "dist" in kvstore_obj.type
             and "async" not in kvstore_obj.type)
     multi_dev = len(module._context) > 1
     if not (dist or multi_dev or flag == "1"):
-        return None
+        return None  # single device, nothing to fuse over — stay quiet
+    if not module.for_training or module.inputs_need_grad:
+        return None  # inference / grad-of-input binds are not a step at all
+    if not getattr(module, "_fused_step_ok", True):
+        return rejected("module was flagged _fused_step_ok=False")
+    if getattr(module, "_monitor_installed", False):
+        return rejected("a Monitor is installed (per-op taps need the "
+                        "exec-group path)")
+    if module._fixed_param_names:
+        return rejected("fixed_param_names is set")
+    wl = module._work_load_list
+    if wl and len(set(wl)) > 1:
+        return rejected("uneven work_load_list %r" % (wl,))
+    bad_req = [n for n in module._param_names
+               if module._exec_group.grad_req.get(n) != "write"]
+    if bad_req:
+        return rejected("grad_req != 'write' for %s" % bad_req[:3])
 
     from ..parallel.optim import functional_from_optimizer
 
@@ -188,12 +198,13 @@ def try_create(module, kvstore_obj):
     else:
         try:
             devices = [ctx.jax_device for ctx in module._context]
-        except Exception:
-            return None
+        except Exception as exc:
+            return rejected("context has no mappable jax device (%s)" % exc)
         if len({id(d) for d in devices}) != len(devices):
-            return None
+            return rejected("duplicate devices in context list")
     if module._exec_group.batch_size % len(module._context):
-        return None  # data axis must split the per-process batch evenly
+        return rejected("batch size %d does not split evenly over %d devices"
+                        % (module._exec_group.batch_size, len(module._context)))
 
     mesh = make_mesh((len(devices),), ("data",), devices)
     return SPMDStepAdapter(module, mesh, (init, apply), lr_of_step)

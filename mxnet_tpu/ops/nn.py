@@ -336,7 +336,7 @@ def _bn_train_core(ndim, eps, fix_gamma):
         return (out, mean, var), (x, gamma, m, istd)
 
     def bn_bwd(res, cts):
-        dy = cts[0]  # mean/var head cotangents are zero in training graphs
+        dy, ct_mean, ct_var = cts
         x, gamma, m, istd = res
         bshape = (1, -1) + (1,) * (ndim - 2)
         cnt = 1
@@ -350,6 +350,13 @@ def _bn_train_core(ndim, eps, fix_gamma):
         c1 = (dbeta32 / cnt).astype(x.dtype)
         c2 = (dgamma32 / cnt).astype(x.dtype)
         dx = g_istd.reshape(bshape) * (dy - c1.reshape(bshape) - xhat * c2.reshape(bshape))
+        # graphs may differentiate through the mean/var heads too
+        # (output_mean_var=True): mean = Σx/n, var = Σx²/n − mean². The terms
+        # are per-channel scalars broadcast into the dx pass — they fuse, so
+        # the usual zero-cotangent case costs nothing extra in HBM traffic.
+        dx = dx + (ct_mean / cnt).astype(x.dtype).reshape(bshape)
+        cv = (2.0 * ct_var / cnt).astype(x.dtype).reshape(bshape)
+        dx = dx + cv * (x - m.reshape(bshape))
         dgamma = (jnp.zeros_like(dgamma32) if fix_gamma else dgamma32).astype(gamma.dtype)
         return dx, dgamma, dbeta32.astype(gamma.dtype)
 

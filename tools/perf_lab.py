@@ -1,6 +1,6 @@
 """Perf lab: measure ResNet-50 step time on the chip under different knobs.
 
-Usage: python tools/perf_lab.py [--batch N] [--layout nchw|nhwc] [--profile DIR]
+Usage: python tools/perf_lab.py [--batch N] [--net NAME] [--profile DIR]
 
 Not part of the public API — the experimental harness behind docs/PERF.md.
 """
@@ -44,29 +44,36 @@ def main():
                        trainer.rules.named(trainer.rules.batch_spec((b, 3, args.image, args.image))))
     y = jax.device_put(rs.randint(0, 1000, (b,)).astype("float32"),
                        trainer.rules.named(trainer.rules.batch_spec((b,))))
+    import jax.numpy as jnp
+
+    def sync(o):
+        # block_until_ready is a no-op on some remote platforms (axon): the
+        # only reliable barrier is fetching device data to host
+        return np.asarray(jnp.sum(o[0].astype(jnp.float32)))
+
     for _ in range(3):
         outs = trainer.step({"data": x}, {"softmax_label": y})
-    jax.block_until_ready(outs)
-    jax.block_until_ready(trainer.params)
+    sync(outs)
 
     if args.profile:
         jax.profiler.start_trace(args.profile)
     t0 = time.perf_counter()
     for _ in range(args.steps):
         outs = trainer.step({"data": x}, {"softmax_label": y})
-    jax.block_until_ready(outs)
-    jax.block_until_ready(trainer.params)
+    sync(outs)
     dt = time.perf_counter() - t0
     if args.profile:
         jax.profiler.stop_trace()
 
     img_s = b * args.steps / dt
+    # FLOPs model is ResNet-50-specific — MFU only claims meaning there
     flops = 3 * 4.09e9 * (args.image / 224.0) ** 2
     peak = 197e12 if "v5 lite" in dev.device_kind else None
+    mfu_ok = peak and args.net == "resnet-50"
     out = {"batch": b, "step_ms": round(1000 * dt / args.steps, 2),
            "img_s": round(img_s, 1), "device": dev.device_kind,
-           "layout_env": os.environ.get("MXNET_CONV_LAYOUT", ""),
-           "mfu": round(img_s * flops / peak, 4) if peak else None}
+           "net": args.net,
+           "mfu": round(img_s * flops / peak, 4) if mfu_ok else None}
     print(json.dumps(out))
 
 
