@@ -213,22 +213,23 @@ class _Collective:
     replicated-output sum over axis 0 is the sum over workers, and XLA
     lowers it to an all-reduce riding ICI/DCN."""
 
-    _instances = {}
+    _cache = None  # (key, instance) for the CURRENT backend only
 
     @classmethod
     def get(cls):
         # keyed on backend identity + device topology: a second KVStore after
         # a mesh/backend change (including an in-process backend restart with
-        # identical topology) must not reuse a stale worker mesh
+        # identical topology) must not reuse a stale worker mesh. Exactly one
+        # entry is kept — superseded backends (and their meshes/executables)
+        # are released, which also keeps the id()-based key collision-free.
         import jax
 
         devs = jax.devices()
         key = (id(devs[0].client),
                tuple(sorted((d.process_index, d.id) for d in devs)))
-        inst = cls._instances.get(key)
-        if inst is None:
-            inst = cls._instances[key] = cls()
-        return inst
+        if cls._cache is None or cls._cache[0] != key:
+            cls._cache = (key, cls())
+        return cls._cache[1]
 
     def __init__(self):
         import functools

@@ -239,8 +239,11 @@ class Perplexity(EvalMetric):
                 probs = probs * (1 - ignore) + ignore
             loss -= numpy.sum(numpy.log(numpy.maximum(1e-10, probs)))
             num += label.size
-        self.sum_metric += numpy.exp(loss / num) if num > 0 else float("nan")
-        self.num_inst += 1
+        if num > 0:
+            self.sum_metric += numpy.exp(loss / num)
+            self.num_inst += 1
+        # num == 0 (every label ignored, e.g. an all-padding bucket batch)
+        # contributes nothing rather than poisoning the epoch with NaN
 
 
 class MAE(EvalMetric):

@@ -247,8 +247,16 @@ class BaseModule:
                     for callback in _as_list(batch_end_callback):
                         callback(batch_end_params)
 
-            for name, val in eval_metric.get_name_value():
-                self.logger.info("Epoch[%d] Train-%s=%f", epoch, name, val)
+            if getattr(eval_metric, "num_inst", 1):
+                for name, val in eval_metric.get_name_value():
+                    self.logger.info("Epoch[%d] Train-%s=%f", epoch, name, val)
+            else:
+                # a Speedometer with auto_reset cleared the metric on the
+                # epoch's last batch — logging 0/0 as 'nan' here would read
+                # as divergence; the per-batch lines carry the real values
+                self.logger.info(
+                    "Epoch[%d] Train metric was reset by a batch callback on "
+                    "the last batch; see the preceding Batch lines", epoch)
             self.logger.info("Epoch[%d] Time cost=%.3f", epoch, time.time() - tic)
 
             arg_params_, aux_params_ = self.get_params()

@@ -19,13 +19,14 @@ from .module import Module
 class BucketingModule(BaseModule):
     """(reference: bucketing_module.py:18)"""
 
-    def __init__(self, sym_gen, default_bucket_key=None, logger=logging, context=None, work_load_list=None):
+    def __init__(self, sym_gen, default_bucket_key=None, logger=logging, context=None, work_load_list=None, fused_step=True):
         super().__init__(logger=logger)
         assert default_bucket_key is not None
         self._default_bucket_key = default_bucket_key
         self._sym_gen = sym_gen
         self._context = context
         self._work_load_list = work_load_list
+        self._fused_step = bool(fused_step)
         self._buckets = {}
         self._curr_module = None
         self._curr_bucket_key = None
@@ -79,7 +80,11 @@ class BucketingModule(BaseModule):
 
     def get_params(self):
         assert self.binded and self.params_initialized
-        self._curr_module._params_dirty = self._params_dirty
+        # OR, don't overwrite: on the fused path forward_backward() already
+        # moved device weights and marked the current module dirty — clearing
+        # that here would hand back stale host params
+        self._curr_module._params_dirty = (
+            self._params_dirty or self._curr_module._params_dirty)
         params = self._curr_module.get_params()
         self._params_dirty = False
         return params
@@ -122,7 +127,7 @@ class BucketingModule(BaseModule):
             logger=self.logger,
             context=self._context,
             work_load_list=self._work_load_list,
-            fused_step=False,
+            fused_step=self._fused_step,
         )
         module.bind(
             data_shapes,
@@ -150,7 +155,7 @@ class BucketingModule(BaseModule):
                 logger=self.logger,
                 context=self._context,
                 work_load_list=self._work_load_list,
-                fused_step=False,
+                fused_step=self._fused_step,
             )
             module.bind(
                 data_shapes,

