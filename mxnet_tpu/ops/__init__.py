@@ -17,6 +17,7 @@ from . import nn  # noqa: F401
 from . import sample  # noqa: F401
 from . import sequence  # noqa: F401
 from . import optimizer_ops  # noqa: F401
+from . import ctc  # noqa: F401
 from . import rnn  # noqa: F401
 from . import vision  # noqa: F401
 from . import attention  # noqa: F401

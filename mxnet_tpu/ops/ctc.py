@@ -1,0 +1,148 @@
+"""CTC loss (reference: plugin/warpctc/warpctc-inl.h — the one plugin with
+real model coverage: speech/OCR).
+
+API parity with the reference's ``WarpCTC`` operator:
+
+- ``data``: ``(input_length * batch, alphabet)`` time-major activations
+  (row ``t*B + b``), exactly the FC output the OCR examples feed it.
+- ``label``: ``(batch, label_length)`` ints, padded with the blank (0 — the
+  warp-ctc convention, warpctc-inl.h labelLengths/removeBlank strip 0s).
+- forward output is ``softmax(data)`` (warpctc-inl.h Forward), and backward
+  IGNORES the incoming head gradient and emits the CTC gradient — the
+  loss-layer contract shared with SoftmaxOutput.
+
+The TPU-native formulation: instead of an external C library, the forward
+log-likelihood is a log-space alpha recursion over ``lax.scan`` (one fused
+step per frame, all batch rows in parallel), and the backward pass IS
+``jax.grad`` of that recursion — which mathematically equals the classic
+softmax-minus-occupancy CTC gradient, with no hand-maintained beta pass.
+"""
+from __future__ import annotations
+
+import functools
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from .registry import AttrSpec, register
+
+_NEG = -1e30  # -inf stand-in that keeps logsumexp autodiff NaN-free
+
+
+def _compact_labels(label, blank):
+    """Left-align non-blank entries per row (reference removeBlank):
+    [3,0,2,0] → [3,2,0,0], plus per-row true lengths."""
+    is_pad = (label == blank)
+    # stable argsort of the pad mask moves non-blanks to the front while
+    # preserving their order
+    order = jnp.argsort(is_pad.astype(jnp.int32), axis=1, stable=True)
+    compact = jnp.take_along_axis(label, order, axis=1)
+    lengths = jnp.sum(~is_pad, axis=1)
+    return compact, lengths
+
+
+def ctc_nll(log_probs, label, label_lengths, blank=0):
+    """Per-sample negative log-likelihood.
+
+    log_probs: (T, B, C) log-softmax scores; label: (B, L) compacted
+    (non-blank first); label_lengths: (B,) true lengths.
+    """
+    T, B, C = log_probs.shape
+    L = label.shape[1]
+    S = 2 * L + 1
+
+    s_idx = jnp.arange(S)
+    # extended sequence: blank at even s, label[(s-1)//2] at odd s
+    lab_at = jnp.where(s_idx % 2 == 1,
+                       label[:, jnp.minimum((s_idx - 1) // 2, L - 1)],
+                       blank)  # (B, S)
+    # a skip s-2 → s is legal when ext[s] is a non-blank differing from ext[s-2]
+    prev2 = jnp.concatenate([jnp.full((B, 2), -1, lab_at.dtype),
+                             lab_at[:, :-2]], axis=1)
+    can_skip = (lab_at != blank) & (lab_at != prev2)  # (B, S)
+    # states beyond 2*len+1 are unreachable
+    valid = s_idx[None, :] < (2 * label_lengths[:, None] + 1)
+
+    alpha0 = jnp.full((B, S), _NEG)
+    alpha0 = alpha0.at[:, 0].set(log_probs[0, :, blank])
+    has1 = label_lengths > 0
+    alpha0 = alpha0.at[:, 1].set(
+        jnp.where(has1, jnp.take_along_axis(
+            log_probs[0], label[:, :1], axis=1)[:, 0], _NEG))
+
+    def step(alpha, lp_t):
+        em = jnp.take_along_axis(lp_t, lab_at, axis=1)
+        stay = alpha
+        diag = jnp.concatenate([jnp.full((B, 1), _NEG), alpha[:, :-1]], axis=1)
+        skip = jnp.concatenate([jnp.full((B, 2), _NEG), alpha[:, :-2]], axis=1)
+        skip = jnp.where(can_skip, skip, _NEG)
+        stacked = jnp.stack([stay, diag, skip], axis=0)
+        merged = jax.scipy.special.logsumexp(stacked, axis=0)
+        new = jnp.where(valid, merged + em, _NEG)
+        return new, None
+
+    alpha_T, _ = jax.lax.scan(step, alpha0, log_probs[1:])
+    # accept states: 2*len (final blank) and 2*len-1 (last symbol)
+    endb = jnp.take_along_axis(alpha_T, (2 * label_lengths)[:, None], axis=1)[:, 0]
+    ends = jnp.take_along_axis(
+        alpha_T, jnp.maximum(2 * label_lengths - 1, 0)[:, None], axis=1)[:, 0]
+    ends = jnp.where(label_lengths > 0, ends, _NEG)
+    ll = jnp.logaddexp(endb, ends)
+    return -ll
+
+
+@functools.lru_cache(maxsize=None)
+def _warpctc_core(input_length, blank):
+    """custom_vjp closure: fwd = softmax scores, bwd = CTC gradient (head
+    gradient ignored, per the reference loss-layer contract)."""
+
+    def total_nll(data2d, label):
+        B = data2d.shape[0] // input_length
+        logits = data2d.reshape(input_length, B, data2d.shape[1])
+        lp = jax.nn.log_softmax(logits.astype(jnp.float32), axis=-1)
+        lab = label.astype(jnp.int32).reshape(B, -1)
+        compact, lengths = _compact_labels(lab, blank)
+        nll = ctc_nll(lp, compact, lengths, blank)
+        # infeasible samples (label needs more frames than input_length —
+        # adjacent repeats require a mandatory blank between them) contribute
+        # zero loss AND zero gradient, matching warp-ctc's behavior; without
+        # this the all-_NEG accept states would backprop garbage occupancies
+        repeats = jnp.sum(
+            (compact[:, 1:] == compact[:, :-1])
+            & (jnp.arange(1, compact.shape[1])[None, :] < lengths[:, None]),
+            axis=1)
+        feasible = (lengths + repeats) <= input_length
+        return jnp.sum(jnp.where(feasible, nll, 0.0))
+
+    @jax.custom_vjp
+    def warpctc(data2d, label):
+        return jax.nn.softmax(data2d, axis=-1)
+
+    def fwd(data2d, label):
+        return warpctc(data2d, label), (data2d, label)
+
+    def bwd(res, _head_grad):
+        data2d, label = res
+        g = jax.grad(total_nll)(data2d, label)
+        return g.astype(data2d.dtype), jnp.zeros_like(label)
+
+    warpctc.defvjp(fwd, bwd)
+    return warpctc
+
+
+@register(
+    "WarpCTC",
+    attrs={
+        "label_length": AttrSpec("int", default=0),
+        "input_length": AttrSpec("int", default=0),
+    },
+    input_names=("data", "label"),
+)
+def _warpctc(attrs, data, label):
+    T = int(attrs["input_length"])
+    if T <= 0:
+        raise ValueError("WarpCTC requires input_length > 0")
+    if data.ndim != 2:
+        data = data.reshape(-1, data.shape[-1])
+    return _warpctc_core(T, 0)(data, label)

@@ -1,0 +1,180 @@
+"""CTC loss (reference: plugin/warpctc). Oracles: brute-force alignment
+enumeration on tiny shapes, finite-difference gradients, and a toy OCR
+convergence run."""
+import itertools
+
+import numpy as np
+import pytest
+
+import mxnet_tpu as mx
+
+
+def _brute_force_nll(log_probs, label, blank=0):
+    """-log P(label) by enumerating every length-T path and collapsing it
+    (remove repeats, then blanks)."""
+    T, C = log_probs.shape
+    total = -np.inf
+    for path in itertools.product(range(C), repeat=T):
+        collapsed = []
+        prev = None
+        for s in path:
+            if s != prev and s != blank:
+                collapsed.append(s)
+            prev = s
+        if collapsed == list(label):
+            lp = sum(log_probs[t, s] for t, s in enumerate(path))
+            total = np.logaddexp(total, lp)
+    return -total
+
+
+class TestCTCNll:
+    @pytest.mark.parametrize("label", [[1, 2], [1, 1], [2], []])
+    def test_matches_brute_force(self, label):
+        import jax.numpy as jnp
+
+        from mxnet_tpu.ops.ctc import ctc_nll
+
+        rs = np.random.RandomState(0)
+        T, C = 4, 3
+        logits = rs.randn(T, 1, C).astype("float32")
+        lp = logits - np.log(np.exp(logits).sum(-1, keepdims=True))
+        L = max(len(label), 1)
+        lab = np.zeros((1, L), "int32")
+        lab[0, : len(label)] = label
+        got = float(ctc_nll(jnp.asarray(lp), jnp.asarray(lab),
+                            jnp.asarray([len(label)]))[0])
+        want = _brute_force_nll(lp[:, 0], label)
+        np.testing.assert_allclose(got, want, rtol=1e-5)
+
+    def test_batch_and_padding(self):
+        """Padded rows must match their unpadded singletons."""
+        import jax.numpy as jnp
+
+        from mxnet_tpu.ops.ctc import ctc_nll
+
+        rs = np.random.RandomState(1)
+        T, C = 5, 4
+        logits = rs.randn(T, 2, C).astype("float32")
+        lp = logits - np.log(np.exp(logits).sum(-1, keepdims=True))
+        lab = np.array([[1, 2, 3], [2, 0, 0]], "int32")
+        lens = np.array([3, 1])
+        got = np.asarray(ctc_nll(jnp.asarray(lp), jnp.asarray(lab),
+                                 jnp.asarray(lens)))
+        for b in (0, 1):
+            want = _brute_force_nll(lp[:, b], list(lab[b][: lens[b]]))
+            np.testing.assert_allclose(got[b], want, rtol=1e-5)
+
+
+class TestWarpCTCOp:
+    def _bind(self, T, B, C, L):
+        data = mx.sym.Variable("data")
+        label = mx.sym.Variable("label")
+        out = mx.sym.WarpCTC(data=data, label=label, input_length=T,
+                             label_length=L)
+        ex = out.simple_bind(ctx=mx.cpu(), data=(T * B, C), label=(B, L),
+                             grad_req="write")
+        return ex
+
+    def test_forward_is_softmax(self):
+        T, B, C, L = 3, 2, 4, 2
+        ex = self._bind(T, B, C, L)
+        rs = np.random.RandomState(0)
+        x = rs.randn(T * B, C).astype("float32")
+        ex.arg_dict["data"][:] = x
+        ex.arg_dict["label"][:] = np.array([[1, 2], [3, 0]], "float32")
+        ex.forward(is_train=False)
+        p = ex.outputs[0].asnumpy()
+        want = np.exp(x) / np.exp(x).sum(-1, keepdims=True)
+        np.testing.assert_allclose(p, want, rtol=1e-5)
+
+    def test_gradient_matches_finite_difference(self):
+        import jax, jax.numpy as jnp
+
+        from mxnet_tpu.ops.ctc import _warpctc_core
+
+        T, B, C, L = 4, 2, 3, 2
+        rs = np.random.RandomState(2)
+        x = rs.randn(T * B, C).astype("float64").astype("float32")
+        lab = np.array([[1, 2], [2, 0]], "float32")
+
+        ex = self._bind(T, B, C, L)
+        ex.arg_dict["data"][:] = x
+        ex.arg_dict["label"][:] = lab
+        ex.forward(is_train=True)
+        ex.backward()
+        g = ex.grad_dict["data"].asnumpy()
+
+        # finite differences of the total nll
+        def nll(xv):
+            lp = xv.reshape(T, B, C)
+            lp = lp - np.log(np.exp(lp).sum(-1, keepdims=True))
+            tot = 0.0
+            for b in range(B):
+                labels = [int(v) for v in lab[b] if v != 0]
+                tot += _brute_force_nll(lp[:, b], labels)
+            return tot
+
+        eps = 1e-3
+        for idx in [(0, 0), (3, 2), (5, 1)]:
+            xp = x.copy(); xp[idx] += eps
+            xm = x.copy(); xm[idx] -= eps
+            fd = (nll(xp) - nll(xm)) / (2 * eps)
+            np.testing.assert_allclose(g[idx], fd, rtol=2e-2, atol=2e-3)
+
+    def test_toy_ocr_converges(self):
+        """A linear model on fixed per-frame features must learn a target
+        transcription (the warpctc toy example's economics)."""
+        T, B, C, L = 6, 4, 5, 3
+        rs = np.random.RandomState(3)
+        X = rs.randn(B, T, 8).astype("float32")
+        Y = np.zeros((B, L), "float32")
+        for b in range(B):
+            Y[b] = rs.choice(np.arange(1, C), L, replace=False)
+
+        data = mx.sym.Variable("data")          # (T*B, feat)
+        label = mx.sym.Variable("label")
+        net = mx.sym.FullyConnected(data, num_hidden=C, name="fc")
+        net = mx.sym.WarpCTC(data=net, label=label, input_length=T,
+                             label_length=L)
+        ex = net.simple_bind(ctx=mx.cpu(), data=(T * B, 8), label=(B, L),
+                             grad_req="write")
+        rs2 = np.random.RandomState(0)
+        for k, v in ex.arg_dict.items():
+            if k not in ("data", "label"):
+                v[:] = rs2.normal(0, 0.1, v.shape)
+        x_flat = X.transpose(1, 0, 2).reshape(T * B, 8)  # time-major rows
+        ex.arg_dict["data"][:] = x_flat
+        ex.arg_dict["label"][:] = Y
+        for step in range(300):
+            ex.forward(is_train=True)
+            ex.backward()
+            for k, g in ex.grad_dict.items():
+                if k not in ("data", "label") and g is not None:
+                    ex.arg_dict[k][:] = ex.arg_dict[k].asnumpy() - 0.5 * g.asnumpy()
+        ex.forward(is_train=False)
+        p = ex.outputs[0].asnumpy().reshape(T, B, C)
+        # greedy decode must equal the target for most rows
+        hits = 0
+        for b in range(B):
+            path = p[:, b].argmax(-1)
+            dec = []
+            prev = None
+            for s in path:
+                if s != prev and s != 0:
+                    dec.append(s)
+                prev = s
+            hits += dec == [int(v) for v in Y[b]]
+        assert hits >= B - 1, "toy CTC training failed: %d/%d decoded" % (hits, B)
+
+    def test_infeasible_label_gets_zero_gradient(self):
+        """warp-ctc contract: a label needing more frames than input_length
+        contributes zero loss and zero gradient."""
+        T, B, C, L = 2, 1, 3, 2
+        ex = self._bind(T, B, C, L)
+        rs = np.random.RandomState(4)
+        ex.arg_dict["data"][:] = rs.randn(T * B, C).astype("float32")
+        ex.arg_dict["label"][:] = np.array([[1, 1]], "float32")  # needs T>=3
+        ex.forward(is_train=True)
+        ex.backward()
+        g = ex.grad_dict["data"].asnumpy()
+        np.testing.assert_allclose(g, 0.0, atol=1e-8)
