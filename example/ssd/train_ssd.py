@@ -44,59 +44,18 @@ def multi_layer_feature(data):
     return [b2, b3, b4]
 
 
-def multibox_layer(layers, num_classes, sizes, ratios):
-    """Per-scale heads + anchors (reference: common.py multibox_layer):
-    returns (cls_preds, loc_preds, anchors)."""
-    cls_layers, loc_layers, anchor_layers = [], [], []
-    num_anchors = [len(s) + len(r) - 1 for s, r in zip(sizes, ratios)]
-    for i, (feat, size, ratio, na) in enumerate(zip(layers, sizes, ratios, num_anchors)):
-        cls = mx.sym.Convolution(data=feat, num_filter=na * (num_classes + 1),
-                                 kernel=(3, 3), pad=(1, 1), name="cls_pred_%d" % i)
-        # (B, na*(C+1), H, W) → (B, H*W*na, C+1) → concat over scales
-        cls = mx.sym.transpose(cls, axes=(0, 2, 3, 1))
-        cls = mx.sym.Reshape(cls, shape=(0, -1, num_classes + 1))
-        cls_layers.append(cls)
-
-        loc = mx.sym.Convolution(data=feat, num_filter=na * 4, kernel=(3, 3),
-                                 pad=(1, 1), name="loc_pred_%d" % i)
-        loc = mx.sym.transpose(loc, axes=(0, 2, 3, 1))
-        loc = mx.sym.Reshape(loc, shape=(0, -1))
-        loc_layers.append(loc)
-
-        anchor_layers.append(mx.sym.MultiBoxPrior(
-            feat, sizes=size, ratios=ratio, name="anchors_%d" % i))
-
-    cls_preds = mx.sym.Concat(*cls_layers, dim=1, name="cls_preds")
-    # SoftmaxOutput(multi_output) wants (B, C+1, N)
-    cls_preds = mx.sym.transpose(cls_preds, axes=(0, 2, 1))
-    loc_preds = mx.sym.Concat(*loc_layers, dim=1, name="loc_preds")
-    anchors = mx.sym.Concat(*anchor_layers, dim=1, name="anchors")
-    return cls_preds, loc_preds, anchors
-
-
 def get_ssd_symbol(num_classes):
+    """Mini synthetic-data SSD: small backbone + the library's shared head
+    and loss builders (mxnet_tpu.models.vgg16_ssd multibox_layer/ssd_losses)."""
+    from mxnet_tpu.models.vgg16_ssd import multibox_layer, ssd_losses
+
     data = mx.sym.Variable("data")
     label = mx.sym.Variable("label")
     layers = multi_layer_feature(data)
     sizes = [(0.2, 0.3), (0.4, 0.5), (0.7, 0.9)]
     ratios = [(1.0, 2.0, 0.5)] * 3
     cls_preds, loc_preds, anchors = multibox_layer(layers, num_classes, sizes, ratios)
-
-    loc_target, loc_target_mask, cls_target = mx.sym.MultiBoxTarget(
-        anchors, label, cls_preds, overlap_threshold=0.5,
-        ignore_label=-1, negative_mining_ratio=3, name="multibox_target")
-
-    cls_prob = mx.sym.SoftmaxOutput(data=cls_preds, label=cls_target,
-                                    ignore_label=-1, use_ignore=True,
-                                    multi_output=True,
-                                    normalization="valid", name="cls_prob")
-    loc_diff = loc_target_mask * (loc_preds - loc_target)
-    loc_loss_ = mx.sym.smooth_l1(data=loc_diff, scalar=1.0, name="loc_loss_")
-    loc_loss = mx.sym.MakeLoss(loc_loss_, grad_scale=1.0,
-                               normalization="valid", name="loc_loss")
-
-    cls_label = mx.sym.MakeLoss(data=cls_target, grad_scale=0, name="cls_label")
-    return mx.sym.Group([cls_prob, loc_loss, cls_label])
+    return ssd_losses(cls_preds, loc_preds, anchors, label)
 
 
 class SyntheticDetIter(mx.io.DataIter):
@@ -137,17 +96,29 @@ class SyntheticDetIter(mx.io.DataIter):
 if __name__ == "__main__":
     parser = argparse.ArgumentParser(
         description="train SSD", formatter_class=argparse.ArgumentDefaultsHelpFormatter)
-    parser.add_argument("--num-classes", type=int, default=4)
+    parser.add_argument("--network", type=str, default="vgg16-ssd-300",
+                        choices=["vgg16-ssd-300", "mini"],
+                        help="'vgg16-ssd-300' (reference parity, 300x300 "
+                             "input) or 'mini' (small synthetic-data net)")
+    parser.add_argument("--num-classes", type=int, default=20)
     parser.add_argument("--batch-size", type=int, default=8)
-    parser.add_argument("--data-shape", type=int, default=64)
+    parser.add_argument("--data-shape", type=int, default=0,
+                        help="input size; defaults to 300 for vgg16-ssd-300, "
+                             "64 for mini")
     parser.add_argument("--num-epochs", type=int, default=3)
     parser.add_argument("--lr", type=float, default=0.01)
     parser.add_argument("--kv-store", type=str, default="local")
     args = parser.parse_args()
 
-    net = get_ssd_symbol(args.num_classes)
-    train_iter = SyntheticDetIter(args.batch_size,
-                                  (3, args.data_shape, args.data_shape),
+    if args.network == "vgg16-ssd-300":
+        from mxnet_tpu.models import vgg16_ssd
+
+        net = vgg16_ssd.get_symbol_train(num_classes=args.num_classes)
+        shape = args.data_shape or 300
+    else:
+        net = get_ssd_symbol(args.num_classes)
+        shape = args.data_shape or 64
+    train_iter = SyntheticDetIter(args.batch_size, (3, shape, shape),
                                   args.num_classes)
 
     mod = mx.mod.Module(net, data_names=("data",), label_names=("label",),

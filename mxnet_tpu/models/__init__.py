@@ -10,7 +10,8 @@ matmuls/convs, and bf16-friendly dtypes threaded via the ``dtype`` argument.
 ``get_symbol`` entry points (e.g. example/image-classification/symbols/
 resnet.py get_symbol).
 """
-from . import lenet, mlp, alexnet, vgg, resnet, inception_bn, lstm, transformer
+from . import (lenet, mlp, alexnet, vgg, resnet, inception_bn, inception_v3,
+               lstm, transformer, vgg16_ssd)
 
 _ZOO = {
     "lenet": lenet.get_symbol,
@@ -21,6 +22,8 @@ _ZOO = {
     "vgg19": lambda **kw: vgg.get_symbol(num_layers=19, **kw),
     "inception-bn": inception_bn.get_symbol,
     "inception_bn": inception_bn.get_symbol,
+    "inception-v3": inception_v3.get_symbol,
+    "inception_v3": inception_v3.get_symbol,
     "resnet": resnet.get_symbol,
     "resnet-18": lambda **kw: resnet.get_symbol(num_layers=18, **kw),
     "resnet-34": lambda **kw: resnet.get_symbol(num_layers=34, **kw),
@@ -29,6 +32,8 @@ _ZOO = {
     "resnet-152": lambda **kw: resnet.get_symbol(num_layers=152, **kw),
     "lstm": lstm.get_symbol,
     "transformer": transformer.get_symbol,
+    "vgg16-ssd-300": vgg16_ssd.get_symbol,
+    "vgg16-ssd-300-train": vgg16_ssd.get_symbol_train,
 }
 
 

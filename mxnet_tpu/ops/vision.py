@@ -268,18 +268,24 @@ def _multibox_target(attrs, anchor, label, cls_pred):
     """Assign ground truth to anchors (reference: contrib/multibox_target.cc).
     anchor (1,N,4); label (B,M,5) rows [cls,x1,y1,x2,y2], cls<0 = pad;
     cls_pred (B, num_cls+1, N). Outputs: loc_target (B,4N), loc_mask (B,4N),
-    cls_target (B,N) with 0 = background, k+1 = class k."""
+    cls_target (B,N) with 0 = background, k+1 = class k, and — under hard
+    negative mining — ignore_label for unmined negatives
+    (multibox_target.cc:162-229)."""
     anchors = anchor[0]  # (N,4)
     N = anchors.shape[0]
     v = attrs["variances"]
     thresh = attrs["overlap_threshold"]
+    mine_ratio = attrs["negative_mining_ratio"]
+    mine_thresh = attrs["negative_mining_thresh"]
+    min_neg = attrs["minimum_negative_samples"]
+    ignore = attrs["ignore_label"]
 
     aw = anchors[:, 2] - anchors[:, 0]
     ah = anchors[:, 3] - anchors[:, 1]
     acx = (anchors[:, 0] + anchors[:, 2]) / 2
     acy = (anchors[:, 1] + anchors[:, 3]) / 2
 
-    def one(lab):
+    def one(lab, preds):
         valid = lab[:, 0] >= 0  # (M,)
         gt = lab[:, 1:5]
         iou = _corner_iou(anchors, gt)  # (N,M)
@@ -308,11 +314,34 @@ def _multibox_target(attrs, anchor, label, cls_pred):
         loc_t = jnp.stack([tx, ty, tw, th], axis=1)  # (N,4)
         loc_t = jnp.where(matched[:, None], loc_t, 0.0)
         loc_m = jnp.where(matched[:, None], 1.0, 0.0) * jnp.ones((N, 4), anchors.dtype)
-        cls_t = jnp.where(matched, lab[gt_idx, 0] + 1.0, 0.0)
+
+        if mine_ratio > 0:
+            # hard negative mining: keep the (ratio × positives) unmatched
+            # anchors whose background probability is LOWEST (hardest), mark
+            # the rest ignore_label so the class loss skips them
+            num_pos = jnp.sum(matched)
+            num_neg = jnp.minimum(
+                (mine_ratio * num_pos).astype("int32"), N - num_pos)
+            num_neg = jnp.maximum(num_neg, min_neg)
+            prob_bg = jax.nn.softmax(preds, axis=0)[0]  # (N,)
+            eligible = (~matched) & (best_iou < mine_thresh)
+            score = jnp.where(eligible, -prob_bg, -jnp.inf)
+            order = jnp.argsort(-score)  # hardest first
+            rank = jnp.zeros((N,), "int32").at[order].set(jnp.arange(N, dtype="int32"))
+            neg = eligible & (rank < num_neg)
+            cls_t = jnp.where(
+                matched, lab[gt_idx, 0] + 1.0,
+                jnp.where(neg, 0.0, jnp.asarray(ignore, anchors.dtype)))
+        else:
+            cls_t = jnp.where(matched, lab[gt_idx, 0] + 1.0, 0.0)
         return loc_t.reshape(-1), loc_m.reshape(-1), cls_t
 
-    loc_t, loc_m, cls_t = jax.vmap(one)(label)
-    return loc_t, loc_m, cls_t
+    loc_t, loc_m, cls_t = jax.vmap(one)(label, cls_pred)
+    # training targets are constants (the reference op has no backward):
+    # without this, the loc MakeLoss would backprop into cls_pred through
+    # the mining softmax
+    return (jax.lax.stop_gradient(loc_t), jax.lax.stop_gradient(loc_m),
+            jax.lax.stop_gradient(cls_t))
 
 
 # -------------------------------------------------------- MultiBoxDetection

@@ -62,3 +62,60 @@ def test_lstm_forward_backward():
     assert out[0].shape == (18, 50)
     g = exe.grad_dict["lstm_parameters"].asnumpy()
     assert np.isfinite(g).all()
+
+
+def test_inception_v3_infer_and_param_count():
+    """BASELINE config 2 (reference symbols/inception-v3.py): canonical
+    channel plan → 23.83M params at 1000 classes, 299x299 input."""
+    net = models.get_symbol("inception-v3", num_classes=1000)
+    args, outs, _ = net.infer_shape(data=(2, 3, 299, 299))
+    assert outs == [(2, 1000)]
+    n = sum(int(np.prod(s)) for nm, s in zip(net.list_arguments(), args)
+            if nm not in ("data", "softmax_label"))
+    assert n == 23834568, "inception-v3 parameter count drifted: %d" % n
+
+
+def test_inception_v3_trains_one_step():
+    net = models.get_symbol("inception-v3", num_classes=5)
+    exe = net.simple_bind(ctx=mx.cpu(), data=(1, 3, 299, 299),
+                          softmax_label=(1,))
+    rs = np.random.RandomState(0)
+    exe.arg_dict["data"][:] = rs.rand(1, 3, 299, 299).astype("float32")
+    exe.arg_dict["softmax_label"][:] = np.array([2], "float32")
+    for name, arr in exe.arg_dict.items():
+        if name not in ("data", "softmax_label"):
+            arr[:] = rs.uniform(-0.05, 0.05, arr.shape).astype("float32")
+    exe.forward_backward()
+    g = exe.grad_dict["fc1_weight"].asnumpy()
+    assert np.isfinite(g).all() and np.abs(g).sum() > 0
+
+
+def test_vgg16_ssd_300_anchor_spec_and_one_step():
+    """BASELINE config 4 (reference symbol_vgg16_ssd_300.py): 8732 anchors
+    over six scales, trains one step with finite grads."""
+    from mxnet_tpu.models import vgg16_ssd
+
+    net = vgg16_ssd.get_symbol_train(num_classes=20)
+    _, outs, _ = net.infer_shape(data=(1, 3, 300, 300), label=(1, 3, 5))
+    shapes = dict(zip(net.list_outputs(), outs))
+    assert shapes["cls_prob_output"] == (1, 21, 8732)
+    assert shapes["loc_loss_output"] == (1, 4 * 8732)
+
+    exe = net.simple_bind(ctx=mx.cpu(), data=(1, 3, 300, 300), label=(1, 3, 5),
+                          grad_req="write")
+    rs = np.random.RandomState(0)
+    exe.arg_dict["data"][:] = rs.rand(1, 3, 300, 300).astype("float32")
+    lab = -np.ones((1, 3, 5), "float32")
+    lab[0, 0] = [1, 0.1, 0.1, 0.6, 0.7]
+    lab[0, 1] = [7, 0.5, 0.4, 0.9, 0.95]
+    exe.arg_dict["label"][:] = lab
+    for name, arr in exe.arg_dict.items():
+        if name in ("data", "label"):
+            continue
+        if name.startswith("scale_"):
+            arr[:] = 20.0
+        else:
+            arr[:] = rs.uniform(-0.02, 0.02, arr.shape).astype("float32")
+    exe.forward_backward()
+    g = exe.grad_dict["conv4_3_weight"].asnumpy()
+    assert np.isfinite(g).all() and np.abs(g).sum() > 0

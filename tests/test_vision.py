@@ -179,3 +179,40 @@ def test_bilinear_sampler_gradient():
     out = sym.BilinearSampler(data=sym.Variable("data"), grid=sym.Variable("grid"))
     tu.check_numeric_gradient(out, {"data": data, "grid": grid},
                               numeric_eps=1e-3, check_eps=3e-2)
+
+
+def test_multibox_target_hard_negative_mining():
+    """With mining (ratio 3): unmined negatives carry ignore_label, mined
+    negatives are the lowest-background-probability anchors, positives keep
+    their class (reference: multibox_target.cc:162-229)."""
+    import numpy as np
+    import mxnet_tpu as mx
+
+    anchors = np.array([[[0.0, 0.0, 0.5, 0.5],
+                         [0.5, 0.5, 1.0, 1.0],
+                         [0.0, 0.5, 0.5, 1.0],
+                         [0.45, 0.0, 0.95, 0.5],
+                         [0.1, 0.1, 0.2, 0.2],
+                         [0.8, 0.8, 0.9, 0.9]]], "float32")
+    label = -np.ones((1, 2, 5), "float32")
+    label[0, 0] = [2, 0.0, 0.0, 0.5, 0.5]  # matches anchor 0 exactly
+    N = anchors.shape[1]
+    # background logits: anchor 4 is the most confident background, anchor 5
+    # the least (hardest negative)
+    cls_pred = np.zeros((1, 3, N), "float32")
+    cls_pred[0, 0] = [0.0, -1.0, 0.0, 1.0, 5.0, -5.0]
+
+    a = mx.nd.array(anchors); l = mx.nd.array(label); p = mx.nd.array(cls_pred)
+    _, loc_mask, cls_t = mx.nd.MultiBoxTarget(
+        a, l, p, overlap_threshold=0.5, ignore_label=-1,
+        negative_mining_ratio=2, negative_mining_thresh=0.5)
+    ct = cls_t.asnumpy()[0]
+    assert ct[0] == 3.0  # class 2 → target 3 (0 is background)
+    # 1 positive × ratio 2 = 2 mined negatives; hardest = lowest bg prob
+    assert (ct == 0).sum() == 2
+    assert ct[5] == 0 and ct[1] == 0  # lowest background logits
+    assert ct[4] == -1 and ct[3] == -1  # confident backgrounds ignored, not mined
+
+    # without mining every unmatched anchor is background
+    _, _, cls_all = mx.nd.MultiBoxTarget(a, l, p, overlap_threshold=0.5)
+    assert (cls_all.asnumpy()[0] == 0).sum() == N - 1
