@@ -53,14 +53,33 @@ def log_train_metric(period, auto_reset=False):
 
 class Speedometer:
     """Log samples/sec every ``frequent`` batches (reference: callback.py:89) —
-    the throughput number the benchmarks track."""
+    the throughput number the benchmarks track — plus step time, and MFU when
+    ``flops_per_sample`` is given and the device's bf16 peak is known
+    (device_info.py). Training logs then carry the BASELINE scoreboard
+    numbers directly."""
 
-    def __init__(self, batch_size, frequent=50):
+    def __init__(self, batch_size, frequent=50, flops_per_sample=None):
         self.batch_size = batch_size
         self.frequent = frequent
+        self.flops_per_sample = flops_per_sample
         self.init = False
         self.tic = 0
         self.last_count = 0
+        self._peak = None  # resolved lazily from the default device
+
+    def _mfu(self, speed):
+        if not self.flops_per_sample:
+            return None
+        if self._peak is None:
+            try:
+                import jax
+
+                from .device_info import bf16_peak_flops
+
+                self._peak = bf16_peak_flops(jax.devices()[0].device_kind) or 0
+            except Exception:
+                self._peak = 0
+        return speed * self.flops_per_sample / self._peak if self._peak else None
 
     def __call__(self, param):
         count = param.nbatch
@@ -70,23 +89,22 @@ class Speedometer:
 
         if self.init:
             if count % self.frequent == 0:
-                speed = self.frequent * self.batch_size / (time.time() - self.tic)
+                dt = time.time() - self.tic
+                speed = self.frequent * self.batch_size / dt
+                step_ms = 1000.0 * dt / self.frequent
+                mfu = self._mfu(speed)
+                perf = "Speed: %.2f samples/sec\tStep: %.1f ms" % (speed, step_ms)
+                if mfu is not None:
+                    perf += "\tMFU: %.1f%%" % (100 * mfu)
                 if param.eval_metric is not None:
                     name_value = param.eval_metric.get_name_value()
                     param.eval_metric.reset()
                     for name, value in name_value:
-                        logging.info(
-                            "Epoch[%d] Batch [%d]\tSpeed: %.2f samples/sec\tTrain-%s=%f",
-                            param.epoch,
-                            count,
-                            speed,
-                            name,
-                            value,
-                        )
+                        logging.info("Epoch[%d] Batch [%d]\t%s\tTrain-%s=%f",
+                                     param.epoch, count, perf, name, value)
                 else:
-                    logging.info(
-                        "Iter[%d] Batch [%d]\tSpeed: %.2f samples/sec", param.epoch, count, speed
-                    )
+                    logging.info("Iter[%d] Batch [%d]\t%s",
+                                 param.epoch, count, perf)
                 self.tic = time.time()
         else:
             self.init = True

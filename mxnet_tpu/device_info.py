@@ -1,0 +1,32 @@
+"""Device capability table (bf16 peak FLOP/s) for MFU accounting.
+
+The reference never needed this — CUDA exposes clock×cores — but TPU peak
+comes from public spec sheets keyed on ``device_kind``. Used by bench.py and
+callback.Speedometer's MFU display.
+"""
+__all__ = ["bf16_peak_flops"]
+
+# public spec-sheet numbers
+_PEAK = {
+    "TPU v4": 275e12,
+    "TPU v5 lite": 197e12,
+    "TPU v5e": 197e12,
+    "TPU v5": 459e12,
+    "TPU v5p": 459e12,
+    "TPU v6 lite": 918e12,
+    "TPU v6e": 918e12,
+    "TPU v7": 2307e12,
+}
+
+
+def bf16_peak_flops(device_kind):
+    """bf16 peak for a device kind, tolerant of naming variants ("TPU v5p
+    slice" → "TPU v5p"); None when unknown — callers must not guess."""
+    if device_kind in _PEAK:
+        return _PEAK[device_kind]
+    best = None
+    for kind, peak in _PEAK.items():
+        if device_kind.startswith(kind):
+            if best is None or len(kind) > len(best[0]):
+                best = (kind, peak)
+    return best[1] if best else None

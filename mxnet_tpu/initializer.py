@@ -109,30 +109,26 @@ class Initializer:
 
     # --- leaf initializers ------------------------------------------------
     def _init_bilinear(self, _, arr):
-        weight = np.zeros(arr.size, dtype=np.float32)
-        shape = arr.shape
-        f = np.ceil(shape[3] / 2.0)
+        # separable triangle filter over the trailing H×W plane, tiled over
+        # the leading dims (vectorized; the reference fills element-wise)
+        h, w = arr.shape[2], arr.shape[3]
+        f = np.ceil(w / 2.0)
         c = (2 * f - 1 - f % 2) / (2.0 * f)
-        for i in range(arr.size):
-            x = i % shape[3]
-            y = (i // shape[3]) % shape[2]
-            weight[i] = (1 - abs(x / f - c)) * (1 - abs(y / f - c))
-        arr[:] = weight.reshape(shape)
+        wx = 1.0 - np.abs(np.arange(w) / f - c)
+        wy = 1.0 - np.abs(np.arange(h) / f - c)
+        arr[:] = np.broadcast_to(np.outer(wy, wx), arr.shape)
 
-    def _init_zero(self, _, arr):
-        arr[:] = 0.0
+    # constant-fill family (aux moving stats, biases, BN gamma/beta): one
+    # factory, six bindings — subclasses may still override any name
+    def _const_fill(value):  # noqa: N805 — class-body factory, not a method
+        def _impl(self, _desc, arr):
+            arr[:] = value
 
-    def _init_one(self, _, arr):
-        arr[:] = 1.0
+        return _impl
 
-    def _init_bias(self, _, arr):
-        arr[:] = 0.0
-
-    def _init_gamma(self, _, arr):
-        arr[:] = 1.0
-
-    def _init_beta(self, _, arr):
-        arr[:] = 0.0
+    _init_zero = _init_bias = _init_beta = _const_fill(0.0)
+    _init_one = _init_gamma = _const_fill(1.0)
+    del _const_fill
 
     def _init_weight(self, name, arr):
         raise NotImplementedError("Must override it")
@@ -281,20 +277,13 @@ class Xavier(Initializer):
 
     def _init_weight(self, name, arr):
         shape = arr.shape
-        hw_scale = 1.0
-        if len(shape) > 2:
-            hw_scale = np.prod(shape[2:])
+        hw_scale = np.prod(shape[2:]) if len(shape) > 2 else 1.0
         fan_in, fan_out = shape[1] * hw_scale, shape[0] * hw_scale
-        factor = 1.0
-        if self.factor_type == "avg":
-            factor = (fan_in + fan_out) / 2.0
-        elif self.factor_type == "in":
-            factor = fan_in
-        elif self.factor_type == "out":
-            factor = fan_out
-        else:
+        factors = {"avg": (fan_in + fan_out) / 2.0, "in": fan_in,
+                   "out": fan_out}
+        if self.factor_type not in factors:
             raise ValueError("Incorrect factor type")
-        scale = np.sqrt(self.magnitude / factor)
+        scale = np.sqrt(self.magnitude / factors[self.factor_type])
         if self.rnd_type == "uniform":
             arr[:] = nd.random_uniform(low=-scale, high=scale, shape=arr.shape, ctx=arr.context)
         elif self.rnd_type == "gaussian":

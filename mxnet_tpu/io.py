@@ -10,6 +10,7 @@ dispatch model.
 """
 from __future__ import annotations
 
+import queue
 import struct
 import threading
 from collections import namedtuple
@@ -230,14 +231,15 @@ class ResizeIter(DataIter):
             self.data_iter.reset()
 
     def iter_next(self):
-        if self.cur == self.size:
+        if self.cur >= self.size:
             return False
+        self.cur += 1
         try:
             self.current_batch = self.data_iter.next()
         except StopIteration:
+            # wrap the child's epoch: this iterator's epoch is `size` batches
             self.data_iter.reset()
             self.current_batch = self.data_iter.next()
-        self.cur += 1
         return True
 
     def getdata(self):
@@ -255,105 +257,144 @@ class ResizeIter(DataIter):
 
 class PrefetchingIter(DataIter):
     """Background-thread prefetch over one or more iterators (reference:
-    io.py:281 PrefetchingIter, C++ PrefetcherIter iter_prefetcher.h:28)."""
+    io.py PrefetchingIter, C++ PrefetcherIter iter_prefetcher.h:28).
 
-    def __init__(self, iters, rename_data=None, rename_label=None):
+    Mechanism (original to this port): one pump thread per child iterator
+    feeds a bounded queue (``prefetch_depth`` batches ahead, vs. the
+    reference's fixed one-ahead event handshake); a sentinel marks epoch
+    end. ``reset()`` tears the epoch's pumps down and starts fresh ones, so
+    no cross-epoch thread state can leak.
+    """
+
+    _END = object()  # epoch-end sentinel
+
+    def __init__(self, iters, rename_data=None, rename_label=None,
+                 prefetch_depth=2):
         super().__init__()
-        if not isinstance(iters, list):
-            iters = [iters]
-        self.n_iter = len(iters)
-        assert self.n_iter > 0
-        self.iters = iters
+        self.iters = iters if isinstance(iters, list) else [iters]
+        assert self.iters
+        self.n_iter = len(self.iters)
         self.rename_data = rename_data
         self.rename_label = rename_label
         self.batch_size = self.provide_data[0].shape[0]
-        self.data_ready = [threading.Event() for _ in range(self.n_iter)]
-        self.data_taken = [threading.Event() for _ in range(self.n_iter)]
-        for e in self.data_taken:
-            e.set()
-        self.started = True
-        self.current_batch = [None for _ in range(self.n_iter)]
-        self.next_batch = [None for _ in range(self.n_iter)]
+        self.current_batch = None
+        self._depth = max(1, int(prefetch_depth))
+        self._queues = None
+        self._threads = []
+        self._stop = None
+        self._ended = False  # epoch exhausted; queues carry no more batches
+        self._start_epoch()
 
-        def prefetch_func(self, i):
-            while True:
-                self.data_taken[i].wait()
-                if not self.started:
-                    break
+    # ------------------------------------------------------------ pump plumbing
+    def _pump(self, child, q, stop):
+        end_token = PrefetchingIter._END
+        try:
+            while not stop.is_set():
                 try:
-                    self.next_batch[i] = self.iters[i].next()
+                    batch = child.next()
                 except StopIteration:
-                    self.next_batch[i] = None
-                self.data_taken[i].clear()
-                self.data_ready[i].set()
+                    break
+                except BaseException as exc:  # surface child errors to the consumer
+                    end_token = exc
+                    break
+                # bounded put that stays responsive to shutdown
+                while not stop.is_set():
+                    try:
+                        q.put(batch, timeout=0.1)
+                        break
+                    except queue.Full:
+                        continue
+        finally:
+            q.put(end_token)
 
-        self.prefetch_threads = [
-            threading.Thread(target=prefetch_func, args=[self, i]) for i in range(self.n_iter)
-        ]
-        for thread in self.prefetch_threads:
-            thread.setDaemon(True)
-            thread.start()
+    def _start_epoch(self):
+        self._queues = [queue.Queue(maxsize=self._depth)
+                        for _ in range(self.n_iter)]
+        self._stop = threading.Event()
+        self._ended = False
+        self._threads = [
+            threading.Thread(target=self._pump, args=(it, q, self._stop),
+                             daemon=True)
+            for it, q in zip(self.iters, self._queues)]
+        for t in self._threads:
+            t.start()
+
+    def _shutdown(self, strict=True):
+        if self._stop is None:
+            return
+        self._stop.set()
+        # unblock any pump stuck on a full queue, then wait for sentinels
+        for q in self._queues:
+            while True:
+                try:
+                    if q.get_nowait() is PrefetchingIter._END:
+                        break
+                except queue.Empty:
+                    break
+        stuck = []
+        for t in self._threads:
+            t.join(timeout=5.0)
+            if t.is_alive():
+                stuck.append(t)
+        self._threads = []
+        if stuck and strict:
+            # resetting the child while a stale pump still holds it would be
+            # a two-thread data race on the iterator's cursor
+            raise MXNetError(
+                "PrefetchingIter: %d pump thread(s) still running after "
+                "shutdown — child iterator blocked >5s" % len(stuck))
 
     def __del__(self):
-        self.started = False
-        for e in self.data_taken:
-            e.set()
-        for thread in self.prefetch_threads:
-            thread.join()
+        try:
+            self._shutdown(strict=False)
+        except Exception:
+            pass
 
+    # ------------------------------------------------------------------ DataIter
     @property
     def provide_data(self):
-        if self.rename_data is None:
-            return sum([i.provide_data for i in self.iters], [])
-        return sum(
-            [
-                [DataDesc(r[x.name], x.shape, x.dtype) if isinstance(x, DataDesc) else DataDesc(*x) for x in i.provide_data]
-                for r, i in zip(self.rename_data, self.iters)
-            ],
-            [],
-        )
+        return self._renamed(lambda it: it.provide_data, self.rename_data)
 
     @property
     def provide_label(self):
-        if self.rename_label is None:
-            return sum([i.provide_label for i in self.iters], [])
-        return sum(
-            [
-                [DataDesc(r[x.name], x.shape, x.dtype) if isinstance(x, DataDesc) else DataDesc(*x) for x in i.provide_label]
-                for r, i in zip(self.rename_label, self.iters)
-            ],
-            [],
-        )
+        return self._renamed(lambda it: it.provide_label, self.rename_label)
+
+    def _renamed(self, get, renames):
+        descs = []
+        for k, it in enumerate(self.iters):
+            for d in get(it):
+                d = d if isinstance(d, DataDesc) else DataDesc(*d)
+                if renames is not None:
+                    d = DataDesc(renames[k][d.name], d.shape, d.dtype)
+                descs.append(d)
+        return descs
 
     def reset(self):
-        for e in self.data_ready:
-            e.wait()
-        for i in self.iters:
-            i.reset()
-        for e in self.data_ready:
-            e.clear()
-        for e in self.data_taken:
-            e.set()
+        self._shutdown()
+        for it in self.iters:
+            it.reset()
+        self._start_epoch()
 
     def iter_next(self):
-        for e in self.data_ready:
-            e.wait()
-        if self.next_batch[0] is None:
-            for i in self.next_batch:
-                assert i is None, "Number of entry mismatches between iterators"
+        if self._ended:
+            return False  # pumps are gone; blocking on the queues would hang
+        got = [q.get() for q in self._queues]
+        for g in got:
+            if isinstance(g, BaseException):
+                self._ended = True
+                raise g  # a pump's child iterator failed mid-epoch
+        ended = [g is PrefetchingIter._END for g in got]
+        if any(ended):
+            assert all(ended), "iterators disagree on epoch length"
+            self._ended = True
             return False
-        for batch in self.next_batch:
-            assert batch.pad == self.next_batch[0].pad, "Different pad between iterators"
-        self.current_batch = DataBatch(
-            sum([batch.data for batch in self.next_batch], []),
-            sum([batch.label for batch in self.next_batch], []),
-            self.next_batch[0].pad,
-            self.next_batch[0].index,
-        )
-        for e in self.data_ready:
-            e.clear()
-        for e in self.data_taken:
-            e.set()
+        pad = got[0].pad
+        assert all(g.pad == pad for g in got), "different pad between iterators"
+        data, label = [], []
+        for g in got:
+            data.extend(g.data)
+            label.extend(g.label)
+        self.current_batch = DataBatch(data, label, pad, got[0].index)
         return True
 
     def next(self):

@@ -92,37 +92,33 @@ class CompositeEvalMetric(EvalMetric):
     """Manage multiple metrics as one (reference: CompositeEvalMetric)."""
 
     def __init__(self, metrics=None, **kwargs):
-        super().__init__("composite", **kwargs)
+        # before super(): EvalMetric.__init__ calls reset(), which iterates
+        # self.metrics (the reference instead swallowed the AttributeError)
         self.metrics = [create(m) if isinstance(m, str) else m for m in (metrics or [])]
+        super().__init__("composite", **kwargs)
 
     def add(self, metric):
         self.metrics.append(create(metric) if isinstance(metric, str) else metric)
 
     def get_metric(self, index):
-        try:
-            return self.metrics[index]
-        except IndexError:
-            return ValueError("Metric index {} is out of range 0 and {}".format(index, len(self.metrics)))
+        if not 0 <= index < len(self.metrics):
+            # unlike the reference (which RETURNED the exception), raise it
+            raise ValueError("metric index %d out of range [0, %d)"
+                             % (index, len(self.metrics)))
+        return self.metrics[index]
 
     def update(self, labels, preds):
-        for metric in self.metrics:
-            metric.update(labels, preds)
+        for m in self.metrics:
+            m.update(labels, preds)
 
     def reset(self):
-        try:
-            for metric in self.metrics:
-                metric.reset()
-        except AttributeError:
-            pass
+        for m in self.metrics:
+            m.reset()
 
     def get(self):
-        names = []
-        results = []
-        for metric in self.metrics:
-            result = metric.get()
-            names.append(result[0])
-            results.append(result[1])
-        return (names, results)
+        pairs = [m.get() for m in self.metrics]
+        names, values = zip(*pairs) if pairs else ((), ())
+        return (list(names), list(values))
 
 
 def _asnumpy(x):

@@ -65,21 +65,28 @@ def _backbone(data):
     return [conv4_3, relu7, conv8_2, conv9_2, conv10_2, conv11_2]
 
 
-def multibox_layer(layers, num_classes, sizes, ratios, normalizations=None):
+def multibox_layer(layers, num_classes, sizes, ratios, normalizations=None,
+                   num_channels=()):
     """Per-scale class/location heads + anchors (reference: common.py
-    multibox_layer). Returns (cls_preds (B,C+1,N), loc_preds (B,4N),
-    anchors (1,N,4))."""
+    multibox_layer). ``num_channels`` supplies the channel count for each
+    normalized layer (consumed in order), sizing its learnable scale.
+    Returns (cls_preds (B,C+1,N), loc_preds (B,4N), anchors (1,N,4))."""
     cls_layers, loc_layers, anchor_layers = [], [], []
     if normalizations is None:
         normalizations = [-1] * len(layers)
+    channels = list(num_channels)
     for i, (feat, size, ratio, norm) in enumerate(
             zip(layers, sizes, ratios, normalizations)):
         if norm > 0:
+            if not channels:
+                raise ValueError(
+                    "multibox_layer: normalizations[%d] > 0 needs a "
+                    "num_channels entry to size the scale variable" % i)
             feat = sym.L2Normalization(data=feat, mode="channel",
                                        name="norm_%d" % i)
             scale = sym.Variable(
                 "scale_%d" % i,
-                attr={"__shape__": json.dumps([1, 512, 1, 1]),
+                attr={"__shape__": json.dumps([1, channels.pop(0), 1, 1]),
                       "__init__": json.dumps(["Constant", {"value": norm}])})
             feat = sym.broadcast_mul(scale, feat, name="scaled_%d" % i)
         na = len(size) + len(ratio) - 1
@@ -128,7 +135,8 @@ def get_symbol_train(num_classes=20, **kwargs):
     label = sym.Variable("label")
     layers = _backbone(data)
     cls_preds, loc_preds, anchors = multibox_layer(
-        layers, num_classes, SIZES, RATIOS, NORMALIZATIONS)
+        layers, num_classes, SIZES, RATIOS, NORMALIZATIONS,
+        num_channels=[512])
     return ssd_losses(cls_preds, loc_preds, anchors, label)
 
 
@@ -137,7 +145,8 @@ def get_symbol(num_classes=20, nms_thresh=0.5, nms_topk=400, **kwargs):
     data = sym.Variable("data")
     layers = _backbone(data)
     cls_preds, loc_preds, anchors = multibox_layer(
-        layers, num_classes, SIZES, RATIOS, NORMALIZATIONS)
+        layers, num_classes, SIZES, RATIOS, NORMALIZATIONS,
+        num_channels=[512])
     cls_prob = sym.SoftmaxActivation(data=cls_preds, mode="channel",
                                      name="cls_prob")
     return sym.MultiBoxDetection(cls_prob, loc_preds, anchors,

@@ -12,7 +12,8 @@ import os
 
 from .base import MXNetError
 
-__all__ = ["profiler_set_config", "profiler_set_state", "dump_profile", "State"]
+__all__ = ["profiler_set_config", "profiler_set_state", "dump_profile",
+           "trace_files", "summarize", "State"]
 
 _config = {"mode": "symbolic", "filename": "profile.json"}
 _state = "stop"
@@ -56,3 +57,60 @@ def dump_profile():
     if _state == "run":
         profiler_set_state("stop")
     return _trace_dir
+
+
+def trace_files(trace_dir=None):
+    """The trace artifacts a capture produced (perfetto/xplane files under
+    <dir>/plugins/profile/<ts>/). Empty list = the capture failed."""
+    import glob
+
+    d = trace_dir or _trace_dir
+    if not d:
+        return []
+    return sorted(glob.glob(os.path.join(d, "plugins", "profile", "*", "*")))
+
+
+def summarize(trace_dir=None, top=25, device_only=True):
+    """Aggregate per-kernel wall time from a captured trace — the per-op
+    stat table of the reference's engine profiler (src/engine/profiler.cc
+    chrome-trace events), recovered from the XLA trace.
+
+    Returns a list of {"name", "ms", "count", "process"} dicts, heaviest
+    first. ``device_only=False`` includes host-side python/runtime spans.
+    """
+    import collections
+    import glob
+    import gzip
+    import json
+    import re
+
+    d = trace_dir or _trace_dir
+    files = sorted(glob.glob(
+        os.path.join(d or ".", "plugins", "profile", "*", "*.trace.json.gz")))
+    if not files:
+        return []
+    raw = json.loads(gzip.open(files[-1]).read().decode())
+    events = raw.get("traceEvents", [])
+    pids = {e["pid"]: e["args"].get("name", "")
+            for e in events if e.get("ph") == "M"
+            and e.get("name") == "process_name"}
+    acc = collections.Counter()
+    cnt = collections.Counter()
+    for e in events:
+        if e.get("ph") != "X":
+            continue
+        proc = pids.get(e["pid"], str(e["pid"]))
+        if device_only and "TPU" not in proc and "GPU" not in proc \
+                and "device" not in proc.lower():
+            continue
+        name = e.get("name", "?")
+        # drop the whole-program umbrella spans and bare step-number marks
+        if name.startswith("jit_") or re.fullmatch(r"\d+", name):
+            continue
+        key = (proc, name)
+        acc[key] += e.get("dur", 0)
+        cnt[key] += 1
+    out = [{"process": proc, "name": name, "ms": round(us / 1000.0, 3),
+            "count": cnt[(proc, name)]}
+           for (proc, name), us in acc.most_common(top)]
+    return out
