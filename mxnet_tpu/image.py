@@ -282,6 +282,17 @@ class ImageRecordIter(_io.DataIter):
     std_r/g/b, pad, num_parts/part_index (sharding), preprocess_threads,
     path_imgidx, label_width, round_batch. ``aug_list`` overrides the default
     augmenter pipeline.
+
+    Execution: when the requested augment set is expressible natively
+    (resize/crop/mirror/mean/std, RGB, single shard) the batches come from
+    the C++ pipeline (src/image_native.cc — threaded libjpeg/libpng decode
+    and augment off the GIL, the reference's iter_image_recordio_2.cc
+    design); anything else — custom aug_list, pad, color jitter, num_parts
+    sharding — runs the Python/PIL path. ``MXNET_NATIVE_IMAGE_PIPELINE=0``
+    forces Python. Native batches preserve record order when unshuffled.
+    ``shuffle=True`` + ``path_imgidx`` gives the Python path's full
+    per-epoch permutation; shuffle WITHOUT an idx falls back to a 4096-
+    record reservoir shuffle (logged) — pass the .idx for class-sorted recs.
     """
 
     def __init__(self, path_imgrec, data_shape, batch_size, shuffle=False,
@@ -294,12 +305,57 @@ class ImageRecordIter(_io.DataIter):
         super().__init__(batch_size)
         if len(data_shape) != 3:
             raise MXNetError("data_shape must be (C, H, W)")
+        self.data_shape = tuple(data_shape)
+        self._label_width = label_width
+        self._round_batch = round_batch
+        self.data_name, self.label_name = data_name, label_name
+        label_shape = (batch_size,) if label_width == 1 else (batch_size, label_width)
+        self.provide_data = [_io.DataDesc(data_name, (batch_size,) + self.data_shape)]
+        self.provide_label = [_io.DataDesc(label_name, label_shape)]
+
+        self._native = None
+        native_ok = (aug_list is None and pad == 0 and num_parts == 1
+                     and not (brightness or contrast or saturation)
+                     and data_shape[0] == 3
+                     # subclasses (ImageDetIter) post-process labels in ways
+                     # the fixed-width native label copy can't express
+                     and type(self) is ImageRecordIter)
+        if native_ok:
+            from . import image_native
+
+            if image_native.available():
+                idx = path_imgidx if (path_imgidx and
+                                      os.path.isfile(path_imgidx)) else None
+                if shuffle and idx is None:
+                    import logging
+
+                    logging.warning(
+                        "ImageRecordIter(native): shuffling without a "
+                        "path_imgidx uses a 4096-record reservoir, not a "
+                        "full permutation — pass the .idx for class-sorted "
+                        "record files")
+                try:
+                    self._native = image_native.NativeImagePipeline(
+                        path_imgrec, batch_size, self.data_shape,
+                        num_workers=max(1, preprocess_threads),
+                        resize=resize, rand_crop=rand_crop,
+                        rand_mirror=rand_mirror,
+                        mean=(mean_r, mean_g, mean_b),
+                        std=(std_r, std_g, std_b),
+                        label_width=label_width,
+                        shuffle_buf=4096 if shuffle else 0, seed=seed,
+                        idx_path=idx if shuffle else None)
+                except Exception:
+                    self._native = None
+        if self._native is not None:
+            self._started = False  # pipeline already sits at epoch start
+            return
+
         self._source = _RecordSource(path_imgrec, path_imgidx)
         n = len(self._source)
         self._indices = list(range(n))[part_index::num_parts]
         self._shuffle = shuffle
         self._rng = _random.Random(seed)
-        self.data_shape = tuple(data_shape)
         self._pad = pad
         mean = np.array([mean_r, mean_g, mean_b], np.float32)
         std = np.array([std_r, std_g, std_b], np.float32)
@@ -309,18 +365,17 @@ class ImageRecordIter(_io.DataIter):
             mean=mean if mean.any() else None,
             std=std if (std != 1.0).any() else None,
             brightness=brightness, contrast=contrast, saturation=saturation)
-        self._label_width = label_width
-        self._round_batch = round_batch
         self._pool = (ThreadPoolExecutor(preprocess_threads)
                       if preprocess_threads > 1 else None)
         self._cursor = 0
-        self.data_name, self.label_name = data_name, label_name
-        label_shape = (batch_size,) if label_width == 1 else (batch_size, label_width)
-        self.provide_data = [_io.DataDesc(data_name, (batch_size,) + self.data_shape)]
-        self.provide_label = [_io.DataDesc(label_name, label_shape)]
         self.reset()
 
     def reset(self):
+        if self._native is not None:
+            if self._started:
+                self._native.reset()
+                self._started = False
+            return
         if self._shuffle:
             self._rng.shuffle(self._indices)
         self._cursor = 0
@@ -341,6 +396,8 @@ class ImageRecordIter(_io.DataIter):
         return chw, label
 
     def next(self):
+        if self._native is not None:
+            return self._next_native()
         n_left = len(self._indices) - self._cursor
         if n_left <= 0 or (not self._round_batch and n_left < self.batch_size):
             raise StopIteration
@@ -361,6 +418,24 @@ class ImageRecordIter(_io.DataIter):
         return _io.DataBatch(
             data=[nd.array(data)], label=[nd.array(labels)],
             pad=self.batch_size - take,
+            provide_data=self.provide_data, provide_label=self.provide_label)
+
+    def _next_native(self):
+        self._started = True
+        data, labels, n = self._native.next_batch()
+        if n == 0 or (not self._round_batch and n < self.batch_size):
+            raise StopIteration
+        data = data.copy()  # the pipeline reuses its staging buffers
+        labels = labels.copy()
+        if n < self.batch_size:
+            # round_batch: pad the tail by cycling its own real members
+            for j in range(n, self.batch_size):
+                data[j] = data[j % n]
+                labels[j] = labels[j % n]
+        lab = labels[:, 0] if self._label_width == 1 else labels
+        return _io.DataBatch(
+            data=[nd.array(data)], label=[nd.array(lab)],
+            pad=self.batch_size - n,
             provide_data=self.provide_data, provide_label=self.provide_label)
 
     def _scalar_label(self, label):

@@ -1,0 +1,140 @@
+"""ctypes bindings for the native image pipeline (src/image_native.cc).
+
+The C++ pipeline (threaded libjpeg/libpng decode → augment → batch,
+reference: src/io/iter_image_recordio_2.cc:559) is compiled on first use
+and cached under ``build/``; ``ImageRecordIter`` uses it
+automatically when the requested augmentation set is expressible natively,
+falling back to the Python/PIL path otherwise (or when
+``MXNET_NATIVE_IMAGE_PIPELINE=0``).
+"""
+from __future__ import annotations
+
+import ctypes
+import os
+import subprocess
+import threading
+
+import numpy as np
+
+__all__ = ["available", "NativeImagePipeline"]
+
+_ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+_SRC = os.path.join(_ROOT, "src", "image_native.cc")
+_BUILD_DIR = os.path.join(_ROOT, "build")
+_LIB_PATH = os.path.join(_BUILD_DIR, "libmxtpu_image.so")
+
+_lib = None
+_lock = threading.Lock()
+_build_failed = False
+
+
+def _load():
+    global _lib, _build_failed
+    with _lock:
+        if _lib is not None or _build_failed:
+            return _lib
+        try:
+            if not os.path.isfile(_LIB_PATH) or (
+                os.path.isfile(_SRC)
+                and os.path.getmtime(_SRC) > os.path.getmtime(_LIB_PATH)
+            ):
+                os.makedirs(_BUILD_DIR, exist_ok=True)
+                # build to a unique temp path then atomically publish —
+                # concurrent processes must never dlopen a half-written .so
+                tmp = _LIB_PATH + ".%d.tmp" % os.getpid()
+                subprocess.run(
+                    ["g++", "-std=c++17", "-O3", "-shared", "-fPIC",
+                     "-pthread", _SRC, "-o", tmp, "-ljpeg", "-lpng"],
+                    check=True, capture_output=True)
+                os.replace(tmp, _LIB_PATH)
+            lib = ctypes.CDLL(_LIB_PATH)
+        except Exception:
+            _build_failed = True
+            return None
+        lib.mximg_open.restype = ctypes.c_void_p
+        lib.mximg_open.argtypes = [
+            ctypes.c_char_p, ctypes.c_char_p, ctypes.c_int, ctypes.c_int,
+            ctypes.c_int, ctypes.c_int, ctypes.c_int, ctypes.c_int,
+            ctypes.c_int, ctypes.c_float, ctypes.c_float, ctypes.c_float,
+            ctypes.c_float, ctypes.c_float, ctypes.c_float, ctypes.c_int,
+            ctypes.c_int, ctypes.c_ulonglong]
+        lib.mximg_file_error.restype = ctypes.c_int
+        lib.mximg_file_error.argtypes = [ctypes.c_void_p]
+        lib.mximg_next_batch.restype = ctypes.c_int
+        lib.mximg_next_batch.argtypes = [
+            ctypes.c_void_p, ctypes.POINTER(ctypes.c_float),
+            ctypes.POINTER(ctypes.c_float)]
+        lib.mximg_reset.argtypes = [ctypes.c_void_p, ctypes.c_int]
+        lib.mximg_decode_errors.restype = ctypes.c_long
+        lib.mximg_decode_errors.argtypes = [ctypes.c_void_p]
+        lib.mximg_close.argtypes = [ctypes.c_void_p]
+        _lib = lib
+        return _lib
+
+
+def available():
+    return (os.environ.get("MXNET_NATIVE_IMAGE_PIPELINE", "1") != "0"
+            and _load() is not None)
+
+
+class NativeImagePipeline:
+    """Batches of decoded+augmented CHW float32 images from a .rec file,
+    produced entirely in C++ worker threads."""
+
+    def __init__(self, path, batch_size, data_shape, num_workers=4,
+                 resize=0, rand_crop=False, rand_mirror=False,
+                 mean=(0.0, 0.0, 0.0), std=(1.0, 1.0, 1.0), label_width=1,
+                 shuffle_buf=0, seed=0, idx_path=None):
+        lib = _load()
+        if lib is None:
+            raise RuntimeError("native image pipeline unavailable")
+        c, h, w = data_shape
+        if c != 3:
+            raise ValueError("native pipeline is RGB-only (C=3)")
+        self._lib = lib
+        self.batch_size = batch_size
+        self.data_shape = tuple(data_shape)
+        self.label_width = label_width
+        self._epoch = 0
+        self._handle = lib.mximg_open(
+            path.encode(), (idx_path or "").encode(), num_workers,
+            batch_size, h, w, resize,
+            int(bool(rand_crop)), int(bool(rand_mirror)),
+            mean[0], mean[1], mean[2], std[0], std[1], std[2],
+            label_width, shuffle_buf, seed)
+        if not self._handle:
+            raise IOError("cannot open %r" % path)
+        self._data = np.empty((batch_size, c, h, w), np.float32)
+        self._labels = np.empty((batch_size, label_width), np.float32)
+
+    def next_batch(self):
+        """(data, labels, n) — n < batch_size marks the epoch's tail; n == 0
+        means exhausted. The returned arrays are reused between calls.
+        Raises on mid-file corruption (the Python reader's invalid-magic
+        contract)."""
+        n = self._lib.mximg_next_batch(
+            self._handle,
+            self._data.ctypes.data_as(ctypes.POINTER(ctypes.c_float)),
+            self._labels.ctypes.data_as(ctypes.POINTER(ctypes.c_float)))
+        if self._lib.mximg_file_error(self._handle):
+            raise IOError("invalid RecordIO framing mid-file (corrupt .rec)")
+        return self._data, self._labels, int(n)
+
+    def reset(self):
+        self._epoch += 1
+        self._lib.mximg_reset(self._handle, self._epoch)
+
+    @property
+    def decode_errors(self):
+        return int(self._lib.mximg_decode_errors(self._handle))
+
+    def close(self):
+        if getattr(self, "_handle", None):
+            self._lib.mximg_close(self._handle)
+            self._handle = None
+
+    def __del__(self):
+        try:
+            self.close()
+        except Exception:
+            pass

@@ -1,0 +1,552 @@
+// Native image pipeline: threaded JPEG decode + augment + batch assembly.
+//
+// TPU-native counterpart of the reference's C++ image IO
+// (src/io/iter_image_recordio_2.cc:559 ImageRecordIOParser2 and
+// src/io/image_aug_default.cc DefaultImageAugmenter): a reader thread streams
+// RecordIO image records through an optional shuffling reservoir; decode
+// workers JPEG/PNG-decode (libjpeg/libpng directly — no hidden thread
+// pools: OpenCV's internal parallel runtime deadlocks under concurrent
+// caller threads in some environments), resize / crop / mirror / normalize,
+// and emit CHW float samples; the caller drains batches through ctypes
+// (mxnet_tpu/image_native.py). All of it runs off the Python GIL — the
+// feeding rate the MFU target needs cannot come from PIL threads.
+//
+// Build: g++ -std=c++17 -O3 -shared -fPIC -pthread image_native.cc
+//        -o libmxtpu_image.so -ljpeg -lpng
+#include <algorithm>
+#include <atomic>
+#include <condition_variable>
+#include <cstdint>
+#include <cstdio>
+#include <cstring>
+#include <deque>
+#include <map>
+#include <mutex>
+#include <random>
+#include <thread>
+#include <vector>
+
+#include <csetjmp>
+
+#include <jpeglib.h>
+#include <png.h>
+
+namespace {
+
+// ------------------------------------------------------------ decode/resize
+// Minimal HWC-RGB image container; all augment math is hand-rolled single
+// passes (thread-safe by construction, SIMD-friendly inner loops).
+struct Image {
+  int h = 0, w = 0;
+  std::vector<uint8_t> px;  // h*w*3, RGB
+  uint8_t* row(int y) { return px.data() + static_cast<size_t>(y) * w * 3; }
+  const uint8_t* row(int y) const {
+    return px.data() + static_cast<size_t>(y) * w * 3;
+  }
+};
+
+struct JpegErr {
+  jpeg_error_mgr pub;
+  jmp_buf jmp;
+};
+
+void jpeg_err_exit(j_common_ptr cinfo) {
+  longjmp(reinterpret_cast<JpegErr*>(cinfo->err)->jmp, 1);
+}
+
+bool decode_jpeg(const uint8_t* buf, size_t n, Image* out) {
+  jpeg_decompress_struct cinfo;
+  JpegErr jerr;
+  cinfo.err = jpeg_std_error(&jerr.pub);
+  jerr.pub.error_exit = jpeg_err_exit;
+  if (setjmp(jerr.jmp)) {
+    jpeg_destroy_decompress(&cinfo);
+    return false;
+  }
+  jpeg_create_decompress(&cinfo);
+  jpeg_mem_src(&cinfo, buf, n);
+  if (jpeg_read_header(&cinfo, TRUE) != JPEG_HEADER_OK) {
+    jpeg_destroy_decompress(&cinfo);
+    return false;
+  }
+  cinfo.out_color_space = JCS_RGB;  // grayscale/CMYK upconvert for free
+  jpeg_start_decompress(&cinfo);
+  out->h = cinfo.output_height;
+  out->w = cinfo.output_width;
+  out->px.resize(3u * out->h * out->w);
+  while (cinfo.output_scanline < cinfo.output_height) {
+    uint8_t* rowp = out->row(cinfo.output_scanline);
+    jpeg_read_scanlines(&cinfo, &rowp, 1);
+  }
+  jpeg_finish_decompress(&cinfo);
+  jpeg_destroy_decompress(&cinfo);
+  return true;
+}
+
+bool decode_png(const uint8_t* buf, size_t n, Image* out) {
+  png_image img;
+  memset(&img, 0, sizeof(img));
+  img.version = PNG_IMAGE_VERSION;
+  if (!png_image_begin_read_from_memory(&img, buf, n)) return false;
+  img.format = PNG_FORMAT_RGB;
+  out->h = img.height;
+  out->w = img.width;
+  out->px.resize(PNG_IMAGE_SIZE(img));
+  if (!png_image_finish_read(&img, nullptr, out->px.data(), 0, nullptr)) {
+    png_image_free(&img);
+    return false;
+  }
+  return true;
+}
+
+bool decode_any(const uint8_t* buf, size_t n, Image* out) {
+  if (n >= 2 && buf[0] == 0xFF && buf[1] == 0xD8) return decode_jpeg(buf, n, out);
+  if (n >= 8 && buf[0] == 0x89 && buf[1] == 'P') return decode_png(buf, n, out);
+  // unknown magic: try jpeg then png
+  return decode_jpeg(buf, n, out) || decode_png(buf, n, out);
+}
+
+// bilinear resize, HWC RGB u8 (one pass; per-row x-weights precomputed)
+void resize_bilinear(const Image& src, int nh, int nw, Image* dst) {
+  dst->h = nh;
+  dst->w = nw;
+  dst->px.resize(3u * nh * nw);
+  const double sy = nh > 1 ? double(src.h - 1) / (nh - 1) : 0.0;
+  const double sx = nw > 1 ? double(src.w - 1) / (nw - 1) : 0.0;
+  std::vector<int> x0s(nw);
+  std::vector<float> fxs(nw);
+  for (int x = 0; x < nw; ++x) {
+    double v = x * sx;
+    int x0 = static_cast<int>(v);
+    if (x0 > src.w - 2) x0 = src.w - 2 < 0 ? 0 : src.w - 2;
+    x0s[x] = x0;
+    fxs[x] = static_cast<float>(v - x0);
+  }
+  for (int y = 0; y < nh; ++y) {
+    double v = y * sy;
+    int y0 = static_cast<int>(v);
+    if (y0 > src.h - 2) y0 = src.h - 2 < 0 ? 0 : src.h - 2;
+    float fy = static_cast<float>(v - y0);
+    const uint8_t* r0 = src.row(y0);
+    const uint8_t* r1 = src.row(src.h > 1 ? y0 + 1 : y0);
+    uint8_t* dr = dst->row(y);
+    for (int x = 0; x < nw; ++x) {
+      const uint8_t* p00 = r0 + 3 * x0s[x];
+      const uint8_t* p01 = p00 + (src.w > 1 ? 3 : 0);
+      const uint8_t* p10 = r1 + 3 * x0s[x];
+      const uint8_t* p11 = p10 + (src.w > 1 ? 3 : 0);
+      float fx = fxs[x];
+      for (int c = 0; c < 3; ++c) {
+        float top = p00[c] + fx * (p01[c] - p00[c]);
+        float bot = p10[c] + fx * (p11[c] - p10[c]);
+        dr[3 * x + c] = static_cast<uint8_t>(top + fy * (bot - top) + 0.5f);
+      }
+    }
+  }
+}
+
+constexpr uint32_t kMagic = 0xced7230a;
+constexpr uint32_t kLenMask = (1u << 29) - 1;
+constexpr size_t kIRHeaderBytes = 24;  // <IfQQ: flag, label, id, id2
+
+struct RawRecord {
+  std::vector<char> bytes;
+  uint64_t seq = 0;
+};
+
+struct Sample {
+  std::vector<float> data;    // C*H*W
+  std::vector<float> label;   // label_width
+  bool ok = false;            // false = decode failed; consumer skips seq
+};
+
+struct Pipeline {
+  // config
+  std::string path;
+  int workers = 4;
+  int batch = 32;
+  int out_h = 224, out_w = 224;
+  int resize = 0;          // resize shorter side first (0 = off)
+  bool rand_crop = false;
+  bool rand_mirror = false;
+  float mean[3] = {0, 0, 0};
+  float stdv[3] = {1, 1, 1};
+  int label_width = 1;
+  uint64_t seed = 0;
+  int shuffle_buf = 0;     // >0: reservoir size for pseudo-shuffle
+
+  // state
+  FILE* fp = nullptr;
+  std::thread reader;
+  std::vector<std::thread> decoders;
+  std::mutex mu;
+  std::condition_variable cv_put, cv_get, cv_out;
+  std::deque<RawRecord> inq;          // reader -> decoders
+  std::vector<RawRecord> reservoir;   // shuffle mode
+  std::map<uint64_t, Sample> outq;    // seq -> sample (reorder buffer)
+  uint64_t next_seq = 0;              // next seq the reader will assign
+  uint64_t next_out = 0;              // next seq the consumer will emit
+  size_t in_capacity = 256;
+  size_t out_capacity = 0;            // set to 4 * batch
+  bool reader_done = false;
+  bool stopping = false;
+  std::atomic<int> in_flight{0};      // popped from inq, not yet in outq
+  std::atomic<long> decode_errors{0};
+  std::atomic<int> file_error{0};     // corrupt framing mid-file
+  std::atomic<int> wstate[64] = {};   // per-worker phase (hang triage)
+  std::vector<uint64_t> offsets;      // record offsets from the .idx
+
+  bool producers_exhausted_locked() const {
+    return reader_done && inq.empty() && reservoir.empty() &&
+           in_flight.load() == 0;
+  }
+
+  // ------------------------------------------------------------- reader
+  bool read_record(RawRecord* out) {
+    uint32_t header[2];
+    size_t got = fread(header, sizeof(uint32_t), 2, fp);
+    if (got == 0 && feof(fp)) return false;  // clean end of file
+    if (got != 2 || header[0] != kMagic) {
+      // mid-file corruption is NOT an EOF: flag it so the consumer can
+      // raise instead of silently truncating every epoch
+      file_error.store(1);
+      return false;
+    }
+    uint64_t n = header[1] & kLenMask;
+    out->bytes.resize(n);
+    if (n && fread(out->bytes.data(), 1, n, fp) != n) {
+      file_error.store(1);
+      return false;
+    }
+    uint64_t pad = (4 - n % 4) % 4;
+    if (pad) fseek(fp, static_cast<long>(pad), SEEK_CUR);
+    return true;
+  }
+
+  void reader_loop() {
+    // Sequence ids assign OUTPUT order at dispatch time, so the consumer
+    // sees record order when unshuffled and the permutation/reservoir order
+    // when shuffled, independent of decode completion order.
+    std::mt19937_64 rng(seed ^ 0x9e3779b97f4a7c15ull);
+    RawRecord rec;
+    // full-permutation shuffle when the .idx gave us record offsets: visit
+    // offsets in a fresh random order each epoch (the Python path's
+    // semantics); without an idx the reservoir below approximates it
+    std::vector<uint64_t> order;
+    if (shuffle_buf > 0 && !offsets.empty()) {
+      order = offsets;
+      for (size_t i = order.size(); i > 1; --i)
+        std::swap(order[i - 1], order[rng() % i]);
+    }
+    size_t oi = 0;
+    while (true) {
+      if (!order.empty()) {
+        if (oi >= order.size()) break;
+        fseek(fp, static_cast<long>(order[oi++]), SEEK_SET);
+      }
+      if (!read_record(&rec)) break;
+      bool use_reservoir = shuffle_buf > 0 && order.empty();
+      std::unique_lock<std::mutex> lk(mu);
+      if (use_reservoir && reservoir.size() <
+              static_cast<size_t>(shuffle_buf)) {
+        reservoir.push_back(std::move(rec));
+        cv_get.notify_all();  // consumer shares cv_get; notify_one could
+                              // wake it instead of a decoder and be lost
+        continue;
+      }
+      cv_put.wait(lk, [&] { return inq.size() < in_capacity || stopping; });
+      if (stopping) break;
+      if (use_reservoir) {
+        // swap a random reservoir slot out to the decode queue
+        size_t k = rng() % reservoir.size();
+        reservoir[k].seq = next_seq++;
+        inq.push_back(std::move(reservoir[k]));
+        reservoir[k] = std::move(rec);
+      } else {
+        rec.seq = next_seq++;
+        inq.push_back(std::move(rec));
+      }
+      cv_get.notify_all();
+    }
+    std::lock_guard<std::mutex> lk(mu);
+    // drain the reservoir tail in (already random) order
+    for (auto& r : reservoir) {
+      r.seq = next_seq++;
+      inq.push_back(std::move(r));
+    }
+    reservoir.clear();
+    reader_done = true;
+    cv_get.notify_all();
+  }
+
+  // ------------------------------------------------------------ decoders
+  bool augment_one(const RawRecord& rec, std::mt19937_64* rng, Sample* out) {
+    if (rec.bytes.size() <= kIRHeaderBytes) return false;
+    uint32_t flag;
+    float scalar_label;
+    memcpy(&flag, rec.bytes.data(), 4);
+    memcpy(&scalar_label, rec.bytes.data() + 4, 4);
+    const char* payload = rec.bytes.data() + kIRHeaderBytes;
+    size_t payload_n = rec.bytes.size() - kIRHeaderBytes;
+
+    out->label.assign(static_cast<size_t>(label_width), 0.f);
+    if (flag > 0) {
+      size_t lab_bytes = static_cast<size_t>(flag) * 4;
+      if (payload_n < lab_bytes) return false;
+      size_t n = std::min<size_t>(label_width, flag);
+      memcpy(out->label.data(), payload, n * 4);
+      payload += lab_bytes;
+      payload_n -= lab_bytes;
+    } else {
+      out->label[0] = scalar_label;
+    }
+
+    Image img;
+    if (!decode_any(reinterpret_cast<const uint8_t*>(payload), payload_n,
+                    &img) || img.h < 1 || img.w < 1)
+      return false;
+
+    // resize shorter side (ResizeAug), keeping aspect
+    if (resize > 0 && std::min(img.h, img.w) != resize) {
+      double sc = static_cast<double>(resize) /
+                  static_cast<double>(std::min(img.h, img.w));
+      Image tmp;
+      resize_bilinear(img, std::max(1, int(img.h * sc + 0.5)),
+                      std::max(1, int(img.w * sc + 0.5)), &tmp);
+      img = std::move(tmp);
+    }
+    // guarantee crop feasibility (ForceResizeAug fallback)
+    if (img.h < out_h || img.w < out_w) {
+      Image tmp;
+      resize_bilinear(img, std::max(img.h, out_h), std::max(img.w, out_w),
+                      &tmp);
+      img = std::move(tmp);
+    }
+    int max_y = img.h - out_h, max_x = img.w - out_w;
+    int y0, x0;
+    if (rand_crop) {
+      y0 = max_y ? static_cast<int>((*rng)() % (max_y + 1)) : 0;
+      x0 = max_x ? static_cast<int>((*rng)() % (max_x + 1)) : 0;
+    } else {  // center crop
+      y0 = max_y / 2;
+      x0 = max_x / 2;
+    }
+    bool mirror = rand_mirror && ((*rng)() & 1);
+
+    // RGB HWC u8 crop -> CHW float with mean/std, one fused pass
+    out->data.resize(3u * out_h * out_w);
+    const size_t plane = static_cast<size_t>(out_h) * out_w;
+    float inv[3] = {1.f / stdv[0], 1.f / stdv[1], 1.f / stdv[2]};
+    for (int y = 0; y < out_h; ++y) {
+      const uint8_t* srow = img.row(y0 + y) + 3 * x0;
+      float* d0 = out->data.data() + static_cast<size_t>(y) * out_w;
+      for (int x = 0; x < out_w; ++x) {
+        int sx = mirror ? (out_w - 1 - x) : x;
+        const uint8_t* px = srow + 3 * sx;
+        d0[x] = (px[0] - mean[0]) * inv[0];
+        d0[x + plane] = (px[1] - mean[1]) * inv[1];
+        d0[x + 2 * plane] = (px[2] - mean[2]) * inv[2];
+      }
+    }
+    return true;
+  }
+
+  void decode_loop(int wid) {
+    std::mt19937_64 rng(seed + 0x1000 + wid);
+    while (true) {
+      RawRecord rec;
+      {
+        wstate[wid & 63] = 1;  // waiting for input
+        std::unique_lock<std::mutex> lk(mu);
+        cv_get.wait(lk, [&] {
+          return !inq.empty() || reader_done || stopping;
+        });
+        if (stopping) return;
+        if (inq.empty()) {
+          if (reader_done && reservoir.empty()) return;
+          continue;
+        }
+        rec = std::move(inq.front());
+        inq.pop_front();
+        in_flight.fetch_add(1);
+        cv_put.notify_one();
+      }
+      Sample s;
+      wstate[wid & 63] = 2;  // decoding
+      s.ok = augment_one(rec, &rng, &s);
+      if (!s.ok) decode_errors.fetch_add(1);
+      {
+        wstate[wid & 63] = 3;  // waiting for output window
+        std::unique_lock<std::mutex> lk(mu);
+        // admission is by sequence WINDOW, not buffer size: a size gate
+        // deadlocks once the buffer fills with seqs ahead while the worker
+        // holding next_out waits for space. seq < next_out + capacity
+        // always admits the consumer's next sample and still bounds memory.
+        // Failed samples (skip markers, empty) are admitted unconditionally.
+        cv_out.wait(lk, [&] {
+          return rec.seq < next_out + out_capacity || !s.ok || stopping;
+        });
+        if (stopping) { in_flight.fetch_sub(1); return; }
+        outq.emplace(rec.seq, std::move(s));
+        in_flight.fetch_sub(1);
+        wstate[wid & 63] = 4;  // pushed
+        cv_get.notify_all();  // consumer may be waiting on this seq
+      }
+    }
+  }
+
+  // ------------------------------------------------------------- lifecycle
+  void start() {
+    stopping = false;
+    reader_done = false;
+    in_flight = 0;
+    out_capacity = static_cast<size_t>(4) * batch;
+    reader = std::thread([this] { reader_loop(); });
+    decoders.clear();
+    for (int i = 0; i < workers; ++i)
+      decoders.emplace_back([this, i] { decode_loop(i); });
+  }
+
+  void stop() {
+    {
+      std::lock_guard<std::mutex> lk(mu);
+      stopping = true;
+      cv_put.notify_all();
+      cv_get.notify_all();
+      cv_out.notify_all();
+    }
+    if (reader.joinable()) reader.join();
+    for (auto& t : decoders)
+      if (t.joinable()) t.join();
+    decoders.clear();
+    std::lock_guard<std::mutex> lk(mu);
+    inq.clear();
+    reservoir.clear();
+    outq.clear();
+    next_seq = 0;
+    next_out = 0;
+  }
+};
+
+}  // namespace
+
+extern "C" {
+
+void* mximg_open(const char* rec_path, const char* idx_path, int num_workers,
+                 int batch_size, int out_h, int out_w, int resize,
+                 int rand_crop, int rand_mirror, float mean_r, float mean_g,
+                 float mean_b, float std_r, float std_g, float std_b,
+                 int label_width, int shuffle_buf, unsigned long long seed) {
+  FILE* fp = fopen(rec_path, "rb");
+  if (!fp) return nullptr;
+  auto* p = new Pipeline();
+  if (idx_path && idx_path[0]) {
+    // "key\toffset" per line (MXIndexedRecordIO / tools/im2rec format);
+    // offsets enable the per-epoch full-permutation shuffle
+    FILE* fi = fopen(idx_path, "r");
+    if (fi) {
+      char line[256];
+      while (fgets(line, sizeof(line), fi)) {
+        unsigned long long key, off;
+        if (sscanf(line, "%llu %llu", &key, &off) == 2)
+          p->offsets.push_back(off);
+      }
+      fclose(fi);
+    }
+  }
+  p->path = rec_path;
+  p->fp = fp;
+  p->workers = std::max(1, num_workers);
+  p->batch = std::max(1, batch_size);
+  p->out_h = out_h;
+  p->out_w = out_w;
+  p->resize = resize;
+  p->rand_crop = rand_crop != 0;
+  p->rand_mirror = rand_mirror != 0;
+  p->mean[0] = mean_r; p->mean[1] = mean_g; p->mean[2] = mean_b;
+  p->stdv[0] = std_r; p->stdv[1] = std_g; p->stdv[2] = std_b;
+  p->label_width = std::max(1, label_width);
+  p->shuffle_buf = shuffle_buf;
+  p->seed = seed;
+  p->start();
+  return p;
+}
+
+// Fills up to batch_size samples IN RECORD ORDER; returns the count
+// (0 = epoch exhausted).
+int mximg_next_batch(void* handle, float* data, float* labels) {
+  auto* p = static_cast<Pipeline*>(handle);
+  const size_t img_f = 3u * p->out_h * p->out_w;
+  int got = 0;
+  while (got < p->batch) {
+    std::unique_lock<std::mutex> lk(p->mu);
+    p->cv_get.wait(lk, [&] {
+      return p->outq.count(p->next_out) > 0 ||
+             (p->producers_exhausted_locked() && p->outq.empty()) ||
+             p->stopping;
+    });
+    if (p->stopping) break;
+    auto it = p->outq.find(p->next_out);
+    if (it == p->outq.end()) break;  // exhausted
+    Sample s = std::move(it->second);
+    p->outq.erase(it);
+    ++p->next_out;
+    // notify_all: with several decoders parked on cv_out, waking an
+    // arbitrary one can leave the decoder holding the new window slot
+    // asleep while the woken one re-waits -> deadlock
+    p->cv_out.notify_all();
+    lk.unlock();
+    if (!s.ok) continue;  // corrupt record: skip its slot
+    memcpy(data + static_cast<size_t>(got) * img_f, s.data.data(),
+           img_f * sizeof(float));
+    memcpy(labels + static_cast<size_t>(got) * p->label_width,
+           s.label.data(), p->label_width * sizeof(float));
+    ++got;
+  }
+  return got;
+}
+
+// Rewind for the next epoch (new reader/decoder generation, new sample order
+// when shuffling: reseed with an epoch counter via `epoch`).
+void mximg_reset(void* handle, int epoch) {
+  auto* p = static_cast<Pipeline*>(handle);
+  p->stop();
+  fseek(p->fp, 0, SEEK_SET);
+  p->seed = p->seed * 0x100000001b3ull + static_cast<uint64_t>(epoch) + 1;
+  p->start();
+}
+
+// Diagnostic: dump internal state to stderr (used by hang triage).
+void mximg_debug_state(void* handle) {
+  auto* p = static_cast<Pipeline*>(handle);
+  std::lock_guard<std::mutex> lk(p->mu);
+  fprintf(stderr,
+          "[mximg] inq=%zu reservoir=%zu outq=%zu next_seq=%llu next_out=%llu"
+          " in_flight=%d reader_done=%d stopping=%d\n",
+          p->inq.size(), p->reservoir.size(), p->outq.size(),
+          (unsigned long long)p->next_seq, (unsigned long long)p->next_out,
+          p->in_flight.load(), (int)p->reader_done, (int)p->stopping);
+  for (int i = 0; i < p->workers && i < 64; ++i)
+    fprintf(stderr, "[mximg] worker %d state=%d\n", i, p->wstate[i].load());
+  if (!p->outq.empty())
+    fprintf(stderr, "[mximg] outq first=%llu last=%llu\n",
+            (unsigned long long)p->outq.begin()->first,
+            (unsigned long long)p->outq.rbegin()->first);
+}
+
+long mximg_decode_errors(void* handle) {
+  return static_cast<Pipeline*>(handle)->decode_errors.load();
+}
+
+int mximg_file_error(void* handle) {
+  return static_cast<Pipeline*>(handle)->file_error.load();
+}
+
+void mximg_close(void* handle) {
+  auto* p = static_cast<Pipeline*>(handle);
+  p->stop();
+  fclose(p->fp);
+  delete p;
+}
+
+}  // extern "C"
