@@ -1,12 +1,21 @@
-"""Benchmark: ResNet-50 synthetic-data training throughput (img/s) + MFU.
+"""Benchmark: the three BASELINE.md scoreboard metrics in ONE JSON line.
 
-Counterpart of the reference's synthetic benchmark mode
-(example/image-classification/train_imagenet.py --benchmark 1 and
-benchmark_score.py): fwd + bwd + SGD update on random data, steady-state
-steps/sec. Baseline: the reference's published ResNet-50 training speed of
-109 img/s on 1× K80 at batch 32 (example/image-classification/README.md:149).
+- ``resnet50_train_throughput`` (img/s, + MFU): synthetic fwd+bwd+SGD,
+  counterpart of the reference's ``train_imagenet.py --benchmark 1``
+  (example/image-classification/README.md:255-261). Baseline: 109 img/s on
+  1x K80, batch 32 (README.md:149-156).
+- ``lstm_tokens_per_s``: bucketed-LSTM training step at the PTB config
+  (example/rnn/lstm_bucketing.py defaults: 2x200 LSTM, embed 200, batch 32,
+  bucket 60).
+- ``allreduce_gbps``: collective bus bandwidth via tools/bandwidth/measure
+  (the reference's tools/bandwidth/measure.py KVStore metric). With one
+  local chip this runs on the 8-process virtual CPU mesh (fabric field says
+  so); on a pod slice the same path measures ICI.
 
-Prints ONE JSON line: {"metric", "value", "unit", "vs_baseline", ...}.
+Timing note: ``jax.block_until_ready`` is a no-op over the axon tunnel, so
+every measurement syncs by fetching a scalar to host.
+
+Prints ONE JSON line {"metric", "value", "unit", "vs_baseline", ...extras}.
 """
 import json
 import os
@@ -18,43 +27,14 @@ import numpy as np
 
 BASELINE_IMG_S = 109.0  # reference README.md:149-156, resnet-50, 1x K80, b32
 
-# bf16 peak FLOP/s by device kind (public spec sheets)
-_PEAK = {
-    "TPU v4": 275e12,
-    "TPU v5 lite": 197e12,
-    "TPU v5e": 197e12,
-    "TPU v5": 459e12,
-    "TPU v5p": 459e12,
-    "TPU v6 lite": 918e12,
-    "TPU v6e": 918e12,
-    "TPU v7": 2307e12,
-}
-
-
-def _peak_flops(device_kind):
-    """bf16 peak for a device kind, tolerant of naming variants."""
-    if device_kind in _PEAK:
-        return _PEAK[device_kind]
-    # longest-prefix fuzzy match ("TPU v5p slice" → "TPU v5p", …); never the
-    # reverse direction — a truncated/generic kind must yield None, not a guess
-    best = None
-    for kind, peak in _PEAK.items():
-        if device_kind.startswith(kind):
-            if best is None or len(kind) > len(best[0]):
-                best = (kind, peak)
-    return best[1] if best else None
-
-
-# ResNet-50 @224: ~4.09 GFLOP forward per image (2*MACs); training ≈ 3× fwd
+# ResNet-50 @224: ~4.09 GFLOP forward per image (2*MACs); training ≈ 3x fwd
 _TRAIN_FLOPS_PER_IMG = 3 * 4.09e9
 
 
 def _probe_backend(timeout=180):
     """Check (in a subprocess, with a hard timeout) that the ambient JAX
-    platform can actually initialize. Round-2 failure mode: the preset
-    ``JAX_PLATFORMS=axon`` backend either raised at init or hung forever —
-    probing out-of-process means a hang costs ``timeout`` seconds instead of
-    the driver's whole budget. Returns True if the ambient platform works."""
+    platform can actually initialize — a hung tunnel must cost ``timeout``
+    seconds, not the driver's whole budget."""
     code = "import jax; d = jax.devices(); print(d[0].platform)"
     for attempt in range(3):
         if attempt:
@@ -74,12 +54,152 @@ def _probe_backend(timeout=180):
     return False
 
 
+def _sync(x):
+    """True device barrier: fetch a scalar (see module docstring)."""
+    import jax.numpy as jnp
+
+    return np.asarray(jnp.sum(x[0].astype(jnp.float32))
+                      if isinstance(x, (tuple, list)) else
+                      jnp.sum(x.astype(jnp.float32)))
+
+
+def _make_trainer(net, dev, batch_shapes, compute_dtype, parallel,
+                  data_names=None):
+    mesh = parallel.make_mesh((1,), axis_names=("data",), devices=[dev])
+    trainer = parallel.SPMDTrainer(
+        net, mesh, optimizer="sgd",
+        optimizer_params={"learning_rate": 0.1, "momentum": 0.9},
+        compute_dtype=compute_dtype,
+        data_names=data_names or tuple(n for n in batch_shapes
+                                       if "label" not in n),
+        label_names=tuple(n for n in batch_shapes if "label" in n))
+    data_shapes = {n: s for n, s in batch_shapes.items() if "label" not in n}
+    label_shapes = {n: s for n, s in batch_shapes.items() if "label" in n}
+    trainer.init_params(data_shapes, label_shapes, seed=0)
+    return trainer
+
+
+def _place(trainer, name, arr):
+    import jax
+
+    return jax.device_put(arr, trainer.rules.named(
+        trainer.rules.batch_spec(arr.shape)))
+
+
+def _bench_resnet50(on_tpu, models, parallel, dev):
+    image = 224 if on_tpu else 64
+    candidates = [512, 256, 128, 64, 32] if on_tpu else [8]
+    net = models.get_symbol("resnet-50", num_classes=1000,
+                            image_shape="3,%d,%d" % (image, image))
+    rs = np.random.RandomState(0)
+    trainer = x = y = batch = None
+    for batch in candidates:
+        try:
+            trainer = _make_trainer(
+                net, dev, {"data": (batch, 3, image, image),
+                           "softmax_label": (batch,)},
+                "bfloat16" if on_tpu else None, parallel)
+            x = _place(trainer, "data",
+                       rs.rand(batch, 3, image, image).astype("float32"))
+            y = _place(trainer, "softmax_label",
+                       rs.randint(0, 1000, (batch,)).astype("float32"))
+            for _ in range(3):
+                outs = trainer.step({"data": x}, {"softmax_label": y})
+            _sync(outs)
+            break
+        except Exception:
+            if batch == candidates[-1]:
+                raise
+            trainer = None
+    n_steps = 10 if on_tpu else 3
+    t0 = time.perf_counter()
+    for _ in range(n_steps):
+        outs = trainer.step({"data": x}, {"softmax_label": y})
+    _sync(outs)
+    dt = time.perf_counter() - t0
+    img_s = batch * n_steps / dt
+    return {"img_s": img_s, "batch": batch, "image": image,
+            "step_ms": 1000 * dt / n_steps,
+            "flops_per_img": _TRAIN_FLOPS_PER_IMG * (image / 224.0) ** 2}
+
+
+def _bench_lstm(on_tpu, models, parallel, dev):
+    """PTB-shape bucketed-LSTM training step (BASELINE config 3)."""
+    batch, seq = (32, 60) if on_tpu else (8, 12)
+    vocab, hidden, embed, layers = 10000, 200, 200, 2
+    net = models.get_symbol("lstm", num_classes=vocab, num_embed=embed,
+                            num_hidden=hidden, num_layers=layers,
+                            seq_len=seq, batch_size=batch)
+    rs = np.random.RandomState(0)
+    # initial states are DATA (the reference feeds init_states per batch,
+    # example/rnn/lstm.py provide_data), not trainable params. NOTE: their
+    # leading dim is num_layers, not batch — fine on this 1-device mesh,
+    # but a multi-device data mesh must not batch_spec-shard them
+    shapes = {"data": (batch, seq),
+              "lstm_init_h": (layers, batch, hidden),
+              "lstm_init_c": (layers, batch, hidden),
+              "softmax_label": (batch, seq)}
+    trainer = _make_trainer(net, dev, shapes,
+                            "bfloat16" if on_tpu else None, parallel,
+                            data_names=("data", "lstm_init_h", "lstm_init_c"))
+    data = {"data": _place(trainer, "data",
+                           rs.randint(1, vocab, (batch, seq)).astype("float32")),
+            "lstm_init_h": _place(trainer, "lstm_init_h",
+                                  np.zeros((layers, batch, hidden), "float32")),
+            "lstm_init_c": _place(trainer, "lstm_init_c",
+                                  np.zeros((layers, batch, hidden), "float32"))}
+    y = _place(trainer, "softmax_label",
+               rs.randint(1, vocab, (batch, seq)).astype("float32"))
+    for _ in range(3):
+        outs = trainer.step(data, {"softmax_label": y})
+    _sync(outs)
+    n_steps = 20 if on_tpu else 3
+    t0 = time.perf_counter()
+    for _ in range(n_steps):
+        outs = trainer.step(data, {"softmax_label": y})
+    _sync(outs)
+    dt = time.perf_counter() - t0
+    return {"tokens_per_s": batch * seq * n_steps / dt, "batch": batch,
+            "seq_len": seq, "step_ms": 1000 * dt / n_steps}
+
+
+def _bench_allreduce():
+    """KVStore allreduce bandwidth (the BASELINE.md metric): push+pull
+    round-trip through the dist KVStore's compiled collective, 8 worker
+    processes under tools/launch.py (measure.py --kvstore). With only one
+    local chip the workers run on CPU; on a multi-host slice the same
+    command measures ICI/DCN."""
+    root = os.path.dirname(os.path.abspath(__file__))
+    import jax
+
+    fabric = ("%s-8proc" % jax.devices()[0].platform
+              if len(jax.devices()) > 1 else "cpu-8proc")
+    env = dict(os.environ)
+    env.update({"JAX_PLATFORMS": "cpu", "MXNET_DEFAULT_CONTEXT": "cpu"})
+    out = subprocess.run(
+        [sys.executable, os.path.join(root, "tools", "launch.py"), "-n", "8",
+         "--launcher", "local", sys.executable,
+         os.path.join(root, "tools", "bandwidth", "measure.py"),
+         "--kvstore", "--sizes", "64", "--json"],
+        capture_output=True, text=True, timeout=600, env=env, cwd=root)
+    lines = [l for l in out.stdout.splitlines() if l.startswith("{")]
+    if not lines:
+        raise RuntimeError(
+            "kvstore bandwidth run produced no JSON (rc=%d): %s"
+            % (out.returncode, (out.stderr or out.stdout).strip()[-400:]))
+    rec = json.loads(lines[-1])
+    return {"gbps": rec["busbw_gbps"], "devices": rec["devices"],
+            "fabric": fabric}
+
+
 def main():
+    degraded = False
     # nothing to probe when the platform is already pinned to CPU
     if os.environ.get("JAX_PLATFORMS", "") != "cpu" and not _probe_backend():
         # ambient (axon/TPU) backend unusable — fall back to CPU so the
-        # bench still records *a* number plus an explicit platform note
+        # bench still records *a* number, LOUDLY marked degraded
         os.environ["JAX_PLATFORMS"] = "cpu"
+        degraded = True
 
     import jax
 
@@ -87,81 +207,52 @@ def main():
         jax.config.update("jax_platforms", "cpu")
 
     from mxnet_tpu import models, parallel
+    from mxnet_tpu.device_info import bf16_peak_flops
 
     dev = jax.devices()[0]
     on_tpu = dev.platform not in ("cpu",)
-    image = 224 if on_tpu else 64
-    candidates = [256, 128, 64, 32] if on_tpu else [8]
 
-    mesh = parallel.make_mesh((1,), axis_names=("data",), devices=[dev])
-    net = models.get_symbol("resnet-50", num_classes=1000,
-                            image_shape="3,%d,%d" % (image, image))
+    rn = _bench_resnet50(on_tpu, models, parallel, dev)
+    peak = bf16_peak_flops(dev.device_kind) if on_tpu else None
+    mfu = (rn["img_s"] * rn["flops_per_img"] / peak) if peak else None
 
-    trainer = x = y = None
-    for batch in candidates:
-        try:
-            trainer = parallel.SPMDTrainer(
-                net, mesh,
-                optimizer="sgd",
-                optimizer_params={"learning_rate": 0.1, "momentum": 0.9},
-                compute_dtype="bfloat16" if on_tpu else None,
-            )
-            trainer.init_params({"data": (batch, 3, image, image)},
-                                {"softmax_label": (batch,)}, seed=0)
-            rs = np.random.RandomState(0)
-            # pre-place the synthetic batch on device once — the benchmark
-            # measures the training step, not host→device feed (the
-            # reference's --benchmark 1 likewise reuses one synthetic batch)
-            x = jax.device_put(
-                rs.rand(batch, 3, image, image).astype("float32"),
-                trainer.rules.named(trainer.rules.batch_spec((batch, 3, image, image))))
-            y = jax.device_put(
-                rs.randint(0, 1000, (batch,)).astype("float32"),
-                trainer.rules.named(trainer.rules.batch_spec((batch,))))
-            # warmup: compile + 2 steady steps
-            for _ in range(3):
-                outs = trainer.step({"data": x}, {"softmax_label": y})
-            jax.block_until_ready(outs)
-            jax.block_until_ready(trainer.params)
-            break
-        except Exception:  # OOM at this batch — try the next size down
-            if batch == candidates[-1]:
-                raise
-            trainer = None
-            continue
-
-    n_steps = 10 if on_tpu else 3
-    t0 = time.perf_counter()
-    for _ in range(n_steps):
-        outs = trainer.step({"data": x}, {"softmax_label": y})
-    jax.block_until_ready(outs)
-    jax.block_until_ready(trainer.params)
-    dt = time.perf_counter() - t0
-
-    img_s = batch * n_steps / dt
-    # scale the FLOPs model with the benched resolution (FLOPs ∝ area)
-    flops_per_img = _TRAIN_FLOPS_PER_IMG * (image / 224.0) ** 2
-    peak = _peak_flops(dev.device_kind)
-    mfu = (img_s * flops_per_img / peak) if peak else None
+    try:
+        lstm = _bench_lstm(on_tpu, models, parallel, dev)
+    except Exception as exc:  # secondary metric must not sink the bench
+        lstm = {"error": "%s: %s" % (type(exc).__name__, exc)}
+    try:
+        ar = _bench_allreduce()
+    except Exception as exc:
+        ar = {"error": "%s: %s" % (type(exc).__name__, exc)}
 
     result = {
         "metric": "resnet50_train_throughput",
-        "value": round(img_s, 2),
+        "value": round(rn["img_s"], 2),
         "unit": "img/s",
-        "vs_baseline": round(img_s / BASELINE_IMG_S, 3),
-        "batch": batch,
-        "image_size": image,
+        "vs_baseline": round(rn["img_s"] / BASELINE_IMG_S, 3),
+        "batch": rn["batch"],
+        "image_size": rn["image"],
         "device": dev.device_kind,
         "platform": dev.platform,
-        "steps_timed": n_steps,
-        "step_ms": round(1000 * dt / n_steps, 2),
+        "step_ms": round(rn["step_ms"], 2),
     }
+    if degraded:
+        result["degraded"] = True  # TPU probe failed; this is a CPU number
     if mfu is not None:
         result["mfu"] = round(mfu, 4)
     elif on_tpu:
-        # unknown device kind — record what we saw so the peak table can grow
         result["mfu"] = None
-        result["mfu_note"] = "no bf16 peak known for device_kind=%r" % dev.device_kind
+        result["mfu_note"] = "no bf16 peak known for %r" % dev.device_kind
+    if "error" not in lstm:
+        result["lstm_tokens_per_s"] = round(lstm["tokens_per_s"], 1)
+        result["lstm_config"] = "b%d_seq%d_2x200" % (lstm["batch"], lstm["seq_len"])
+    else:
+        result["lstm_error"] = lstm["error"]
+    if "error" not in ar:
+        result["allreduce_gbps"] = round(ar["gbps"], 3)
+        result["allreduce_fabric"] = ar["fabric"]
+    else:
+        result["allreduce_error"] = ar["error"]
     print(json.dumps(result))
 
 

@@ -19,8 +19,15 @@ def get_symbol(num_classes=10000, num_embed=256, num_hidden=512, num_layers=2,
     embed = sym.Embedding(data=data, input_dim=num_classes, output_dim=num_embed,
                           name="embed")
     tm = sym.SwapAxis(data=embed, dim1=0, dim2=1, name="time_major")  # (T,N,E)
-    params = sym.Variable("lstm_parameters",
-                          shape=(rnn_param_size(num_layers, num_embed, num_hidden, False, "lstm"),))
+    from ..initializer import Uniform
+
+    params = sym.Variable(
+        "lstm_parameters",
+        shape=(rnn_param_size(num_layers, num_embed, num_hidden, False, "lstm"),),
+        # the fused blob has no weight/bias suffix for the initializer's
+        # dispatch; pin the classic LSTM uniform init on the variable
+        # (reference pattern: Variable(init=mx.init.FusedRNN(...)))
+        init=Uniform(0.1))
     # initial states carry the batch dimension explicitly, like the reference's
     # lstm_bucketing init_states entries in provide_data (example/rnn/lstm.py)
     init_h = sym.Variable("lstm_init_h", shape=(num_layers, batch_size, num_hidden))

@@ -121,16 +121,25 @@ def main():
     parser.add_argument("--legacy-allgather", action="store_true",
                         help="with --kvstore: measure the host allgather "
                              "path instead of the compiled collective")
+    parser.add_argument("--json", action="store_true",
+                        help="emit one JSON line per size (for bench.py)")
     args = parser.parse_args()
 
-    print("%8s %12s %12s" % ("size_MB", "time_ms", "busbw_GB/s"))
+    import json
+
+    if not args.json:
+        print("%8s %12s %12s" % ("size_MB", "time_ms", "busbw_GB/s"))
     for size in (float(s) for s in args.sizes.split(",")):
         if args.kvstore:
             dt, bw, n = measure_kvstore(size, args.iters,
                                         legacy=args.legacy_allgather)
         else:
             dt, bw, n = measure(size, args.iters)
-        print("%8g %12.3f %12.2f   (%d devices)" % (size, dt * 1e3, bw, n))
+        if args.json:
+            print(json.dumps({"size_mb": size, "time_ms": round(dt * 1e3, 3),
+                              "busbw_gbps": round(bw, 3), "devices": n}))
+        else:
+            print("%8g %12.3f %12.2f   (%d devices)" % (size, dt * 1e3, bw, n))
 
 
 if __name__ == "__main__":
