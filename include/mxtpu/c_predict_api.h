@@ -1,0 +1,74 @@
+/*
+ * C predict ABI for the TPU-native framework (parity surface of the
+ * reference's include/mxnet/c_predict_api.h, re-declared for
+ * libmxtpu_predict.so — see src/predict_api.cc for the implementation
+ * notes). Link: -lmxtpu_predict. All functions return 0 on success and -1
+ * on failure; MXGetLastError() describes the failure.
+ */
+#ifndef MXTPU_C_PREDICT_API_H_
+#define MXTPU_C_PREDICT_API_H_
+
+#include <stdint.h>
+
+#ifdef __cplusplus
+extern "C" {
+#endif
+
+typedef void* PredictorHandle;
+typedef uint32_t mx_uint;
+
+/* Last error message of the calling thread. */
+const char* MXGetLastError(void);
+
+/*
+ * Build a predictor from a symbol JSON and a .params blob.
+ * dev_type/dev_id are accepted for source compatibility; device placement
+ * follows the framework's default context (the TPU when present).
+ * input_shape_indptr has num_input_nodes+1 entries delimiting each input's
+ * dims inside input_shape_data (e.g. one NCHW input: indptr {0,4}).
+ */
+int MXPredCreate(const char* symbol_json_str, const void* param_bytes,
+                 int param_size, int dev_type, int dev_id,
+                 mx_uint num_input_nodes, const char** input_keys,
+                 const mx_uint* input_shape_indptr,
+                 const mx_uint* input_shape_data, PredictorHandle* out);
+
+/* As MXPredCreate, keeping only the named outputs. */
+int MXPredCreatePartialOut(const char* symbol_json_str,
+                           const void* param_bytes, int param_size,
+                           int dev_type, int dev_id, mx_uint num_input_nodes,
+                           const char** input_keys,
+                           const mx_uint* input_shape_indptr,
+                           const mx_uint* input_shape_data,
+                           mx_uint num_output_nodes,
+                           const char** output_keys, PredictorHandle* out);
+
+/* Stage a float32 input (size = element count, must match the bound shape). */
+int MXPredSetInput(PredictorHandle handle, const char* key,
+                   const float* data, mx_uint size);
+
+/* Run the staged inputs through the compiled graph. */
+int MXPredForward(PredictorHandle handle);
+
+/*
+ * Shape of output `index`. The returned pointer is valid until the next
+ * call on this handle (the reference's transient-buffer contract).
+ */
+int MXPredGetOutputShape(PredictorHandle handle, mx_uint index,
+                         mx_uint** shape_data, mx_uint* shape_ndim);
+
+/* Copy output `index` into data (size = element count, checked). */
+int MXPredGetOutput(PredictorHandle handle, mx_uint index, float* data,
+                    mx_uint size);
+
+/* Re-bind with new input shapes (recompiles once; XLA caches per shape). */
+int MXPredReshape(PredictorHandle handle, mx_uint num_input_nodes,
+                  const char** input_keys, const mx_uint* input_shape_indptr,
+                  const mx_uint* input_shape_data, PredictorHandle* out);
+
+int MXPredFree(PredictorHandle handle);
+
+#ifdef __cplusplus
+}
+#endif
+#endif  /* MXTPU_C_PREDICT_API_H_ */

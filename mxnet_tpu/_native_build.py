@@ -1,0 +1,41 @@
+"""One compile-if-stale helper for every native component.
+
+All the runtime's C++ pieces (src/engine_native.cc, io_native.cc,
+image_native.cc, predict_api.cc) share the same lifecycle: compile on first
+use with the system toolchain, cache under build/, rebuild when the source
+is newer, degrade gracefully (return None) when no compiler exists. The
+publish is atomic (temp file + os.replace) so concurrent processes never
+dlopen a half-written .so.
+"""
+from __future__ import annotations
+
+import os
+import subprocess
+
+_ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+_BUILD_DIR = os.path.join(_ROOT, "build")
+
+
+def source_path(name):
+    return os.path.join(_ROOT, "src", name)
+
+
+def build_lib(src, libname, extra_flags=(), opt="-O2"):
+    """Compile ``src`` (absolute path) into build/<libname> if stale.
+    Returns the .so path, or None when the toolchain/compile fails."""
+    out = os.path.join(_BUILD_DIR, libname)
+    try:
+        if os.path.isfile(out) and (
+                not os.path.isfile(src)
+                or os.path.getmtime(src) <= os.path.getmtime(out)):
+            return out
+        os.makedirs(_BUILD_DIR, exist_ok=True)
+        tmp = out + ".%d.tmp" % os.getpid()
+        subprocess.run(
+            ["g++", "-std=c++17", opt, "-shared", "-fPIC", "-pthread", src,
+             "-o", tmp] + list(extra_flags),
+            check=True, capture_output=True)
+        os.replace(tmp, out)
+        return out
+    except Exception:
+        return None

@@ -29,7 +29,6 @@ from __future__ import annotations
 
 import ctypes
 import os
-import subprocess
 import threading
 
 from .base import MXNetError
@@ -38,8 +37,6 @@ __all__ = ["Engine", "NaiveEngine", "ThreadedEngine", "get", "set_engine_type"]
 
 _ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
 _SRC = os.path.join(_ROOT, "src", "engine_native.cc")
-_BUILD_DIR = os.path.join(_ROOT, "build")
-_LIB_PATH = os.path.join(_BUILD_DIR, "libmxtpu_engine.so")
 
 _lib = None
 _lib_lock = threading.Lock()
@@ -51,17 +48,14 @@ def _load_lib():
     with _lib_lock:
         if _lib is not None or _lib_failed:
             return _lib
+        from ._native_build import build_lib
+
+        path = build_lib(_SRC, "libmxtpu_engine.so")
+        if path is None:
+            _lib_failed = True
+            return None
         try:
-            if not os.path.isfile(_LIB_PATH) or (
-                os.path.isfile(_SRC)
-                and os.path.getmtime(_SRC) > os.path.getmtime(_LIB_PATH)
-            ):
-                os.makedirs(_BUILD_DIR, exist_ok=True)
-                subprocess.run(
-                    ["g++", "-std=c++17", "-O2", "-shared", "-fPIC", "-pthread",
-                     _SRC, "-o", _LIB_PATH],
-                    check=True, capture_output=True)
-            lib = ctypes.CDLL(_LIB_PATH)
+            lib = ctypes.CDLL(path)
         except Exception:
             _lib_failed = True
             return None

@@ -11,7 +11,6 @@ from __future__ import annotations
 
 import ctypes
 import os
-import subprocess
 import threading
 
 import numpy as np
@@ -20,8 +19,6 @@ __all__ = ["available", "NativeImagePipeline"]
 
 _ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
 _SRC = os.path.join(_ROOT, "src", "image_native.cc")
-_BUILD_DIR = os.path.join(_ROOT, "build")
-_LIB_PATH = os.path.join(_BUILD_DIR, "libmxtpu_image.so")
 
 _lib = None
 _lock = threading.Lock()
@@ -33,21 +30,15 @@ def _load():
     with _lock:
         if _lib is not None or _build_failed:
             return _lib
+        from ._native_build import build_lib
+
+        path = build_lib(_SRC, "libmxtpu_image.so",
+                         extra_flags=["-ljpeg", "-lpng"], opt="-O3")
+        if path is None:
+            _build_failed = True
+            return None
         try:
-            if not os.path.isfile(_LIB_PATH) or (
-                os.path.isfile(_SRC)
-                and os.path.getmtime(_SRC) > os.path.getmtime(_LIB_PATH)
-            ):
-                os.makedirs(_BUILD_DIR, exist_ok=True)
-                # build to a unique temp path then atomically publish —
-                # concurrent processes must never dlopen a half-written .so
-                tmp = _LIB_PATH + ".%d.tmp" % os.getpid()
-                subprocess.run(
-                    ["g++", "-std=c++17", "-O3", "-shared", "-fPIC",
-                     "-pthread", _SRC, "-o", tmp, "-ljpeg", "-lpng"],
-                    check=True, capture_output=True)
-                os.replace(tmp, _LIB_PATH)
-            lib = ctypes.CDLL(_LIB_PATH)
+            lib = ctypes.CDLL(path)
         except Exception:
             _build_failed = True
             return None

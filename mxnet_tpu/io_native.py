@@ -11,7 +11,6 @@ from __future__ import annotations
 
 import ctypes
 import os
-import subprocess
 import threading
 
 import numpy as np
@@ -20,8 +19,6 @@ __all__ = ["available", "NativeRecordIOReader", "NativePrefetchReader", "read_id
 
 _ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
 _SRC = os.path.join(_ROOT, "src", "io_native.cc")
-_BUILD_DIR = os.path.join(_ROOT, "build")
-_LIB_PATH = os.path.join(_BUILD_DIR, "libmxtpu_io.so")
 
 _lib = None
 _lock = threading.Lock()
@@ -33,17 +30,14 @@ def _load():
     with _lock:
         if _lib is not None or _build_failed:
             return _lib
+        from ._native_build import build_lib
+
+        path = build_lib(_SRC, "libmxtpu_io.so")
+        if path is None:
+            _build_failed = True
+            return None
         try:
-            if not os.path.isfile(_LIB_PATH) or (
-                os.path.isfile(_SRC)
-                and os.path.getmtime(_SRC) > os.path.getmtime(_LIB_PATH)
-            ):
-                os.makedirs(_BUILD_DIR, exist_ok=True)
-                subprocess.run(
-                    ["g++", "-std=c++17", "-O2", "-shared", "-fPIC", "-pthread",
-                     _SRC, "-o", _LIB_PATH],
-                    check=True, capture_output=True)
-            lib = ctypes.CDLL(_LIB_PATH)
+            lib = ctypes.CDLL(path)
         except Exception:
             _build_failed = True
             return None

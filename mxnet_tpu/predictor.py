@@ -124,3 +124,9 @@ class Predictor:
     @property
     def num_outputs(self):
         return len(self._exe.outputs)
+
+    @property
+    def input_shapes(self):
+        """Bound input spec (consumed by the C ABI's MXPredSetInput size
+        check, src/predict_api.cc)."""
+        return dict(self._input_shapes)

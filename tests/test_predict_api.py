@@ -1,0 +1,135 @@
+"""C predict ABI (src/predict_api.cc; reference: c_predict_api.h).
+
+Oracle: a real C program compiles against include/mxtpu/c_predict_api.h,
+links libmxtpu_predict.so, runs MXPredCreate/SetInput/Forward/GetOutput on
+a checkpoint saved by the Python API, and its printed probabilities match
+the Python predictor's bit-for-bit (same XLA executable underneath)."""
+import os
+import subprocess
+import sys
+import sysconfig
+
+import numpy as np
+import pytest
+
+import mxnet_tpu as mx
+from mxnet_tpu import predict_api
+
+ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+C_SMOKE = r"""
+#include <stdio.h>
+#include <stdlib.h>
+#include "mxtpu/c_predict_api.h"
+
+int main(int argc, char** argv) {
+  /* argv: symbol.json params.bin input.bin n_in out.bin */
+  FILE* f = fopen(argv[1], "rb");
+  fseek(f, 0, SEEK_END); long js = ftell(f); fseek(f, 0, SEEK_SET);
+  char* json = (char*)malloc(js + 1);
+  if (fread(json, 1, js, f) != (size_t)js) return 10;
+  json[js] = 0; fclose(f);
+
+  f = fopen(argv[2], "rb");
+  fseek(f, 0, SEEK_END); long ps = ftell(f); fseek(f, 0, SEEK_SET);
+  void* params = malloc(ps);
+  if (fread(params, 1, ps, f) != (size_t)ps) return 11;
+  fclose(f);
+
+  mx_uint n_in = (mx_uint)atoi(argv[4]);
+  f = fopen(argv[3], "rb");
+  float* input = (float*)malloc(n_in * sizeof(float));
+  if (fread(input, sizeof(float), n_in, f) != n_in) return 12;
+  fclose(f);
+
+  const char* keys[] = {"data"};
+  mx_uint indptr[] = {0, 2};
+  mx_uint shape[] = {4, 8};  /* batch 4, feat 8 */
+  PredictorHandle h = NULL;
+  if (MXPredCreate(json, params, (int)ps, 1, 0, 1, keys, indptr, shape, &h)) {
+    fprintf(stderr, "create: %s\n", MXGetLastError()); return 1;
+  }
+  if (MXPredSetInput(h, "data", input, n_in)) {
+    fprintf(stderr, "set: %s\n", MXGetLastError()); return 2;
+  }
+  if (MXPredForward(h)) {
+    fprintf(stderr, "fwd: %s\n", MXGetLastError()); return 3;
+  }
+  mx_uint* oshape; mx_uint ondim;
+  if (MXPredGetOutputShape(h, 0, &oshape, &ondim)) return 4;
+  mx_uint total = 1;
+  for (mx_uint i = 0; i < ondim; ++i) total *= oshape[i];
+  float* out = (float*)malloc(total * sizeof(float));
+  if (MXPredGetOutput(h, 0, out, total)) {
+    fprintf(stderr, "get: %s\n", MXGetLastError()); return 5;
+  }
+  f = fopen(argv[5], "wb");
+  fwrite(&ondim, sizeof(mx_uint), 1, f);
+  fwrite(oshape, sizeof(mx_uint), ondim, f);
+  fwrite(out, sizeof(float), total, f);
+  fclose(f);
+  MXPredFree(h);
+  return 0;
+}
+"""
+
+
+@pytest.fixture(scope="module")
+def libpredict():
+    path = predict_api.build()
+    if path is None:
+        pytest.skip("no toolchain for libmxtpu_predict.so")
+    return path
+
+
+def test_c_program_matches_python_predictor(tmp_path, libpredict):
+    # 1) save a small net + params through the Python API
+    rs = np.random.RandomState(0)
+    net = mx.sym.FullyConnected(mx.sym.Variable("data"), num_hidden=5, name="fc")
+    net = mx.sym.SoftmaxOutput(net, name="softmax")
+    w = rs.randn(5, 8).astype("float32") * 0.3
+    b = rs.randn(5).astype("float32") * 0.1
+    json_path = tmp_path / "m-symbol.json"
+    json_path.write_text(net.tojson())
+    params_path = tmp_path / "m.params"
+    mx.nd.save(str(params_path), {"arg:fc_weight": mx.nd.array(w),
+                                  "arg:fc_bias": mx.nd.array(b)})
+    x = rs.rand(4, 8).astype("float32")
+    (tmp_path / "input.bin").write_bytes(x.tobytes())
+
+    # 2) compile the C smoke program against the public header
+    csrc = tmp_path / "smoke.c"
+    csrc.write_text(C_SMOKE)
+    exe = tmp_path / "smoke"
+    subprocess.run(
+        ["gcc", str(csrc), "-I", os.path.join(ROOT, "include"),
+         "-o", str(exe), str(libpredict),
+         "-Wl,-rpath," + os.path.dirname(str(libpredict))],
+        check=True, capture_output=True)
+
+    # 3) run it (PYTHONPATH so the embedded interpreter finds the package)
+    env = dict(os.environ)
+    env["PYTHONPATH"] = ROOT + os.pathsep + env.get("PYTHONPATH", "")
+    env.setdefault("JAX_PLATFORMS", "cpu")
+    env.setdefault("MXNET_DEFAULT_CONTEXT", "cpu")
+    out_bin = tmp_path / "out.bin"
+    r = subprocess.run(
+        [str(exe), str(json_path), str(params_path),
+         str(tmp_path / "input.bin"), str(x.size), str(out_bin)],
+        capture_output=True, text=True, timeout=300, env=env)
+    assert r.returncode == 0, r.stderr[-800:]
+
+    blob = out_bin.read_bytes()
+    ndim = np.frombuffer(blob[:4], np.uint32)[0]
+    shape = tuple(np.frombuffer(blob[4:4 + 4 * ndim], np.uint32))
+    got = np.frombuffer(blob[4 + 4 * ndim:], np.float32).reshape(shape)
+
+    # 4) the Python predictor is the oracle
+    from mxnet_tpu.predictor import Predictor
+
+    pred = Predictor(json_path.read_text(), params_path.read_bytes(),
+                     {"data": (4, 8)})
+    pred.forward(data=x)
+    want = pred.get_output(0)
+    assert shape == want.shape
+    np.testing.assert_allclose(got, want, rtol=1e-5, atol=1e-6)
