@@ -1,0 +1,333 @@
+"""Faster-RCNN-style end-to-end training (reference: example/rcnn/
+train_end2end.py + rcnn/symbol/symbol_vgg.py get_vgg_train).
+
+Compact two-stage detector exercising the full region pipeline on one XLA
+step: conv backbone → RPN (objectness + box-delta conv heads with
+MultiBoxTarget-assigned anchor targets) → ``Proposal`` (NMS'd region
+proposals, contrib op) → ``ProposalTarget`` (a python CustomOp, like the
+reference's rcnn/symbol/proposal_target.py) → ``ROIPooling`` → FC head with
+per-class softmax + smooth-L1 box regression.
+
+Runs on the synthetic rectangle detection set (no egress); the point is the
+end-to-end graph, every op of the reference's RCNN path trained together.
+"""
+import argparse
+import logging
+import os
+import sys
+
+import numpy as np
+
+sys.path.insert(0, os.path.abspath(os.path.join(os.path.dirname(__file__), "..", "..")))
+import mxnet_tpu as mx
+
+logging.basicConfig(level=logging.INFO)
+
+FEAT_STRIDE = 8
+IMG = 128
+N_ROIS = 32          # rois sampled per image by ProposalTarget
+RPN_POST_NMS = 64
+
+
+# --------------------------------------------------------- ProposalTarget
+@mx.operator.register("proposal_target")
+class ProposalTargetProp(mx.operator.CustomOpProp):
+    """Sample proposals and assign classification/box-regression targets
+    (reference: rcnn/symbol/proposal_target.py, also a python CustomOp)."""
+
+    def __init__(self, num_classes="4", fg_fraction="0.5"):
+        super().__init__(need_top_grad=False)
+        self._num_classes = int(num_classes)
+        self._fg = float(fg_fraction)
+
+    def list_arguments(self):
+        return ["rois", "gt_boxes"]
+
+    def list_outputs(self):
+        return ["rois_out", "label", "bbox_target", "bbox_weight"]
+
+    def infer_shape(self, in_shape):
+        n = N_ROIS
+        c = self._num_classes + 1
+        return (in_shape,
+                [[n, 5], [n], [n, 4 * c], [n, 4 * c]], [])
+
+    def create_operator(self, ctx, in_shapes, in_dtypes):
+        return ProposalTargetOp(self._num_classes, self._fg)
+
+
+class ProposalTargetOp(mx.operator.CustomOp):
+    def __init__(self, num_classes, fg_fraction):
+        self._nc = num_classes
+        self._fg = fg_fraction
+        self._rng = np.random.RandomState(0)
+
+    @staticmethod
+    def _iou(rois, gt):
+        x1 = np.maximum(rois[:, None, 0], gt[None, :, 0])
+        y1 = np.maximum(rois[:, None, 1], gt[None, :, 1])
+        x2 = np.minimum(rois[:, None, 2], gt[None, :, 2])
+        y2 = np.minimum(rois[:, None, 3], gt[None, :, 3])
+        inter = np.maximum(x2 - x1, 0) * np.maximum(y2 - y1, 0)
+        a = np.maximum(rois[:, 2] - rois[:, 0], 0) * np.maximum(rois[:, 3] - rois[:, 1], 0)
+        b = np.maximum(gt[:, 2] - gt[:, 0], 0) * np.maximum(gt[:, 3] - gt[:, 1], 0)
+        union = a[:, None] + b[None, :] - inter
+        return np.where(union > 0, inter / union, 0)
+
+    def forward(self, is_train, req, in_data, out_data, aux):
+        rois = in_data[0].asnumpy()          # (R, 5) [batch, x1,y1,x2,y2]
+        gt = in_data[1].asnumpy()[0]         # (M, 5) [cls, x1,y1,x2,y2], px
+        valid = gt[:, 0] >= 0
+        gt = gt[valid]
+        n = N_ROIS
+        out_rois = np.zeros((n, 5), np.float32)
+        labels = np.zeros((n,), np.float32)
+        btarget = np.zeros((n, 4 * (self._nc + 1)), np.float32)
+        bweight = np.zeros_like(btarget)
+        boxes = rois[:, 1:5]
+        if len(gt):
+            iou = self._iou(boxes, gt[:, 1:5])
+            best = iou.argmax(axis=1)
+            best_iou = iou.max(axis=1)
+        else:
+            best = np.zeros(len(boxes), np.int64)
+            best_iou = np.zeros(len(boxes))
+        fg_idx = np.where(best_iou >= 0.5)[0]
+        bg_idx = np.where(best_iou < 0.5)[0]
+        n_fg = min(len(fg_idx), int(self._fg * n))
+        fg_idx = self._rng.permutation(fg_idx)[:n_fg]
+        bg_take = self._rng.permutation(bg_idx)[: n - n_fg]
+        keep = np.concatenate([fg_idx, bg_take]).astype(np.int64)
+        if len(keep) < n:  # degenerate: repeat
+            keep = np.resize(keep, n)
+        out_rois[:] = rois[keep]
+        for slot, ri in enumerate(keep):
+            if slot < n_fg and len(gt):
+                g = gt[best[ri]]
+                cls = int(g[0]) + 1
+                labels[slot] = cls
+                bx = boxes[ri]
+                bw = max(bx[2] - bx[0], 1e-3)
+                bh = max(bx[3] - bx[1], 1e-3)
+                gw = max(g[3] - g[1], 1e-3)
+                gh = max(g[4] - g[2], 1e-3)
+                t = [((g[1] + g[3]) / 2 - (bx[0] + bx[2]) / 2) / bw,
+                     ((g[2] + g[4]) / 2 - (bx[1] + bx[3]) / 2) / bh,
+                     np.log(gw / bw), np.log(gh / bh)]
+                btarget[slot, 4 * cls:4 * cls + 4] = t
+                bweight[slot, 4 * cls:4 * cls + 4] = 1.0
+        self.assign(out_data[0], req[0], mx.nd.array(out_rois))
+        self.assign(out_data[1], req[1], mx.nd.array(labels))
+        self.assign(out_data[2], req[2], mx.nd.array(btarget))
+        self.assign(out_data[3], req[3], mx.nd.array(bweight))
+
+    def backward(self, req, out_grad, in_data, out_data, in_grad, aux):
+        for g, r in zip(in_grad, req):  # targets are constants
+            self.assign(g, r, mx.nd.zeros(g.shape))
+
+
+# ----------------------------------------------------------------- symbol
+def conv_relu(data, name, nf, stride=(1, 1)):
+    c = mx.sym.Convolution(data=data, num_filter=nf, kernel=(3, 3),
+                           pad=(1, 1), stride=stride, name="conv" + name)
+    return mx.sym.Activation(c, act_type="relu", name="relu" + name)
+
+
+def get_rcnn_train(num_classes, num_anchors=9):
+    data = mx.sym.Variable("data")
+    im_info = mx.sym.Variable("im_info")
+    gt_boxes = mx.sym.Variable("gt_boxes")
+    rpn_label = mx.sym.Variable("rpn_label")           # (B, A*H*W)
+    rpn_bbox_target = mx.sym.Variable("rpn_bbox_target")
+    rpn_bbox_weight = mx.sym.Variable("rpn_bbox_weight")
+
+    # backbone: stride-8 feature map (the reference's conv5 relu at /16)
+    net = conv_relu(data, "1", 16)
+    net = mx.sym.Pooling(net, kernel=(2, 2), stride=(2, 2), pool_type="max")
+    net = conv_relu(net, "2", 32)
+    net = mx.sym.Pooling(net, kernel=(2, 2), stride=(2, 2), pool_type="max")
+    net = conv_relu(net, "3", 64)
+    net = mx.sym.Pooling(net, kernel=(2, 2), stride=(2, 2), pool_type="max")
+    feat = conv_relu(net, "4", 64)
+
+    # RPN heads (reference: symbol_vgg.py get_vgg_rpn)
+    rpn_conv = conv_relu(feat, "_rpn", 64)
+    rpn_cls = mx.sym.Convolution(rpn_conv, kernel=(1, 1),
+                                 num_filter=2 * num_anchors, name="rpn_cls_score")
+    rpn_bbox = mx.sym.Convolution(rpn_conv, kernel=(1, 1),
+                                  num_filter=4 * num_anchors, name="rpn_bbox_pred")
+    # the reference's reshape dance: (B,2A,H,W) -> (B,2,A*H,W) for the
+    # channel softmax, back to (B,2A,H,W) for Proposal (symbol_vgg.py:220)
+    rpn_cls_rs = mx.sym.Reshape(rpn_cls, shape=(0, 2, -1, 0), name="rpn_cls_rs")
+    rpn_cls_prob = mx.sym.SoftmaxOutput(
+        data=rpn_cls_rs, label=rpn_label, multi_output=True, use_ignore=True,
+        ignore_label=-1, normalization="valid", name="rpn_cls_prob")
+    rpn_bbox_flat = mx.sym.Reshape(rpn_bbox, shape=(0, -1), name="rpn_bbox_flat")
+    rpn_bbox_loss_ = rpn_bbox_weight * mx.sym.smooth_l1(
+        data=(rpn_bbox_flat - rpn_bbox_target), scalar=3.0, name="rpn_l1")
+    rpn_bbox_loss = mx.sym.MakeLoss(rpn_bbox_loss_, grad_scale=1.0 / RPN_POST_NMS,
+                                    name="rpn_bbox_loss")
+
+    # proposals from the (stop-grad) RPN outputs (reference: Proposal op)
+    score_act = mx.sym.SoftmaxActivation(data=rpn_cls_rs, mode="channel",
+                                         name="rpn_prob_act")
+    score_act = mx.sym.Reshape(score_act, shape=(0, 2 * num_anchors, -1, 0),
+                               name="rpn_prob_rs")
+    rois = mx.sym.Proposal(
+        mx.sym.BlockGrad(score_act), mx.sym.BlockGrad(rpn_bbox),
+        im_info, feature_stride=FEAT_STRIDE, scales=(2.0, 4.0, 8.0),
+        ratios=(0.5, 1.0, 2.0), rpn_pre_nms_top_n=256,
+        rpn_post_nms_top_n=RPN_POST_NMS, threshold=0.7, rpn_min_size=4,
+        name="rois")
+
+    # sample + target assignment (python CustomOp, like the reference)
+    group = mx.sym.Custom(rois=rois, gt_boxes=gt_boxes, op_type="proposal_target",
+                          num_classes=str(num_classes), name="ptarget")
+    rois_s, label, bbox_target, bbox_weight = (group[0], group[1], group[2], group[3])
+
+    # RCNN head over pooled regions (reference: ROIPooling + fc6/fc7)
+    pooled = mx.sym.ROIPooling(feat, mx.sym.BlockGrad(rois_s), pooled_size=(6, 6),
+                               spatial_scale=1.0 / FEAT_STRIDE, name="roi_pool")
+    flat = mx.sym.Flatten(pooled)
+    fc = mx.sym.Activation(mx.sym.FullyConnected(flat, num_hidden=128, name="fc6"),
+                           act_type="relu")
+    cls_score = mx.sym.FullyConnected(fc, num_hidden=num_classes + 1, name="cls_score")
+    cls_prob = mx.sym.SoftmaxOutput(data=cls_score, label=mx.sym.BlockGrad(label),
+                                    normalization="batch", name="cls_prob")
+    bbox_pred = mx.sym.FullyConnected(fc, num_hidden=4 * (num_classes + 1),
+                                      name="bbox_pred")
+    bbox_loss_ = mx.sym.BlockGrad(bbox_weight) * mx.sym.smooth_l1(
+        data=(bbox_pred - mx.sym.BlockGrad(bbox_target)), scalar=1.0, name="rcnn_l1")
+    bbox_loss = mx.sym.MakeLoss(bbox_loss_, grad_scale=1.0 / N_ROIS,
+                                name="bbox_loss")
+    return mx.sym.Group([rpn_cls_prob, rpn_bbox_loss, cls_prob, bbox_loss])
+
+
+# -------------------------------------------------------------- data + fit
+def rpn_targets(gt, anchors, n_anchors_total, hw):
+    """Anchor-level RPN targets via IoU (the reference's AnchorLoader).
+    Box targets are laid out to match the flattened (4A, H, W) conv output:
+    flat[(4a+c)*HW + pos] for anchor index i = a*HW + pos."""
+    labels = -np.ones((n_anchors_total,), np.float32)
+    btarget = np.zeros((n_anchors_total * 4,), np.float32)
+    bweight = np.zeros_like(btarget)
+    valid = gt[gt[:, 0] >= 0][:, 1:5]
+    if len(valid):
+        x1 = np.maximum(anchors[:, None, 0], valid[None, :, 0])
+        y1 = np.maximum(anchors[:, None, 1], valid[None, :, 1])
+        x2 = np.minimum(anchors[:, None, 2], valid[None, :, 2])
+        y2 = np.minimum(anchors[:, None, 3], valid[None, :, 3])
+        inter = np.maximum(x2 - x1, 0) * np.maximum(y2 - y1, 0)
+        aa = np.maximum(anchors[:, 2] - anchors[:, 0], 0) * np.maximum(anchors[:, 3] - anchors[:, 1], 0)
+        ab = np.maximum(valid[:, 2] - valid[:, 0], 0) * np.maximum(valid[:, 3] - valid[:, 1], 0)
+        iou = np.where(aa[:, None] + ab[None] - inter > 0,
+                       inter / (aa[:, None] + ab[None] - inter), 0)
+        best_iou = iou.max(axis=1)
+        best_gt = iou.argmax(axis=1)
+        labels[best_iou >= 0.6] = 1
+        labels[best_iou < 0.3] = 0
+        pos = np.where(labels == 1)[0]
+        for i in pos:
+            g = valid[best_gt[i]]
+            a = anchors[i]
+            aw, ah = max(a[2] - a[0], 1e-3), max(a[3] - a[1], 1e-3)
+            gw, gh = max(g[2] - g[0], 1e-3), max(g[3] - g[1], 1e-3)
+            t = [((g[0] + g[2]) / 2 - (a[0] + a[2]) / 2) / aw,
+                 ((g[1] + g[3]) / 2 - (a[1] + a[3]) / 2) / ah,
+                 np.log(gw / aw), np.log(gh / ah)]
+            ai, pos_i = i // hw, i % hw
+            for c in range(4):
+                btarget[(4 * ai + c) * hw + pos_i] = t[c]
+                bweight[(4 * ai + c) * hw + pos_i] = 1.0
+    return labels, btarget, bweight
+
+
+def make_anchors(fm, stride, scales=(2.0, 4.0, 8.0), ratios=(0.5, 1.0, 2.0)):
+    """All anchors of the feature map in 'a-major' flat order matching the
+    (A*H*W) reshape of the RPN heads."""
+    out = []
+    for s in scales:
+        for r in ratios:
+            w = stride * s * np.sqrt(1.0 / r)
+            h = stride * s * np.sqrt(r)
+            for y in range(fm):
+                for x in range(fm):
+                    cx, cy = (x + 0.5) * stride, (y + 0.5) * stride
+                    out.append([cx - w / 2, cy - h / 2, cx + w / 2, cy + h / 2])
+    return np.asarray(out, np.float32)
+
+
+class SyntheticRCNNIter(mx.io.DataIter):
+    """Single-image batches of colored rectangles with full RPN targets."""
+
+    def __init__(self, num_classes, num_batches=24, seed=0):
+        super().__init__(1)
+        fm = IMG // FEAT_STRIDE
+        self.anchors = make_anchors(fm, FEAT_STRIDE)
+        na = len(self.anchors)
+        rs = np.random.RandomState(seed)
+        self.batches = []
+        for _ in range(num_batches):
+            img = np.zeros((1, 3, IMG, IMG), np.float32)
+            gt = -np.ones((1, 3, 5), np.float32)
+            for j in range(rs.randint(1, 3)):
+                cls = rs.randint(0, num_classes)
+                x0, y0 = rs.randint(0, IMG // 2, 2)
+                w, h = rs.randint(IMG // 4, IMG // 2, 2)
+                x1, y1 = min(x0 + w, IMG - 1), min(y0 + h, IMG - 1)
+                img[0, cls % 3, y0:y1, x0:x1] = 1.0
+                gt[0, j] = [cls, x0, y0, x1, y1]
+            lab, bt, bw = rpn_targets(gt[0], self.anchors, na, fm * fm)
+            self.batches.append(mx.io.DataBatch(
+                data=[mx.nd.array(img),
+                      mx.nd.array([[IMG, IMG, 1.0]]),
+                      mx.nd.array(gt)],
+                label=[mx.nd.array(lab.reshape(1, -1, fm)),
+                       mx.nd.array(bt[None]),
+                       mx.nd.array(bw[None])],
+                pad=0))
+        self.cur = 0
+        fmsz = fm * fm
+        self.provide_data = [
+            mx.io.DataDesc("data", (1, 3, IMG, IMG)),
+            mx.io.DataDesc("im_info", (1, 3)),
+            mx.io.DataDesc("gt_boxes", (1, 3, 5))]
+        A = na // fmsz
+        # label shaped to the (B,2,A*H,W) softmax view; flat order matches
+        # make_anchors' a-major enumeration
+        self.provide_label = [
+            mx.io.DataDesc("rpn_label", (1, A * fm, fm)),
+            mx.io.DataDesc("rpn_bbox_target", (1, na * 4)),
+            mx.io.DataDesc("rpn_bbox_weight", (1, na * 4))]
+
+    def next(self):
+        if self.cur >= len(self.batches):
+            raise StopIteration
+        b = self.batches[self.cur]
+        self.cur += 1
+        return b
+
+    def reset(self):
+        self.cur = 0
+
+
+if __name__ == "__main__":
+    parser = argparse.ArgumentParser(description="train Faster-RCNN (compact)")
+    parser.add_argument("--num-classes", type=int, default=3)
+    parser.add_argument("--num-epochs", type=int, default=2)
+    parser.add_argument("--lr", type=float, default=0.005)
+    args = parser.parse_args()
+
+    net = get_rcnn_train(args.num_classes)
+    it = SyntheticRCNNIter(args.num_classes)
+    mod = mx.mod.Module(net, data_names=("data", "im_info", "gt_boxes"),
+                        label_names=("rpn_label", "rpn_bbox_target",
+                                     "rpn_bbox_weight"),
+                        context=mx.current_context())
+    mod.fit(it, num_epoch=args.num_epochs, optimizer="sgd",
+            optimizer_params={"learning_rate": args.lr, "momentum": 0.9,
+                              "wd": 5e-4},
+            initializer=mx.init.Xavier(),
+            eval_metric=mx.metric.Loss(),
+            batch_end_callback=mx.callback.Speedometer(1, 8))
+    print("RCNN end-to-end training finished")

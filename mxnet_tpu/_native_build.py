@@ -20,12 +20,14 @@ def source_path(name):
     return os.path.join(_ROOT, "src", name)
 
 
-def build_lib(src, libname, extra_flags=(), opt="-O2"):
+def build_lib(src, libname, extra_flags=(), opt="-O2", force=False):
     """Compile ``src`` (absolute path) into build/<libname> if stale.
-    Returns the .so path, or None when the toolchain/compile fails."""
+    Returns the .so path, or None when the toolchain/compile fails.
+    ``force`` rebuilds even when mtimes say fresh (compile inputs the
+    staleness check can't see — e.g. a Python version switch)."""
     out = os.path.join(_BUILD_DIR, libname)
     try:
-        if os.path.isfile(out) and (
+        if not force and os.path.isfile(out) and (
                 not os.path.isfile(src)
                 or os.path.getmtime(src) <= os.path.getmtime(out)):
             return out

@@ -32,5 +32,5 @@ def build(force=False):
         inc = sysconfig.get_paths()["include"]
         libdir = sysconfig.get_config_var("LIBDIR")
         pyver = "python%d.%d" % sys.version_info[:2]
-        return build_lib(_SRC, "libmxtpu_predict.so",
+        return build_lib(_SRC, "libmxtpu_predict.so", force=force,
                          extra_flags=["-I", inc, "-L", libdir, "-l", pyver])

@@ -26,12 +26,16 @@ typedef uint32_t mx_uint;
 namespace {
 
 std::mutex g_init_mu;
-bool g_we_initialized = false;
 thread_local std::string g_last_error;
 
 struct Pred {
   PyObject* predictor = nullptr;   // mxnet_tpu.predictor.Predictor
   PyObject* staged = nullptr;      // dict of inputs set via MXPredSetInput
+  // creation arguments, retained so MXPredReshape can build an INDEPENDENT
+  // predictor (a shared one would mutate under the old handle)
+  PyObject* symbol_json = nullptr;
+  PyObject* param_bytes = nullptr;
+  PyObject* output_names = nullptr;
   // one cached fetch: GetOutputShape-then-GetOutput is the canonical call
   // sequence and must not copy device->host twice
   long cached_index = -1;
@@ -39,17 +43,22 @@ struct Pred {
   std::vector<float> out_data;
 };
 
+PyObject* np_module() {
+  static PyObject* np = nullptr;  // borrowed forever (interned)
+  if (!np) np = PyImport_ImportModule("numpy");
+  return np;
+}
+
 // Fetch output `index` into the handle's cache (caller holds the GIL).
 int fetch_output(Pred* p, mx_uint index) {
   if (p->cached_index == static_cast<long>(index)) return 0;
   PyObject* out = PyObject_CallMethod(p->predictor, "get_output", "I", index);
   if (!out) return -1;
-  PyObject* np = PyImport_ImportModule("numpy");
+  PyObject* np = np_module();
   PyObject* flat = np ? PyObject_CallMethod(
       np, "ascontiguousarray", "Os", out, "float32") : nullptr;
   PyObject* shp = PyObject_GetAttrString(out, "shape");
   Py_DECREF(out);
-  Py_XDECREF(np);
   if (!flat || !shp) {
     Py_XDECREF(flat);
     Py_XDECREF(shp);
@@ -76,8 +85,7 @@ int fetch_output(Pred* p, mx_uint index) {
 void ensure_python() {
   std::lock_guard<std::mutex> lk(g_init_mu);
   if (!Py_IsInitialized()) {
-    Py_InitializeEx(0);
-    g_we_initialized = true;
+    Py_InitializeEx(0);  // the interpreter lives for the process lifetime
     PyEval_SaveThread();  // release the GIL so PyGILState_Ensure works
   }
 }
@@ -110,12 +118,6 @@ int fail(const std::string& msg) {
   return -1;
 }
 
-PyObject* np_module() {
-  static PyObject* np = nullptr;  // borrowed forever (interned)
-  if (!np) np = PyImport_ImportModule("numpy");
-  return np;
-}
-
 // float32 C-order ndarray copy of `data` with the given shape
 PyObject* make_array(const float* data, const std::vector<Py_ssize_t>& shape) {
   PyObject* np = np_module();
@@ -141,6 +143,24 @@ PyObject* make_array(const float* data, const std::vector<Py_ssize_t>& shape) {
   return owned;
 }
 
+// Build a Predictor instance from (json, params, shapes-dict, outputs).
+PyObject* new_predictor(PyObject* json, PyObject* params, PyObject* shapes,
+                        PyObject* output_names) {
+  PyObject* mod = PyImport_ImportModule("mxnet_tpu.predictor");
+  if (!mod) return nullptr;
+  PyObject* cls = PyObject_GetAttrString(mod, "Predictor");
+  Py_DECREF(mod);
+  if (!cls) return nullptr;
+  PyObject* kwargs = PyDict_New();
+  PyDict_SetItemString(kwargs, "output_names", output_names);
+  PyObject* args = Py_BuildValue("(OOO)", json, params, shapes);
+  PyObject* predictor = PyObject_Call(cls, args, kwargs);
+  Py_DECREF(args);
+  Py_DECREF(kwargs);
+  Py_DECREF(cls);
+  return predictor;
+}
+
 int create_impl(const char* symbol_json_str, const void* param_bytes,
                 int param_size, mx_uint num_input_nodes,
                 const char** input_keys, const mx_uint* input_shape_indptr,
@@ -148,12 +168,6 @@ int create_impl(const char* symbol_json_str, const void* param_bytes,
                 const char** output_keys, PredictorHandle* out) {
   ensure_python();
   Gil gil;
-  PyObject* mod = PyImport_ImportModule("mxnet_tpu.predictor");
-  if (!mod) return fail_from_python();
-  PyObject* cls = PyObject_GetAttrString(mod, "Predictor");
-  Py_DECREF(mod);
-  if (!cls) return fail_from_python();
-
   PyObject* shapes = PyDict_New();
   for (mx_uint i = 0; i < num_input_nodes; ++i) {
     PyObject* tup = PyTuple_New(input_shape_indptr[i + 1] -
@@ -174,21 +188,22 @@ int create_impl(const char* symbol_json_str, const void* param_bytes,
     for (mx_uint i = 0; i < num_output_nodes; ++i)
       PyList_SET_ITEM(outputs, i, PyUnicode_FromString(output_keys[i]));
   }
-  PyObject* kwargs = PyDict_New();
-  PyDict_SetItemString(kwargs, "output_names", outputs);
-  PyObject* args = Py_BuildValue("(sOO)", symbol_json_str, params, shapes);
-  PyObject* predictor = PyObject_Call(cls, args, kwargs);
-  Py_DECREF(args);
-  Py_DECREF(kwargs);
-  Py_DECREF(outputs);
-  Py_DECREF(params);
+  PyObject* json = PyUnicode_FromString(symbol_json_str);
+  PyObject* predictor = new_predictor(json, params, shapes, outputs);
   Py_DECREF(shapes);
-  Py_DECREF(cls);
-  if (!predictor) return fail_from_python();
+  if (!predictor) {
+    Py_DECREF(json);
+    Py_DECREF(params);
+    Py_DECREF(outputs);
+    return fail_from_python();
+  }
 
   auto* p = new Pred();
   p->predictor = predictor;
   p->staged = PyDict_New();
+  p->symbol_json = json;        // retained for MXPredReshape
+  p->param_bytes = params;
+  p->output_names = outputs;
   *out = p;
   return 0;
 }
@@ -310,16 +325,21 @@ int MXPredReshape(PredictorHandle handle, mx_uint num_input_nodes,
     PyDict_SetItemString(shapes, input_keys[i], tup);
     Py_DECREF(tup);
   }
-  PyObject* r = PyObject_CallMethod(p->predictor, "reshape", "O", shapes);
+  // a fully INDEPENDENT predictor for the new shapes: sharing the old
+  // Python object would mutate the old handle's executor underneath it
+  PyObject* predictor = new_predictor(p->symbol_json, p->param_bytes,
+                                      shapes, p->output_names);
   Py_DECREF(shapes);
-  if (!r) return fail_from_python();
-  Py_DECREF(r);
-  // a DISTINCT handle owning its own references: the reference contract
-  // lets callers free the old and new handle independently
+  if (!predictor) return fail_from_python();
   auto* q = new Pred();
-  q->predictor = p->predictor;
-  Py_INCREF(q->predictor);
+  q->predictor = predictor;
   q->staged = PyDict_New();
+  q->symbol_json = p->symbol_json;
+  Py_INCREF(q->symbol_json);
+  q->param_bytes = p->param_bytes;
+  Py_INCREF(q->param_bytes);
+  q->output_names = p->output_names;
+  Py_INCREF(q->output_names);
   *out = q;
   return 0;
 }
@@ -331,6 +351,9 @@ int MXPredFree(PredictorHandle handle) {
     Gil gil;
     Py_XDECREF(p->predictor);
     Py_XDECREF(p->staged);
+    Py_XDECREF(p->symbol_json);
+    Py_XDECREF(p->param_bytes);
+    Py_XDECREF(p->output_names);
   }
   delete p;
   return 0;

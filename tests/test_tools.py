@@ -81,3 +81,19 @@ def test_launcher_dist_sync():
          "--launcher", "local", "--cpu-devices", "1", sys.executable, "-c", script],
         capture_output=True, text=True, timeout=300, cwd=ROOT, env=env)
     assert proc.returncode == 0, proc.stderr[-2000:]
+
+
+def test_rcnn_example_end_to_end():
+    """The compact Faster-RCNN example (RPN -> Proposal -> ProposalTarget
+    CustomOp -> ROIPooling -> heads) trains one epoch with finite loss."""
+    import subprocess
+    import sys
+
+    env = dict(os.environ)
+    env.update({"JAX_PLATFORMS": "cpu", "MXNET_DEFAULT_CONTEXT": "cpu"})
+    r = subprocess.run(
+        [sys.executable, os.path.join(ROOT, "example", "rcnn", "train_rcnn.py"),
+         "--num-epochs", "1"],
+        capture_output=True, text=True, timeout=500, env=env)
+    assert r.returncode == 0, (r.stderr or r.stdout)[-800:]
+    assert "RCNN end-to-end training finished" in r.stdout
