@@ -1,0 +1,129 @@
+/*
+ * Header-only C++ wrapper over the C predict ABI (the cpp-package analogue
+ * for the deployment surface; reference: cpp-package/include/mxnet-cpp).
+ * RAII handles, std::vector IO, exceptions from MXGetLastError.
+ *
+ *   mxtpu::Predictor pred(json, params, {{"data", {1, 3, 224, 224}}});
+ *   pred.SetInput("data", batch);
+ *   pred.Forward();
+ *   std::vector<float> probs = pred.GetOutput(0);
+ */
+#ifndef MXTPU_CPP_PREDICTOR_HPP_
+#define MXTPU_CPP_PREDICTOR_HPP_
+
+#include <map>
+#include <stdexcept>
+#include <string>
+#include <utility>
+#include <vector>
+
+#include "../c_predict_api.h"
+
+namespace mxtpu {
+
+class Error : public std::runtime_error {
+ public:
+  using std::runtime_error::runtime_error;
+};
+
+class Predictor {
+ public:
+  using Shapes = std::map<std::string, std::vector<mx_uint>>;
+
+  Predictor(const std::string& symbol_json, const std::string& param_bytes,
+            const Shapes& input_shapes,
+            const std::vector<std::string>& output_keys = {}) {
+    std::vector<const char*> keys;
+    std::vector<mx_uint> indptr{0};
+    std::vector<mx_uint> dims;
+    for (const auto& kv : input_shapes) {
+      keys.push_back(kv.first.c_str());
+      dims.insert(dims.end(), kv.second.begin(), kv.second.end());
+      indptr.push_back(static_cast<mx_uint>(dims.size()));
+    }
+    int rc;
+    if (output_keys.empty()) {
+      rc = MXPredCreate(symbol_json.c_str(), param_bytes.data(),
+                        static_cast<int>(param_bytes.size()), 1, 0,
+                        static_cast<mx_uint>(keys.size()), keys.data(),
+                        indptr.data(), dims.data(), &handle_);
+    } else {
+      std::vector<const char*> outs;
+      for (const auto& o : output_keys) outs.push_back(o.c_str());
+      rc = MXPredCreatePartialOut(
+          symbol_json.c_str(), param_bytes.data(),
+          static_cast<int>(param_bytes.size()), 1, 0,
+          static_cast<mx_uint>(keys.size()), keys.data(), indptr.data(),
+          dims.data(), static_cast<mx_uint>(outs.size()), outs.data(),
+          &handle_);
+    }
+    if (rc != 0) throw Error(MXGetLastError());
+  }
+
+  Predictor(const Predictor&) = delete;
+  Predictor& operator=(const Predictor&) = delete;
+  Predictor(Predictor&& other) noexcept : handle_(other.handle_) {
+    other.handle_ = nullptr;
+  }
+  Predictor& operator=(Predictor&& other) noexcept {
+    std::swap(handle_, other.handle_);
+    return *this;
+  }
+
+  ~Predictor() {
+    if (handle_) MXPredFree(handle_);
+  }
+
+  void SetInput(const std::string& key, const std::vector<float>& data) {
+    if (MXPredSetInput(handle_, key.c_str(), data.data(),
+                       static_cast<mx_uint>(data.size())) != 0)
+      throw Error(MXGetLastError());
+  }
+
+  void Forward() {
+    if (MXPredForward(handle_) != 0) throw Error(MXGetLastError());
+  }
+
+  std::vector<mx_uint> GetOutputShape(mx_uint index) {
+    mx_uint* data;
+    mx_uint ndim;
+    if (MXPredGetOutputShape(handle_, index, &data, &ndim) != 0)
+      throw Error(MXGetLastError());
+    return std::vector<mx_uint>(data, data + ndim);
+  }
+
+  std::vector<float> GetOutput(mx_uint index) {
+    auto shape = GetOutputShape(index);
+    mx_uint total = 1;
+    for (auto d : shape) total *= d;
+    std::vector<float> out(total);
+    if (MXPredGetOutput(handle_, index, out.data(), total) != 0)
+      throw Error(MXGetLastError());
+    return out;
+  }
+
+  /* New independently-owned predictor bound to new input shapes. */
+  Predictor Reshape(const Shapes& input_shapes) {
+    std::vector<const char*> keys;
+    std::vector<mx_uint> indptr{0};
+    std::vector<mx_uint> dims;
+    for (const auto& kv : input_shapes) {
+      keys.push_back(kv.first.c_str());
+      dims.insert(dims.end(), kv.second.begin(), kv.second.end());
+      indptr.push_back(static_cast<mx_uint>(dims.size()));
+    }
+    PredictorHandle h;
+    if (MXPredReshape(handle_, static_cast<mx_uint>(keys.size()), keys.data(),
+                      indptr.data(), dims.data(), &h) != 0)
+      throw Error(MXGetLastError());
+    return Predictor(h);
+  }
+
+ private:
+  explicit Predictor(PredictorHandle h) : handle_(h) {}
+  PredictorHandle handle_ = nullptr;
+};
+
+}  // namespace mxtpu
+
+#endif  /* MXTPU_CPP_PREDICTOR_HPP_ */

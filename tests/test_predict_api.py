@@ -133,3 +133,90 @@ def test_c_program_matches_python_predictor(tmp_path, libpredict):
     want = pred.get_output(0)
     assert shape == want.shape
     np.testing.assert_allclose(got, want, rtol=1e-5, atol=1e-6)
+
+
+CPP_SMOKE = r"""
+#include <cstdio>
+#include <fstream>
+#include <sstream>
+#include "mxtpu/cpp/predictor.hpp"
+
+static std::string slurp(const char* p, bool binary) {
+  std::ifstream f(p, binary ? std::ios::binary : std::ios::in);
+  std::ostringstream ss;
+  ss << f.rdbuf();
+  return ss.str();
+}
+
+int main(int argc, char** argv) {
+  try {
+    mxtpu::Predictor pred(slurp(argv[1], false), slurp(argv[2], true),
+                          {{"data", {4, 8}}});
+    std::string in = slurp(argv[3], true);
+    std::vector<float> x(reinterpret_cast<const float*>(in.data()),
+                         reinterpret_cast<const float*>(in.data()) + 32);
+    pred.SetInput("data", x);
+    pred.Forward();
+    auto out = pred.GetOutput(0);
+    // move + reshape to batch 1
+    mxtpu::Predictor small = pred.Reshape({{"data", {1, 8}}});
+    small.SetInput("data", std::vector<float>(x.begin(), x.begin() + 8));
+    small.Forward();
+    auto out1 = small.GetOutput(0);
+    std::ofstream f(argv[4], std::ios::binary);
+    f.write(reinterpret_cast<const char*>(out.data()),
+            out.size() * sizeof(float));
+    f.write(reinterpret_cast<const char*>(out1.data()),
+            out1.size() * sizeof(float));
+    return 0;
+  } catch (const std::exception& e) {
+    fprintf(stderr, "cpp error: %s\n", e.what());
+    return 1;
+  }
+}
+"""
+
+
+def test_cpp_wrapper_matches_python(tmp_path, libpredict):
+    """The header-only C++ RAII wrapper (cpp-package analogue) drives the
+    same checkpoint, with Reshape returning an independent predictor."""
+    rs = np.random.RandomState(1)
+    net = mx.sym.SoftmaxOutput(
+        mx.sym.FullyConnected(mx.sym.Variable("data"), num_hidden=5, name="fc"),
+        name="softmax")
+    w = rs.randn(5, 8).astype("float32") * 0.3
+    (tmp_path / "m-symbol.json").write_text(net.tojson())
+    mx.nd.save(str(tmp_path / "m.params"),
+               {"arg:fc_weight": mx.nd.array(w),
+                "arg:fc_bias": mx.nd.zeros((5,))})
+    x = rs.rand(4, 8).astype("float32")
+    (tmp_path / "in.bin").write_bytes(x.tobytes())
+
+    cpp = tmp_path / "smoke.cc"
+    cpp.write_text(CPP_SMOKE)
+    exe = tmp_path / "smokecc"
+    subprocess.run(
+        ["g++", "-std=c++17", str(cpp), "-I", os.path.join(ROOT, "include"),
+         "-o", str(exe), str(libpredict),
+         "-Wl,-rpath," + os.path.dirname(str(libpredict))],
+        check=True, capture_output=True)
+    env = dict(os.environ)
+    env["PYTHONPATH"] = ROOT + os.pathsep + env.get("PYTHONPATH", "")
+    env.setdefault("JAX_PLATFORMS", "cpu")
+    env.setdefault("MXNET_DEFAULT_CONTEXT", "cpu")
+    out_bin = tmp_path / "o.bin"
+    r = subprocess.run(
+        [str(exe), str(tmp_path / "m-symbol.json"), str(tmp_path / "m.params"),
+         str(tmp_path / "in.bin"), str(out_bin)],
+        capture_output=True, text=True, timeout=300, env=env)
+    assert r.returncode == 0, r.stderr[-800:]
+    blob = np.frombuffer(out_bin.read_bytes(), np.float32)
+    got4, got1 = blob[:20].reshape(4, 5), blob[20:].reshape(1, 5)
+
+    from mxnet_tpu.predictor import Predictor
+
+    pr = Predictor((tmp_path / "m-symbol.json").read_text(),
+                   (tmp_path / "m.params").read_bytes(), {"data": (4, 8)})
+    pr.forward(data=x)
+    np.testing.assert_allclose(got4, pr.get_output(0), rtol=1e-5, atol=1e-6)
+    np.testing.assert_allclose(got1, pr.get_output(0)[:1], rtol=1e-4, atol=1e-5)
