@@ -55,7 +55,11 @@ class SPMDTrainer:
     rules : ShardingRules (defaults to batch-on-'data', params replicated or
         tensor-sharded on 'model' when present).
     remat : rematerialise the forward during backward (jax.checkpoint) — the
-        MXNET_BACKWARD_DO_MIRROR memory/compute trade.
+        MXNET_BACKWARD_DO_MIRROR memory/compute trade. May also be a policy
+        name: 'dots' (save matmul/conv outputs, recompute elementwise/BN —
+        the bytes-for-FLOPs trade docs/PERF.md recommends on HBM-bound
+        chips), 'nothing' (recompute everything), or True (save-nothing
+        default checkpoint).
     compute_dtype : e.g. 'bfloat16' — cast inputs+params for compute, keep
         fp32 master weights and fp32 grads (MXU fast path).
     """
@@ -64,13 +68,14 @@ class SPMDTrainer:
                  label_names=("softmax_label",), optimizer="sgd",
                  optimizer_params=None, rules: Optional[ShardingRules] = None,
                  remat=False, compute_dtype=None):
+        # remat accepts False | True | 'dots' | 'nothing'
         from ..executor import _GraphProgram
 
         self.symbol = symbol
         self.mesh = mesh
         self.rules = rules or ShardingRules(mesh)
         self._prog = _GraphProgram(symbol)
-        self._remat = bool(remat)
+        self._remat = remat
         self._compute_dtype = np.dtype(compute_dtype) if compute_dtype else None
 
         arg_names = self._prog.arg_names
@@ -218,7 +223,16 @@ class SPMDTrainer:
             return outs, new_aux
 
         if self._remat:
-            fwd = jax.checkpoint(fwd, static_argnums=())
+            if self._remat == "dots":
+                # keep MXU results, re-derive cheap elementwise/norm chains
+                # in backward instead of round-tripping them through HBM
+                pol = jax.checkpoint_policies.dots_with_no_batch_dims_saveable
+                fwd = jax.checkpoint(fwd, policy=pol)
+            elif self._remat == "nothing":
+                fwd = jax.checkpoint(
+                    fwd, policy=jax.checkpoint_policies.nothing_saveable)
+            else:
+                fwd = jax.checkpoint(fwd, static_argnums=())
 
         def step(params, aux, opt_state, inputs, base_key, lr):
             # derive the per-step key on device from the optimizer counter —

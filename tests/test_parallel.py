@@ -89,3 +89,38 @@ def test_batch_sharded_on_data_axis():
                    {"softmax_label": np.zeros((16,), "float32")})
     spec = outs[0].sharding.spec
     assert spec and spec[0] == "data"
+
+
+def test_trainer_remat_policies_match_plain():
+    """remat=True/'dots'/'nothing' recompute strategies must not change the
+    numbers — same params after 3 steps as the un-rematerialized trainer."""
+    import jax
+    import numpy as np
+    import mxnet_tpu as mx
+    from mxnet_tpu import models, parallel
+
+    devs = jax.devices()[:2]
+    rs = np.random.RandomState(0)
+    x = rs.rand(8, 1, 28, 28).astype("float32")
+    y = rs.randint(0, 10, (8,)).astype("float32")
+
+    def run(remat):
+        mesh = parallel.make_mesh((len(devs),), ("data",), devs)
+        net = models.get_symbol("lenet", num_classes=10)
+        tr = parallel.SPMDTrainer(net, mesh, optimizer="sgd",
+                                  optimizer_params={"learning_rate": 0.1},
+                                  remat=remat)
+        tr.init_params({"data": (8, 1, 28, 28)}, {"softmax_label": (8,)},
+                       seed=0)
+        for _ in range(3):
+            tr.step({"data": x}, {"softmax_label": y})
+        arg, _ = tr.get_params()
+        return arg
+
+    base = run(False)
+    for mode in (True, "dots", "nothing"):
+        got = run(mode)
+        for k in base:
+            np.testing.assert_allclose(
+                got[k], base[k], rtol=1e-5, atol=1e-6,
+                err_msg="remat=%r diverged on %s" % (mode, k))
