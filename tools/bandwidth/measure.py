@@ -133,6 +133,10 @@ def main():
         if args.kvstore:
             dt, bw, n = measure_kvstore(size, args.iters,
                                         legacy=args.legacy_allgather)
+            # under launch.py every worker shares one stdout — interleaved
+            # prints corrupt the JSON stream, so only rank 0 reports
+            if args.json and int(os.environ.get("MXNET_TPU_WORKER_ID", "0")):
+                continue
         else:
             dt, bw, n = measure(size, args.iters)
         if args.json:

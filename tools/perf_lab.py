@@ -21,6 +21,10 @@ def main():
     ap.add_argument("--steps", type=int, default=10)
     ap.add_argument("--profile", default=None, help="capture jax trace to DIR")
     ap.add_argument("--compute-dtype", default="bfloat16")
+    ap.add_argument("--remat", default="false",
+                    choices=["false", "true", "dots", "nothing"])
+    ap.add_argument("--input-dtype", default="float32",
+                    help="dtype the input batch is placed on device in")
     args = ap.parse_args()
 
     import jax
@@ -32,15 +36,22 @@ def main():
     mesh = parallel.make_mesh((1,), axis_names=("data",), devices=[dev])
     net = models.get_symbol(args.net, num_classes=1000,
                             image_shape="3,%d,%d" % (args.image, args.image))
+    remat = {"false": False, "true": True}.get(args.remat, args.remat)
     trainer = parallel.SPMDTrainer(
         net, mesh, optimizer="sgd",
         optimizer_params={"learning_rate": 0.1, "momentum": 0.9},
+        remat=remat,
         compute_dtype=args.compute_dtype or None)
     b = args.batch
     trainer.init_params({"data": (b, 3, args.image, args.image)},
                         {"softmax_label": (b,)}, seed=0)
     rs = np.random.RandomState(0)
-    x = jax.device_put(rs.rand(b, 3, args.image, args.image).astype("float32"),
+    import jax.numpy as _jnp
+
+    x_host = rs.rand(b, 3, args.image, args.image).astype("float32")
+    if args.input_dtype != "float32":
+        x_host = x_host.astype(_jnp.dtype(args.input_dtype))
+    x = jax.device_put(x_host,
                        trainer.rules.named(trainer.rules.batch_spec((b, 3, args.image, args.image))))
     y = jax.device_put(rs.randint(0, 1000, (b,)).astype("float32"),
                        trainer.rules.named(trainer.rules.batch_spec((b,))))
@@ -72,7 +83,8 @@ def main():
     mfu_ok = peak and args.net == "resnet-50"
     out = {"batch": b, "step_ms": round(1000 * dt / args.steps, 2),
            "img_s": round(img_s, 1), "device": dev.device_kind,
-           "net": args.net,
+           "net": args.net, "remat": args.remat,
+           "input_dtype": args.input_dtype,
            "mfu": round(img_s * flops / peak, 4) if mfu_ok else None}
     print(json.dumps(out))
 
