@@ -294,6 +294,21 @@ class SPMDTrainer:
             raise MXNetError("call init_params first")
         if self._step_fn is None:
             self._step_fn = self._build_step()
+        placed = self._place_batch(data, label)
+        if lr is None:
+            lr = self._opt_static_lr  # may stay None → apply() uses its own lr
+        self._step_count += 1
+        self.params, self.aux, self.opt_state, outs = self._step_fn(
+            self.params, self.aux, self.opt_state, placed, self._base_key,
+            None if lr is None else jnp.asarray(lr, "float32"))
+        return outs
+
+    def _place_batch(self, data, label=None):
+        """Lay one batch out on the mesh per the sharding rules (shared by
+        ``step`` and ``cost_analysis``)."""
+        import jax
+        import jax.numpy as jnp
+
         inputs = dict(data)
         inputs.update(label or {})
         placed = {}
@@ -306,13 +321,28 @@ class SPMDTrainer:
         if getattr(self, "_base_key", None) is None:
             self._base_key = jax.device_put(
                 jax.random.PRNGKey(self._seed), self.rules.named(_replicated(self.rules)))
-        if lr is None:
-            lr = self._opt_static_lr  # may stay None → apply() uses its own lr
-        self._step_count += 1
-        self.params, self.aux, self.opt_state, outs = self._step_fn(
+        return placed
+
+    def cost_analysis(self, data, label=None):
+        """XLA's cost analysis of the compiled training step — a dict with
+        ``flops`` and ``bytes accessed`` (the quantities docs/PERF.md's
+        roofline argument rests on). Lowers, does NOT execute the step.
+        Note: the AOT lower/compile here does not share jit's executable
+        cache, so this pays one extra compile — a perf-lab cost, not a
+        training-loop one."""
+        import jax.numpy as jnp
+
+        if not self.params and self.param_names:
+            raise MXNetError("call init_params first")
+        if self._step_fn is None:
+            self._step_fn = self._build_step()
+        placed = self._place_batch(data, label)
+        lr = self._opt_static_lr
+        lowered = self._step_fn.lower(
             self.params, self.aux, self.opt_state, placed, self._base_key,
             None if lr is None else jnp.asarray(lr, "float32"))
-        return outs
+        cost = lowered.compile().cost_analysis()
+        return cost[0] if isinstance(cost, (list, tuple)) else cost
 
     # ------------------------------------------------------------------ misc
     def get_params(self):

@@ -23,6 +23,8 @@ def main():
     ap.add_argument("--compute-dtype", default="bfloat16")
     ap.add_argument("--remat", default="false",
                     choices=["false", "true", "dots", "nothing"])
+    ap.add_argument("--cost", action="store_true",
+                    help="also print XLA cost analysis (flops, bytes)")
     ap.add_argument("--input-dtype", default="float32",
                     help="dtype the input batch is placed on device in")
     args = ap.parse_args()
@@ -86,6 +88,12 @@ def main():
            "net": args.net, "remat": args.remat,
            "input_dtype": args.input_dtype,
            "mfu": round(img_s * flops / peak, 4) if mfu_ok else None}
+    if args.cost:
+        cost = trainer.cost_analysis({"data": x}, {"softmax_label": y})
+        gb = cost.get("bytes accessed", 0.0) / 1e9
+        out["xla_gb_accessed"] = round(gb, 2)
+        out["xla_tflops"] = round(cost.get("flops", 0.0) / 1e12, 3)
+        out["hbm_gbps_achieved"] = round(gb / (dt / args.steps), 1)
     print(json.dumps(out))
 
 
