@@ -233,6 +233,10 @@ def _bench_allreduce():
             if l.startswith("{"):
                 res["device_mesh_gbps"] = json.loads(l)["busbw_gbps"]
                 res["device_mesh_fabric"] = mesh_fabric
+        if "device_mesh_gbps" not in res:
+            res["device_mesh_error"] = (
+                "no JSON from measure.py (rc=%d): %s"
+                % (out2.returncode, (out2.stderr or out2.stdout).strip()[-300:]))
     except Exception as exc:
         res["device_mesh_error"] = "%s: %s" % (type(exc).__name__, exc)
     return res

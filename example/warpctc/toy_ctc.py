@@ -1,0 +1,153 @@
+"""Toy sequence-labeling with CTC (counterpart of the reference's
+example/warpctc/toy_ctc.py, which trained an unrolled LSTM + WarpCTC on
+synthetic digit strips via the warp-ctc CUDA plugin).
+
+Here the task is the same shape but everything is TPU-native: a synthetic
+"strip" of T frames encodes a variable-length digit sequence (each digit
+holds a run of noisy one-hot frames separated by blank noise), a fused
+``RNN`` op (lax.scan LSTM) reads the strip, a per-frame FC scores the
+alphabet, and ``WarpCTC`` — the log-space alpha recursion in
+mxnet_tpu/ops/ctc.py — provides loss and gradient. Greedy best-path
+decoding (collapse repeats, drop blanks) reports sequence accuracy.
+
+Runs on CPU in under a minute:
+    MXNET_DEFAULT_CONTEXT=cpu python example/warpctc/toy_ctc.py
+"""
+import argparse
+import logging
+import os
+import sys
+
+import numpy as np
+
+sys.path.insert(0, os.path.abspath(os.path.join(os.path.dirname(__file__), "..", "..")))
+import mxnet_tpu as mx
+
+BLANK = 0
+
+
+def make_strips(n, T, max_len, alphabet, rs):
+    """Synthesize (data, label): data (n, T, alphabet) noisy one-hot frames,
+    label (n, max_len) digit ids padded with the blank (0)."""
+    data = rs.randn(n, T, alphabet).astype("float32") * 0.3
+    label = np.zeros((n, max_len), dtype="float32")
+    for i in range(n):
+        length = rs.randint(1, max_len + 1)
+        seq = []
+        while len(seq) < length:
+            d = rs.randint(1, alphabet)
+            if not seq or d != seq[-1]:  # no adjacent repeats → always feasible
+                seq.append(d)
+        label[i, :length] = seq
+        # each digit occupies a run of frames; runs are spaced so blanks remain
+        starts = np.sort(rs.choice(T - 2, size=length, replace=False))
+        for pos, d in zip(starts, seq):
+            run = rs.randint(1, 3)
+            data[i, pos:pos + run, d] += 3.0
+    return data, label
+
+
+def greedy_decode(scores, T, batch):
+    """Best path: argmax per frame → collapse repeats → strip blanks.
+    scores is the WarpCTC forward output, (T*batch, alphabet) time-major."""
+    path = scores.reshape(T, batch, -1).argmax(axis=2)  # (T, B)
+    out = []
+    for b in range(batch):
+        seq, prev = [], -1
+        for s in path[:, b]:
+            if s != prev and s != BLANK:
+                seq.append(int(s))
+            prev = int(s)
+        out.append(seq)
+    return out
+
+
+class SeqAccuracy(mx.metric.EvalMetric):
+    """Fraction of samples whose decoded sequence matches the label exactly."""
+
+    def __init__(self, T, batch):
+        super().__init__("seq_acc")
+        self.T, self.batch = T, batch
+
+    def update(self, labels, preds):
+        lab = labels[0].asnumpy()
+        decoded = greedy_decode(preds[0].asnumpy(), self.T, self.batch)
+        for b in range(lab.shape[0]):
+            truth = [int(v) for v in lab[b] if v != BLANK]
+            self.sum_metric += float(decoded[b] == truth)
+            self.num_inst += 1
+
+
+def build_symbol(T, max_len, alphabet, hidden):
+    from mxnet_tpu.initializer import Uniform
+    from mxnet_tpu.ops.rnn import rnn_param_size
+
+    data = mx.sym.Variable("data")                       # (B, T, alphabet)
+    label = mx.sym.Variable("ctc_label")                 # (B, max_len)
+    tm = mx.sym.SwapAxis(data=data, dim1=0, dim2=1)      # (T, B, F)
+    params = mx.sym.Variable(
+        "lstm_parameters",
+        shape=(rnn_param_size(1, alphabet, hidden, False, "lstm"),),
+        init=Uniform(0.1))
+    # iterator-fed states arrive batch-major (B, 1, H) — NDArrayIter slices
+    # axis 0 — and are swapped here to the RNN's (layers, B, H)
+    init_h = mx.sym.SwapAxis(data=mx.sym.Variable("init_h_in"), dim1=0, dim2=1)
+    init_c = mx.sym.SwapAxis(data=mx.sym.Variable("init_c_in"), dim1=0, dim2=1)
+    out = mx.sym.RNN(data=tm, parameters=params, state=init_h,
+                     state_cell=init_c, mode="lstm", state_size=hidden,
+                     num_layers=1, state_outputs=False, name="lstm")
+    out = mx.sym.Reshape(data=out, shape=(-1, hidden))   # (T*B, H) time-major
+    pred = mx.sym.FullyConnected(data=out, num_hidden=alphabet, name="pred")
+    return mx.sym.WarpCTC(data=pred, label=label, input_length=T,
+                          label_length=max_len, name="ctc")
+
+
+def main():
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--batch-size", type=int, default=32)
+    ap.add_argument("--frames", type=int, default=20)
+    ap.add_argument("--max-len", type=int, default=4)
+    ap.add_argument("--alphabet", type=int, default=11, help="incl. blank 0")
+    ap.add_argument("--hidden", type=int, default=64)
+    ap.add_argument("--num-epochs", type=int, default=10)
+    ap.add_argument("--lr", type=float, default=0.02)
+    ap.add_argument("--train-size", type=int, default=1600)
+    ap.add_argument("--val-size", type=int, default=320)
+    args = ap.parse_args()
+
+    logging.basicConfig(level=logging.INFO)
+    rs = np.random.RandomState(7)
+    Xtr, Ytr = make_strips(args.train_size, args.frames, args.max_len,
+                           args.alphabet, rs)
+    Xva, Yva = make_strips(args.val_size, args.frames, args.max_len,
+                           args.alphabet, rs)
+    zeros = lambda n: np.zeros((n, 1, args.hidden), "float32")
+    # init states ride the iterator as extra data (reference init_states
+    # pattern); NDArrayIter slices their batch axis, RNN wants (layers, B, H)
+    # so the symbol sees them via batch-major (B, layers, H) → SwapAxis
+    train = mx.io.NDArrayIter(
+        {"data": Xtr, "init_h_in": zeros(args.train_size),
+         "init_c_in": zeros(args.train_size)},
+        {"ctc_label": Ytr}, batch_size=args.batch_size, shuffle=True,
+        last_batch_handle="discard")
+    val = mx.io.NDArrayIter(
+        {"data": Xva, "init_h_in": zeros(args.val_size),
+         "init_c_in": zeros(args.val_size)},
+        {"ctc_label": Yva}, batch_size=args.batch_size,
+        last_batch_handle="discard")
+
+    sym = build_symbol(args.frames, args.max_len, args.alphabet, args.hidden)
+    mod = mx.mod.Module(sym, data_names=("data", "init_h_in", "init_c_in"),
+                        label_names=("ctc_label",))
+    mod.fit(train, eval_data=val,
+            eval_metric=SeqAccuracy(args.frames, args.batch_size),
+            optimizer="adam", optimizer_params={"learning_rate": args.lr},
+            initializer=mx.init.Xavier(), num_epoch=args.num_epochs,
+            batch_end_callback=mx.callback.Speedometer(args.batch_size, 10))
+
+    score = mod.score(val, SeqAccuracy(args.frames, args.batch_size))
+    print("final validation %s=%.3f" % score[0])
+
+
+if __name__ == "__main__":
+    main()

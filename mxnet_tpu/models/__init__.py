@@ -32,6 +32,7 @@ _ZOO = {
     "resnet-152": lambda **kw: resnet.get_symbol(num_layers=152, **kw),
     "lstm": lstm.get_symbol,
     "transformer": transformer.get_symbol,
+    "transformer_mt": transformer.get_symbol_mt,
     "vgg16-ssd-300": vgg16_ssd.get_symbol,
     "vgg16-ssd-300-train": vgg16_ssd.get_symbol_train,
 }

@@ -21,7 +21,9 @@ def _layer_norm(x, name, dim):
     return sym.broadcast_add(sym.broadcast_mul(normed, gamma), beta, name=name)
 
 
-def _attention_block(x, name, num_heads, model_dim, seq_len):
+def _attention_block(x, name, num_heads, model_dim, seq_len, causal=True):
+    """Self-attention with ONE fused 3·M-wide qkv GEMM (better MXU shape
+    than three M-wide projections; used for every q==kv site)."""
     dh = model_dim // num_heads
     qkv = sym.FullyConnected(data=x, num_hidden=3 * model_dim, flatten=False,
                              name="%s_qkv" % name)
@@ -36,12 +38,109 @@ def _attention_block(x, name, num_heads, model_dim, seq_len):
     q = sym.SwapAxis(q, dim1=1, dim2=2)
     k = sym.SwapAxis(k, dim1=1, dim2=2)
     v = sym.SwapAxis(v, dim1=1, dim2=2)
-    att = sym.MultiHeadAttention(query=q, key=k, value=v, causal=True,
+    att = sym.MultiHeadAttention(query=q, key=k, value=v, causal=causal,
                                  name="%s_att" % name)
     att = sym.SwapAxis(att, dim1=1, dim2=2)  # (B,T,H,D)
     att = sym.Reshape(att, shape=(-1, seq_len, model_dim))
     return sym.FullyConnected(data=att, num_hidden=model_dim, flatten=False,
                               name="%s_proj" % name)
+
+
+def _split_heads(x, seq_len, num_heads, dh):
+    """(B, T, M) → (B, H, T, dh) for the fused attention op."""
+    x = sym.Reshape(x, shape=(-1, seq_len, num_heads, dh))
+    return sym.SwapAxis(x, dim1=1, dim2=2)
+
+
+def _merge_heads(att, seq_len, model_dim):
+    att = sym.SwapAxis(att, dim1=1, dim2=2)
+    return sym.Reshape(att, shape=(-1, seq_len, model_dim))
+
+
+def _cross_attention(q_in, kv_in, name, num_heads, model_dim, q_len, kv_len):
+    """Attention with separate query/key-value sources (the MT decoder's
+    encoder-attention). Projections are necessarily split — the fused-qkv
+    GEMM of _attention_block only applies when q==kv, so self-attention
+    sites use that block instead."""
+    dh = model_dim // num_heads
+    q = sym.FullyConnected(data=q_in, num_hidden=model_dim, flatten=False,
+                           name="%s_q" % name)
+    k = sym.FullyConnected(data=kv_in, num_hidden=model_dim, flatten=False,
+                           name="%s_k" % name)
+    v = sym.FullyConnected(data=kv_in, num_hidden=model_dim, flatten=False,
+                           name="%s_v" % name)
+    att = sym.MultiHeadAttention(
+        query=_split_heads(q, q_len, num_heads, dh),
+        key=_split_heads(k, kv_len, num_heads, dh),
+        value=_split_heads(v, kv_len, num_heads, dh),
+        causal=False, name="%s_att" % name)
+    att = _merge_heads(att, q_len, model_dim)
+    return sym.FullyConnected(data=att, num_hidden=model_dim, flatten=False,
+                              name="%s_proj" % name)
+
+
+def _ffn(x, name, model_dim, ffn_dim):
+    h = sym.FullyConnected(data=x, num_hidden=ffn_dim, flatten=False,
+                           name="%s_ffn1" % name)
+    h = sym.Activation(h, act_type="relu")
+    return sym.FullyConnected(data=h, num_hidden=model_dim, flatten=False,
+                              name="%s_ffn2" % name)
+
+
+def _embed_with_pos(tokens, vocab_size, model_dim, seq_len, name):
+    embed = sym.Embedding(data=tokens, input_dim=vocab_size,
+                          output_dim=model_dim, name="%s_embed" % name)
+    pos = sym.Variable("%s_pos_weight" % name, shape=(seq_len, model_dim))
+    return sym.broadcast_add(
+        embed, sym.Reshape(pos, shape=(1, seq_len, model_dim)),
+        name="%s_pos_add" % name)
+
+
+def get_symbol_mt(vocab_size=32000, num_layers=6, num_heads=8, model_dim=512,
+                  ffn_dim=2048, src_len=64, tgt_len=64, **kwargs):
+    """Encoder-decoder Transformer-base for MT (BASELINE.md stretch config:
+    "Transformer-base MT"; the reference era predates Transformers — the
+    closest ancestor is its seq2seq RNN stack — so the architecture here is
+    the standard pre-norm Transformer built from this repo's Symbol ops and
+    the fused MultiHeadAttention, not a translation of reference code).
+
+    Inputs: ``data`` (B, src_len) source tokens, ``dec_data`` (B, tgt_len)
+    shifted-right target tokens, ``softmax_label`` (B, tgt_len). Fixed
+    lengths (pad to bucket shapes; BucketingModule handles the rest) —
+    padding attends as ordinary tokens, the toy/bucketed regime this model
+    targets."""
+    src = sym.Variable("data")
+    tgt = sym.Variable("dec_data")
+    label = sym.Variable("softmax_label")
+
+    # ---- encoder: pre-norm self-attention stack, non-causal
+    x = _embed_with_pos(src, vocab_size, model_dim, src_len, "enc")
+    for i in range(num_layers):
+        n = "enc%d" % i
+        ln = _layer_norm(x, "%s_ln1" % n, model_dim)
+        x = x + _attention_block(ln, n + "_self", num_heads, model_dim,
+                                 src_len, causal=False)
+        x = x + _ffn(_layer_norm(x, "%s_ln2" % n, model_dim), n,
+                     model_dim, ffn_dim)
+    memory = _layer_norm(x, "enc_final_ln", model_dim)
+
+    # ---- decoder: causal self-attention + cross-attention on the memory
+    y = _embed_with_pos(tgt, vocab_size, model_dim, tgt_len, "dec")
+    for i in range(num_layers):
+        n = "dec%d" % i
+        ln = _layer_norm(y, "%s_ln1" % n, model_dim)
+        y = y + _attention_block(ln, n + "_self", num_heads, model_dim,
+                                 tgt_len, causal=True)
+        y = y + _cross_attention(_layer_norm(y, "%s_ln2" % n, model_dim),
+                                 memory, n + "_cross", num_heads, model_dim,
+                                 tgt_len, src_len)
+        y = y + _ffn(_layer_norm(y, "%s_ln3" % n, model_dim), n,
+                     model_dim, ffn_dim)
+    y = _layer_norm(y, "dec_final_ln", model_dim)
+    y = sym.Reshape(y, shape=(-1, model_dim))
+    logits = sym.FullyConnected(data=y, num_hidden=vocab_size, name="mt_head")
+    label_flat = sym.Reshape(label, shape=(-1,))
+    return sym.SoftmaxOutput(data=logits, label=label_flat, name="softmax")
 
 
 def get_symbol(vocab_size=32000, num_layers=6, num_heads=8, model_dim=512,

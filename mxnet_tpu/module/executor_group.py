@@ -172,10 +172,15 @@ class DataParallelExecutorGroup:
     def _load_slices(self, arrays_per_name, batch_arrays):
         """Copy sliced batch rows into each device's bound array
         (reference: executor_group.py _load_data/_load_general)."""
-        if batch_arrays is None:
+        if batch_arrays is None or len(batch_arrays) == 0:
+            # label-less predict batch: nothing to load
             return
-        for name_idx, dev_arrays in enumerate(arrays_per_name):
-            src = batch_arrays[name_idx]
+        if len(batch_arrays) < len(arrays_per_name):
+            raise MXNetError(
+                "batch supplies %d arrays but %d are bound — an iterator is "
+                "under-feeding the module's inputs"
+                % (len(batch_arrays), len(arrays_per_name)))
+        for src, dev_arrays in zip(batch_arrays, arrays_per_name):
             src_np = None
             for dev_i, dst in enumerate(dev_arrays):
                 slc = self.slices[dev_i]
@@ -191,7 +196,7 @@ class DataParallelExecutorGroup:
 
     def load_data_label(self, data_batch):
         self._load_slices(self.data_arrays, data_batch.data)
-        if self.label_arrays and data_batch.label is not None:
+        if self.label_arrays and data_batch.label:
             self._load_slices(self.label_arrays, data_batch.label)
 
     def forward(self, data_batch, is_train=None):

@@ -200,6 +200,13 @@ class NAG(SGD):
 
 
 @register
+class ccSGD(SGD):
+    """Deprecated alias of SGD (reference: optimizer.py:445 — there it was a
+    C++-side fast path; here every optimizer already lowers into the compiled
+    step, so the distinction is void)."""
+
+
+@register
 class SGLD(Optimizer):
     """Stochastic Gradient Langevin Dynamics (reference: optimizer.py:416)."""
 

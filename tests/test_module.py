@@ -104,6 +104,18 @@ def test_module_predict():
     assert np.allclose(out.asnumpy().sum(axis=1), 1.0, atol=1e-5)
 
 
+def test_module_predict_unlabeled_after_fit():
+    """A module bound for training must predict from a LABEL-LESS iterator
+    (the batch carries an empty label list) — the decode-time idiom of
+    example/nmt/train_transformer_mt.py."""
+    x, y = _synthetic_classification(n=100)
+    mod = mx.mod.Module(mlp_symbol(), context=mx.cpu())
+    mod.fit(mx.io.NDArrayIter(x, y, batch_size=25), num_epoch=1)
+    out = mod.predict(mx.io.NDArrayIter(x, batch_size=25))
+    assert out.shape == (100, 5)
+    assert np.allclose(out.asnumpy().sum(axis=1), 1.0, atol=1e-5)
+
+
 def test_module_save_load_checkpoint(tmp_path):
     x, y = _synthetic_classification(n=100)
     prefix = str(tmp_path / "mlp")
