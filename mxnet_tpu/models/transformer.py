@@ -59,20 +59,23 @@ def _merge_heads(att, seq_len, model_dim):
 
 def _cross_attention(q_in, kv_in, name, num_heads, model_dim, q_len, kv_len):
     """Attention with separate query/key-value sources (the MT decoder's
-    encoder-attention). Projections are necessarily split — the fused-qkv
-    GEMM of _attention_block only applies when q==kv, so self-attention
-    sites use that block instead."""
+    encoder-attention). Only the q projection is separate; k and v share
+    one fused 2·M-wide GEMM on kv_in (same MXU-shape rationale as
+    _attention_block's fused qkv; self-attention sites use that block)."""
     dh = model_dim // num_heads
     q = sym.FullyConnected(data=q_in, num_hidden=model_dim, flatten=False,
                            name="%s_q" % name)
-    k = sym.FullyConnected(data=kv_in, num_hidden=model_dim, flatten=False,
-                           name="%s_k" % name)
-    v = sym.FullyConnected(data=kv_in, num_hidden=model_dim, flatten=False,
-                           name="%s_v" % name)
+    kv = sym.FullyConnected(data=kv_in, num_hidden=2 * model_dim,
+                            flatten=False, name="%s_kv" % name)
+    kv = sym.Reshape(kv, shape=(-1, kv_len, 2, num_heads, dh))
+    k = sym.Reshape(sym.slice_axis(kv, axis=2, begin=0, end=1),
+                    shape=(-1, kv_len, num_heads, dh))
+    v = sym.Reshape(sym.slice_axis(kv, axis=2, begin=1, end=2),
+                    shape=(-1, kv_len, num_heads, dh))
     att = sym.MultiHeadAttention(
         query=_split_heads(q, q_len, num_heads, dh),
-        key=_split_heads(k, kv_len, num_heads, dh),
-        value=_split_heads(v, kv_len, num_heads, dh),
+        key=sym.SwapAxis(k, dim1=1, dim2=2),
+        value=sym.SwapAxis(v, dim1=1, dim2=2),
         causal=False, name="%s_att" % name)
     att = _merge_heads(att, q_len, model_dim)
     return sym.FullyConnected(data=att, num_hidden=model_dim, flatten=False,

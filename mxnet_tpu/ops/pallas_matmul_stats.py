@@ -1,0 +1,111 @@
+"""Matmul with a BatchNorm-statistics epilogue, as a Pallas TPU kernel.
+
+docs/PERF.md's round-4 roofline analysis shows the ResNet-50 step pinned at
+the HBM roofline with ~25 ms/step spent in BN-statistics reductions that
+re-READ every conv output — XLA cannot fuse a reduce into a convolution
+producer. For 1x1 convolutions (36 of ResNet-50's 53 convs) the conv IS a
+matmul, and this kernel emits the per-column sums the statistics pass needs
+*while the output tile is still in VMEM*:
+
+    C = A @ B;   col_sum[n] = sum_m C[m, n];   col_sumsq[n] = sum_m C[m, n]^2
+
+one HBM write for C, zero extra reads for the statistics — removing one
+full activation read per fused layer versus the XLA lowering.
+
+Grid: (N/bn, M/bm), M innermost, so each kernel instance accumulates the
+column partials for its N-stripe across the M sweep in f32 VMEM scratch and
+flushes them on the final M step. The statistics come from the f32 MXU
+accumulator BEFORE the bf16 round of C — at least as accurate as reducing
+the stored bf16 activations.
+
+This is the measured prototype of PERF.md §4's "hand-fused conv+BN stack"
+— the only remaining lever toward >=0.35 MFU on the v5e. The general-conv
+variant (and the graph pass that rewrites Conv1x1+BatchNorm sites onto it)
+builds on this kernel.
+"""
+from __future__ import annotations
+
+import functools
+
+import jax
+import jax.numpy as jnp
+
+__all__ = ["matmul_with_stats", "supported"]
+
+
+def supported(m, k, n, block_m=512, block_n=256):
+    bm, bn = min(block_m, m), min(block_n, n)
+    return m % bm == 0 and n % bn == 0 and bm % 8 == 0 and bn % 128 == 0
+
+
+def _kernel(a_ref, b_ref, c_ref, sum_ref, sq_ref, acc_s, acc_q, *, m_tiles):
+    import jax.experimental.pallas as pl
+
+    mi = pl.program_id(1)
+
+    @pl.when(mi == 0)
+    def _init():
+        acc_s[...] = jnp.zeros_like(acc_s)
+        acc_q[...] = jnp.zeros_like(acc_q)
+
+    c32 = jnp.dot(a_ref[...], b_ref[...],
+                  preferred_element_type=jnp.float32)
+    c_ref[...] = c32.astype(c_ref.dtype)
+    acc_s[...] += jnp.sum(c32, axis=0, keepdims=True)
+    acc_q[...] += jnp.sum(c32 * c32, axis=0, keepdims=True)
+
+    @pl.when(mi == m_tiles - 1)
+    def _flush():
+        sum_ref[...] = acc_s[...]
+        sq_ref[...] = acc_q[...]
+
+
+@functools.partial(jax.jit, static_argnames=("block_m", "block_n",
+                                             "interpret"))
+def matmul_with_stats(a, b, block_m=512, block_n=256, interpret=False):
+    """``(C, col_sum, col_sumsq)`` for ``C = a @ b``.
+
+    a: (M, K), b: (K, N); C keeps ``a.dtype``, the statistics are f32 from
+    the MXU accumulator. K is kept whole per tile (1x1-conv K is at most a
+    few thousand channels — comfortably VMEM-resident).
+    """
+    import jax.experimental.pallas as pl
+
+    M, K = a.shape
+    K2, N = b.shape
+    assert K == K2, (a.shape, b.shape)
+    bm, bn = min(block_m, M), min(block_n, N)
+    assert supported(M, K, N, bm, bn), (a.shape, b.shape, bm, bn)
+    m_tiles, n_tiles = M // bm, N // bn
+
+    from jax.experimental.pallas import tpu as pltpu
+
+    scratch = [pltpu.VMEM((1, bn), jnp.float32),
+               pltpu.VMEM((1, bn), jnp.float32)]
+    # N-stripes are independent (parallel); the M sweep carries the
+    # statistics accumulator (arbitrary/sequential) and pipelines DMA
+    params = None if interpret else pltpu.CompilerParams(
+        dimension_semantics=(pltpu.GridDimensionSemantics.PARALLEL,
+                             pltpu.GridDimensionSemantics.ARBITRARY))
+    c, s, q = pl.pallas_call(
+        functools.partial(_kernel, m_tiles=m_tiles),
+        grid=(n_tiles, m_tiles),
+        in_specs=[
+            pl.BlockSpec((bm, K), lambda n, m: (m, 0)),
+            pl.BlockSpec((K, bn), lambda n, m: (0, n)),
+        ],
+        out_specs=[
+            pl.BlockSpec((bm, bn), lambda n, m: (m, n)),
+            pl.BlockSpec((1, bn), lambda n, m: (0, n)),
+            pl.BlockSpec((1, bn), lambda n, m: (0, n)),
+        ],
+        out_shape=[
+            jax.ShapeDtypeStruct((M, N), a.dtype),
+            jax.ShapeDtypeStruct((1, N), jnp.float32),
+            jax.ShapeDtypeStruct((1, N), jnp.float32),
+        ],
+        scratch_shapes=scratch,
+        compiler_params=params,
+        interpret=interpret,
+    )(a, b)
+    return c, s[0], q[0]

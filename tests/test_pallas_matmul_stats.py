@@ -1,0 +1,34 @@
+"""Pallas matmul+BN-stats kernel (ops/pallas_matmul_stats.py): interpret-mode
+correctness against numpy on CPU; the on-TPU timing story lives in
+tools/fused_stats_bench.py and docs/PERF.md."""
+import numpy as np
+import pytest
+
+from mxnet_tpu.ops.pallas_matmul_stats import matmul_with_stats, supported
+
+
+@pytest.mark.parametrize("M,K,N,bm,bn", [
+    (256, 64, 128, 64, 128),
+    (1024, 32, 256, 512, 256),   # multi-tile both axes
+    (512, 128, 128, 128, 128),
+])
+def test_matmul_with_stats_matches_numpy(M, K, N, bm, bn):
+    import jax.numpy as jnp
+
+    rs = np.random.RandomState(0)
+    a = rs.randn(M, K).astype("float32")
+    b = rs.randn(K, N).astype("float32")
+    c, s, q = matmul_with_stats(jnp.asarray(a), jnp.asarray(b),
+                                block_m=bm, block_n=bn, interpret=True)
+    ref = a @ b
+    np.testing.assert_allclose(np.asarray(c), ref, rtol=1e-4, atol=1e-4)
+    np.testing.assert_allclose(np.asarray(s), ref.sum(0), rtol=1e-4, atol=1e-3)
+    np.testing.assert_allclose(np.asarray(q), (ref * ref).sum(0),
+                               rtol=1e-4, atol=1e-3)
+    assert s.dtype == np.float32 and q.dtype == np.float32
+
+
+def test_supported_gates_tiling():
+    assert supported(1024, 64, 256)
+    assert not supported(1000, 64, 256)        # M not tileable
+    assert not supported(1024, 64, 200)        # N not lane-aligned

@@ -1,0 +1,99 @@
+"""Measure the Pallas matmul+BN-stats kernel against XLA's unfused lowering
+(matmul, then a separate statistics read-back pass) at ResNet-50 1x1-conv
+shapes, batch 256. The quantity under test is the one docs/PERF.md §4 says
+is the last MFU lever on the v5e: removing the statistics pass's re-read of
+the activation.
+
+Each timing amortizes ``--iters`` kernel executions inside one jitted scan
+(the axon tunnel adds ~2 ms per dispatch) and syncs by fetching a scalar.
+
+    python tools/fused_stats_bench.py
+"""
+import argparse
+import functools
+import json
+import os
+import sys
+import time
+
+import numpy as np
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+# (M, K, N) = (B*H*W, Cin, Cout) for b256 ResNet-50 bottleneck 1x1s
+SHAPES = [
+    (802816, 64, 256),    # stage1 expand, 56x56
+    (802816, 256, 64),    # stage1 reduce
+    (200704, 512, 128),   # stage2, 28x28
+    (50176, 1024, 256),   # stage3, 14x14
+    (12544, 2048, 512),   # stage4, 7x7
+]
+
+
+def main():
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--iters", type=int, default=20)
+    ap.add_argument("--block-m", type=int, default=512)
+    ap.add_argument("--block-n", type=int, default=256)
+    args = ap.parse_args()
+
+    import jax
+    import jax.numpy as jnp
+
+    from mxnet_tpu.ops.pallas_matmul_stats import matmul_with_stats, supported
+
+    def sync(x):
+        return np.asarray(jnp.sum(x.astype(jnp.float32)))
+
+    def timeit(fn, a, b):
+        @jax.jit
+        def many(a, b):
+            def body(carry, _):
+                c, s, q = fn(a, b)
+                # fold outputs into the carry so no iteration is dead code
+                return carry + s[:1] + q[:1] + c[:1, :1].astype(jnp.float32).reshape(1), None
+
+            out, _ = jax.lax.scan(body, jnp.zeros((1,), jnp.float32),
+                                  None, length=args.iters)
+            return out
+
+        sync(many(a, b))  # compile + warmup
+        t0 = time.perf_counter()
+        out = many(a, b)
+        sync(out)
+        return (time.perf_counter() - t0) / args.iters
+
+    def xla_path(a, b):
+        c = jnp.dot(a, b)                       # bf16 out, MXU
+        c32 = c.astype(jnp.float32)
+        return c, jnp.sum(c32, axis=0), jnp.sum(c32 * c32, axis=0)
+
+    def pallas_path(a, b):
+        c, s, q = matmul_with_stats(a, b, block_m=args.block_m,
+                                    block_n=args.block_n)
+        return c, s, q
+
+    rs = np.random.RandomState(0)
+    for M, K, N in SHAPES:
+        if not supported(M, K, N, args.block_m, args.block_n):
+            print(json.dumps({"shape": [M, K, N], "skipped": "tiling"}))
+            continue
+        a = jnp.asarray(rs.randn(M, K), jnp.bfloat16)
+        b = jnp.asarray(rs.randn(K, N), jnp.bfloat16)
+        t_xla = timeit(xla_path, a, b)
+        t_pal = timeit(pallas_path, a, b)
+        # correctness spot check (bf16 tolerances)
+        c0, s0, q0 = jax.jit(xla_path)(a, b)
+        c1, s1, q1 = jax.jit(pallas_path)(a, b)
+        s_err = float(jnp.max(jnp.abs(s0 - s1)) / (jnp.max(jnp.abs(s0)) + 1e-9))
+        print(json.dumps({
+            "shape": [M, K, N],
+            "xla_ms": round(t_xla * 1e3, 3),
+            "pallas_ms": round(t_pal * 1e3, 3),
+            "speedup": round(t_xla / t_pal, 3),
+            "stats_rel_err": round(s_err, 5),
+        }))
+
+
+if __name__ == "__main__":
+    main()
