@@ -21,23 +21,25 @@ def _layer_norm(x, name, dim):
     return sym.broadcast_add(sym.broadcast_mul(normed, gamma), beta, name=name)
 
 
+def _split_fused(fused, n_parts, seq_len, num_heads, dh):
+    """Split one fused (B, T, n_parts·M) projection into n_parts head-major
+    (B, H, T, dh) tensors — the single owner of the fused-weight layout."""
+    fused = sym.Reshape(fused, shape=(-1, seq_len, n_parts, num_heads, dh))
+    outs = []
+    for i in range(n_parts):
+        p = sym.Reshape(sym.slice_axis(fused, axis=2, begin=i, end=i + 1),
+                        shape=(-1, seq_len, num_heads, dh))
+        outs.append(sym.SwapAxis(p, dim1=1, dim2=2))  # (B,T,H,D)→(B,H,T,D)
+    return outs
+
+
 def _attention_block(x, name, num_heads, model_dim, seq_len, causal=True):
     """Self-attention with ONE fused 3·M-wide qkv GEMM (better MXU shape
     than three M-wide projections; used for every q==kv site)."""
     dh = model_dim // num_heads
     qkv = sym.FullyConnected(data=x, num_hidden=3 * model_dim, flatten=False,
                              name="%s_qkv" % name)
-    qkv = sym.Reshape(qkv, shape=(-1, seq_len, 3, num_heads, dh))
-    q = sym.Reshape(sym.slice_axis(qkv, axis=2, begin=0, end=1),
-                    shape=(-1, seq_len, num_heads, dh))
-    k = sym.Reshape(sym.slice_axis(qkv, axis=2, begin=1, end=2),
-                    shape=(-1, seq_len, num_heads, dh))
-    v = sym.Reshape(sym.slice_axis(qkv, axis=2, begin=2, end=3),
-                    shape=(-1, seq_len, num_heads, dh))
-    # (B,T,H,D) → (B,H,T,D)
-    q = sym.SwapAxis(q, dim1=1, dim2=2)
-    k = sym.SwapAxis(k, dim1=1, dim2=2)
-    v = sym.SwapAxis(v, dim1=1, dim2=2)
+    q, k, v = _split_fused(qkv, 3, seq_len, num_heads, dh)
     att = sym.MultiHeadAttention(query=q, key=k, value=v, causal=causal,
                                  name="%s_att" % name)
     att = sym.SwapAxis(att, dim1=1, dim2=2)  # (B,T,H,D)
@@ -67,15 +69,10 @@ def _cross_attention(q_in, kv_in, name, num_heads, model_dim, q_len, kv_len):
                            name="%s_q" % name)
     kv = sym.FullyConnected(data=kv_in, num_hidden=2 * model_dim,
                             flatten=False, name="%s_kv" % name)
-    kv = sym.Reshape(kv, shape=(-1, kv_len, 2, num_heads, dh))
-    k = sym.Reshape(sym.slice_axis(kv, axis=2, begin=0, end=1),
-                    shape=(-1, kv_len, num_heads, dh))
-    v = sym.Reshape(sym.slice_axis(kv, axis=2, begin=1, end=2),
-                    shape=(-1, kv_len, num_heads, dh))
+    k, v = _split_fused(kv, 2, kv_len, num_heads, dh)
     att = sym.MultiHeadAttention(
         query=_split_heads(q, q_len, num_heads, dh),
-        key=sym.SwapAxis(k, dim1=1, dim2=2),
-        value=sym.SwapAxis(v, dim1=1, dim2=2),
+        key=k, value=v,
         causal=False, name="%s_att" % name)
     att = _merge_heads(att, q_len, model_dim)
     return sym.FullyConnected(data=att, num_hidden=model_dim, flatten=False,

@@ -33,9 +33,14 @@ import jax.numpy as jnp
 __all__ = ["matmul_with_stats", "supported"]
 
 
-def supported(m, k, n, block_m=512, block_n=256):
+def supported(m, k, n, block_m=512, block_n=256, itemsize=2):
     bm, bn = min(block_m, m), min(block_n, n)
-    return m % bm == 0 and n % bn == 0 and bm % 8 == 0 and bn % 128 == 0
+    # K is kept whole per tile: the A (bm, K) + B (K, bn) + C (bm, bn) f32
+    # accumulator working set must fit VMEM (~16 MB, keep headroom for
+    # double-buffering)
+    vmem = (bm * k + k * bn) * itemsize + bm * bn * 4
+    return (m % bm == 0 and n % bn == 0 and bm % 8 == 0 and bn % 128 == 0
+            and vmem <= 12 * 1024 * 1024)
 
 
 def _kernel(a_ref, b_ref, c_ref, sum_ref, sq_ref, acc_s, acc_q, *, m_tiles):
