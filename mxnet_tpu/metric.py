@@ -242,6 +242,19 @@ class Perplexity(EvalMetric):
         # contributes nothing rather than poisoning the epoch with NaN
 
 
+
+def _align_regression(label, pred):
+    """Shape-align a (label, pred) pair for elementwise error metrics: lift a
+    rank-1 label to (B, 1) (reference layout) and reshape a same-size pred to
+    match — otherwise (B,1)-(B,) broadcasts into a (B,B) matrix and the
+    metric reports a constant ~sqrt(var(label)+var(pred))."""
+    if len(label.shape) == 1:
+        label = label.reshape(label.shape[0], 1)
+    if pred.shape != label.shape and pred.size == label.size:
+        pred = pred.reshape(label.shape)
+    return label, pred
+
+
 class MAE(EvalMetric):
     def __init__(self):
         super().__init__("mae")
@@ -251,8 +264,7 @@ class MAE(EvalMetric):
         for label, pred in zip(labels, preds):
             label = _asnumpy(label)
             pred = _asnumpy(pred)
-            if len(label.shape) == 1:
-                label = label.reshape(label.shape[0], 1)
+            label, pred = _align_regression(label, pred)
             self.sum_metric += numpy.abs(label - pred).mean()
             self.num_inst += 1
 
@@ -266,8 +278,7 @@ class MSE(EvalMetric):
         for label, pred in zip(labels, preds):
             label = _asnumpy(label)
             pred = _asnumpy(pred)
-            if len(label.shape) == 1:
-                label = label.reshape(label.shape[0], 1)
+            label, pred = _align_regression(label, pred)
             self.sum_metric += ((label - pred) ** 2.0).mean()
             self.num_inst += 1
 
@@ -281,8 +292,7 @@ class RMSE(EvalMetric):
         for label, pred in zip(labels, preds):
             label = _asnumpy(label)
             pred = _asnumpy(pred)
-            if len(label.shape) == 1:
-                label = label.reshape(label.shape[0], 1)
+            label, pred = _align_regression(label, pred)
             self.sum_metric += numpy.sqrt(((label - pred) ** 2.0).mean())
             self.num_inst += 1
 

@@ -80,7 +80,8 @@ def matmul_with_stats(a, b, block_m=512, block_n=256, interpret=False):
     K2, N = b.shape
     assert K == K2, (a.shape, b.shape)
     bm, bn = min(block_m, M), min(block_n, N)
-    assert supported(M, K, N, bm, bn), (a.shape, b.shape, bm, bn)
+    assert supported(M, K, N, bm, bn, itemsize=a.dtype.itemsize), (
+        a.shape, b.shape, a.dtype, bm, bn)
     m_tiles, n_tiles = M // bm, N // bn
 
     from jax.experimental.pallas import tpu as pltpu

@@ -248,6 +248,26 @@ def test_initializers():
     assert np.allclose(arr.asnumpy(), 0.0)
 
 
+def test_regression_metrics_rank1_pred():
+    """MAE/MSE/RMSE with rank-1 preds vs rank-1 labels (the
+    LinearRegressionOutput shape): must NOT broadcast (B,1)-(B,) into a
+    (B,B) matrix — regression for the bug that froze every regression
+    example's reported RMSE at ~sqrt(var(label)+var(pred))."""
+    rs = np.random.RandomState(0)
+    y = rs.randn(32).astype("float32")
+    p = rs.randn(32).astype("float32")
+    for cls, ref in ((mx.metric.MAE, np.abs(y - p).mean()),
+                     (mx.metric.MSE, ((y - p) ** 2).mean()),
+                     (mx.metric.RMSE, np.sqrt(((y - p) ** 2).mean()))):
+        m = cls()
+        m.update([mx.nd.array(y)], [mx.nd.array(p)])
+        assert abs(m.get()[1] - ref) < 1e-5, (cls.__name__, m.get()[1], ref)
+        # 2-D (B,1) preds (the reference layout) must agree exactly
+        m2 = cls()
+        m2.update([mx.nd.array(y)], [mx.nd.array(p.reshape(-1, 1))])
+        assert abs(m2.get()[1] - m.get()[1]) < 1e-7
+
+
 def test_metrics():
     acc = mx.metric.create("acc")
     acc.update([mx.nd.array([0, 1, 1])], [mx.nd.array([[0.9, 0.1], [0.2, 0.8], [0.7, 0.3]])])
