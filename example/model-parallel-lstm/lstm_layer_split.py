@@ -1,0 +1,110 @@
+"""Model-parallel LSTM: layers placed on different devices via ctx groups
+(counterpart of the reference's example/model-parallel-lstm, which pinned
+each LSTM layer to its own GPU). Each of the two stacked LSTM layers lives
+in its own ``ctx_group``; ``group2ctx`` maps the groups to devices and the
+executor inserts the boundary copies — on a TPU pod those are ICI
+transfers, here they run on the CPU mesh (``mx.cpu(0)``/``mx.cpu(1)``,
+the reference's own multi-device-without-GPUs test trick).
+
+A same-seed single-device run must produce identical losses — asserted at
+the end, making the example self-checking.
+
+    MXNET_DEFAULT_CONTEXT=cpu python example/model-parallel-lstm/lstm_layer_split.py
+"""
+import argparse
+import logging
+import os
+import sys
+
+import numpy as np
+
+sys.path.insert(0, os.path.abspath(os.path.join(os.path.dirname(__file__), "..", "..")))
+import mxnet_tpu as mx
+
+
+def build_symbol(seq_len, vocab, num_embed, num_hidden):
+    """Two LSTM layers, each in its own ctx group; heads in group 'dev2'."""
+    with mx.AttrScope(ctx_group="dev1"):
+        data = mx.sym.Variable("data")
+        embed = mx.sym.Embedding(data, input_dim=vocab, output_dim=num_embed,
+                                 name="embed")
+        c1 = mx.rnn.LSTMCell(num_hidden, prefix="l1_")
+        l1, _ = c1.unroll(seq_len, inputs=embed, layout="NTC",
+                          begin_state=c1.begin_state(batch_size=1),
+                          merge_outputs=True)
+    with mx.AttrScope(ctx_group="dev2"):
+        c2 = mx.rnn.LSTMCell(num_hidden, prefix="l2_")
+        l2, _ = c2.unroll(seq_len, inputs=l1, layout="NTC",
+                          begin_state=c2.begin_state(batch_size=1),
+                          merge_outputs=True)
+        pred = mx.sym.Reshape(l2, shape=(-1, num_hidden))
+        pred = mx.sym.FullyConnected(pred, num_hidden=vocab, name="pred")
+        label = mx.sym.Reshape(mx.sym.Variable("softmax_label"), shape=(-1,))
+        return mx.sym.SoftmaxOutput(pred, label=label, name="softmax")
+
+
+def train(net, x, y, group2ctx, epochs, lr, batch):
+    exe = net.simple_bind(mx.cpu(0), grad_req="write", group2ctx=group2ctx,
+                          data=(batch, x.shape[1]),
+                          softmax_label=(batch, x.shape[1]))
+    rs = np.random.RandomState(3)
+    for name, arr in sorted(exe.arg_dict.items()):
+        if name not in ("data", "softmax_label"):
+            arr[:] = rs.uniform(-0.1, 0.1, arr.shape).astype("float32")
+    losses = []
+    nb = x.shape[0] // batch
+    for ep in range(epochs):
+        tot = 0.0
+        for k in range(nb):
+            s = slice(k * batch, (k + 1) * batch)
+            exe.arg_dict["data"][:] = x[s]
+            exe.arg_dict["softmax_label"][:] = y[s]
+            out = exe.forward(is_train=True)[0].asnumpy()
+            flat = y[s].reshape(-1).astype(int)
+            tot += -np.log(out[np.arange(len(flat)), flat] + 1e-8).mean()
+            exe.backward()
+            for name, g in exe.grad_dict.items():
+                if g is not None and name not in ("data", "softmax_label"):
+                    exe.arg_dict[name][:] = exe.arg_dict[name] - lr * g
+        losses.append(tot / nb)
+        logging.info("%s epoch %d loss %.4f",
+                     "split" if len(group2ctx or {}) > 1 else "single",
+                     ep, losses[-1])
+    return losses
+
+
+def main():
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--batch-size", type=int, default=16)
+    ap.add_argument("--seq-len", type=int, default=8)
+    ap.add_argument("--vocab", type=int, default=30)
+    ap.add_argument("--num-embed", type=int, default=24)
+    ap.add_argument("--num-hidden", type=int, default=32)
+    ap.add_argument("--num-epochs", type=int, default=3)
+    ap.add_argument("--lr", type=float, default=0.05)
+    ap.add_argument("--train-size", type=int, default=256)
+    args = ap.parse_args()
+
+    logging.basicConfig(level=logging.INFO)
+    rs = np.random.RandomState(7)
+    x = rs.randint(1, args.vocab, (args.train_size, args.seq_len)).astype("float32")
+    y = np.roll(x, -1, axis=1)  # next-token task
+
+    net = build_symbol(args.seq_len, args.vocab, args.num_embed,
+                       args.num_hidden)
+    split = train(net, x, y, {"dev1": mx.cpu(0), "dev2": mx.cpu(1)},
+                  args.num_epochs, args.lr, args.batch_size)
+    single = train(net, x, y, None, args.num_epochs, args.lr,
+                   args.batch_size)
+    drift = max(abs(a - b) for a, b in zip(split, single))
+    print("max |split - single| loss drift: %.2e (same math, different "
+          "placement)" % drift)
+    # fp reduction order differs across placements and compounds over SGD
+    # steps; 1e-2 on a converging run separates reorder noise from real
+    # placement bugs (a wrong boundary copy shows up at epoch 0, at O(1))
+    assert abs(split[0] - single[0]) < 1e-4, "placement changed step-0 math!"
+    assert drift < 1e-2, "model-parallel placement diverged beyond fp noise"
+
+
+if __name__ == "__main__":
+    main()

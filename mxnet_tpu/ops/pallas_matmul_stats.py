@@ -72,7 +72,9 @@ def matmul_with_stats(a, b, block_m=512, block_n=256, interpret=False):
 
     a: (M, K), b: (K, N); C keeps ``a.dtype``, the statistics are f32 from
     the MXU accumulator. K is kept whole per tile (1x1-conv K is at most a
-    few thousand channels — comfortably VMEM-resident).
+    few thousand channels — comfortably VMEM-resident). Callers gating with
+    ``supported()`` must pass ``itemsize=a.dtype.itemsize`` (its default, 2,
+    assumes bf16) or the internal assert may still reject f32 shapes.
     """
     import jax.experimental.pallas as pl
 

@@ -73,7 +73,7 @@ def main():
         # fall back through smaller M-blocks so every shape that CAN tile
         # gets measured rather than silently skipped
         bm = next((c for c in (args.block_m, 256, 128, 64)
-                   if supported(M, K, N, c, args.block_n)), None)
+                   if supported(M, K, N, c, args.block_n, itemsize=2)), None)
         if bm is None:
             print(json.dumps({"shape": [M, K, N], "skipped": "tiling"}))
             continue
