@@ -124,3 +124,21 @@ def test_trainer_remat_policies_match_plain():
             np.testing.assert_allclose(
                 got[k], base[k], rtol=1e-5, atol=1e-6,
                 err_msg="remat=%r diverged on %s" % (mode, k))
+
+
+def test_cost_analysis_reports_flops_and_bytes():
+    """SPMDTrainer.cost_analysis (the quantity docs/PERF.md's roofline rests
+    on): lowers without executing, returns positive flops/bytes, and leaves
+    the trainer able to keep stepping."""
+    jax = _jax()
+    mesh = parallel.make_mesh({"data": 1}, devices=jax.devices()[:1])
+    net = models.get_symbol("mlp", num_classes=10)
+    tr = parallel.SPMDTrainer(net, mesh)
+    tr.init_params({"data": (8, 784)}, {"softmax_label": (8,)}, seed=0)
+    d = {"data": np.ones((8, 784), "float32")}
+    l = {"softmax_label": np.zeros((8,), "float32")}
+    tr.step(d, l)
+    cost = tr.cost_analysis(d, l)
+    assert cost.get("flops", 0) > 0
+    assert cost.get("bytes accessed", 0) > 0
+    tr.step(d, l)  # donation state must be unharmed by the AOT lower
