@@ -31,7 +31,7 @@ def make_manifold(n, dim, k, noise, rs):
     return x.astype("float32")
 
 
-def build_symbol(dim, hidden, bottleneck):
+def build_symbol(dim, bottleneck):
     """Linear encoder/decoder around the bottleneck: for data on a linear
     manifold a linear AE provably converges to the principal subspace, so
     the example is self-checking; swap in Activation layers to explore
@@ -48,7 +48,6 @@ def main():
     ap.add_argument("--batch-size", type=int, default=128)
     ap.add_argument("--dim", type=int, default=32)
     ap.add_argument("--bottleneck", type=int, default=4)
-    ap.add_argument("--hidden", type=int, default=64)  # used by nonlinear variants
     ap.add_argument("--noise", type=float, default=0.05)
     ap.add_argument("--num-epochs", type=int, default=15)
     ap.add_argument("--lr", type=float, default=0.03)
@@ -69,7 +68,7 @@ def main():
                             batch_size=args.batch_size,
                             last_batch_handle="discard")
 
-    net = build_symbol(args.dim, args.hidden, args.bottleneck)
+    net = build_symbol(args.dim, args.bottleneck)
     mod = mx.mod.Module(net, data_names=("data",),
                         label_names=("target_label",))
     mod.bind(data_shapes=train.provide_data, label_shapes=train.provide_label)
