@@ -48,15 +48,10 @@ def build_symbol():
 
 
 def accuracy(mod, x, y, batch):
-    correct = total = 0
-    for k in range(x.shape[0] // batch):
-        s = slice(k * batch, (k + 1) * batch)
-        mod.forward(mx.io.DataBatch(data=[mx.nd.array(x[s])], label=None),
-                    is_train=False)
-        pred = mod.get_outputs()[0].asnumpy().argmax(axis=1)
-        correct += (pred == y[s]).sum()
-        total += batch
-    return correct / total
+    # BaseModule.score handles batching, padding, and the metric — no
+    # hand-rolled loop (which would drop remainder samples)
+    it = mx.io.NDArrayIter(x, y, batch_size=batch)
+    return mod.score(it, mx.metric.Accuracy())[0][1]
 
 
 def main():

@@ -50,14 +50,23 @@ def score(network, batch_size, image_shape=(3, 224, 224), num_batches=10):
 if __name__ == "__main__":
     parser = argparse.ArgumentParser(description="benchmark inference throughput")
     parser.add_argument("--networks", type=str,
-                        default="alexnet,vgg16,inception-bn,resnet-50")
+                        default="alexnet,vgg16,inception-bn,inception-v3,resnet-50")
     parser.add_argument("--batch-sizes", type=str, default="1,32")
     parser.add_argument("--image-shape", type=str, default="3,224,224")
     args = parser.parse_args()
 
     image_shape = tuple(int(i) for i in args.image_shape.split(","))
+    # canonical input resolutions where they differ from 224 (reference
+    # benchmark_score.py special-cased inception-v3 the same way) — applied
+    # only when the user did not override --image-shape
+    canonical = {"inception-v3": (3, 299, 299)}
+    user_shape = args.image_shape != parser.get_default("image_shape")
     for net in args.networks.split(","):
-        logging.info("network: %s", net)
+        if not user_shape and net in canonical:
+            image_shape = canonical[net]
+        elif not user_shape:
+            image_shape = tuple(int(i) for i in args.image_shape.split(","))
+        logging.info("network: %s (input %s)", net, image_shape)
         for b in (int(x) for x in args.batch_sizes.split(",")):
             speed = score(net, b, image_shape)
             logging.info("batch size %2d, image/sec: %f", b, speed)

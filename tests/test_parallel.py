@@ -142,3 +142,27 @@ def test_cost_analysis_reports_flops_and_bytes():
     assert cost.get("flops", 0) > 0
     assert cost.get("bytes accessed", 0) > 0
     tr.step(d, l)  # donation state must be unharmed by the AOT lower
+
+
+def test_bf16_training_converges():
+    """End-to-end bf16-compute training reaches high accuracy (the
+    reference's tests/python/train/test_dtype.py asserted fp16 cifar
+    convergence; this is the TPU bf16 analogue on a separable toy set)."""
+    jax = _jax()
+    mesh = parallel.make_mesh({"data": 1}, devices=jax.devices()[:1])
+    net = models.get_symbol("mlp", num_classes=2)
+    tr = parallel.SPMDTrainer(
+        net, mesh, optimizer="adam", optimizer_params={"learning_rate": 1e-3},
+        compute_dtype="bfloat16")
+    tr.init_params({"data": (64, 784)}, {"softmax_label": (64,)}, seed=1)
+    rs = np.random.RandomState(0)
+    w = rs.randn(784).astype("float32")
+    x = rs.randn(512, 784).astype("float32")
+    y = (x @ w > 0).astype("float32")
+    for _ in range(30):
+        k = rs.randint(0, 8) * 64
+        tr.step({"data": x[k:k + 64]}, {"softmax_label": y[k:k + 64]})
+    outs = tr.step({"data": x[:64]}, {"softmax_label": y[:64]})
+    pred = np.asarray(outs[0]).argmax(axis=1)
+    acc = (pred == y[:64]).mean()
+    assert acc > 0.9, "bf16 training under-converged: acc=%.3f" % acc
