@@ -48,10 +48,15 @@ def build_symbol():
 
 
 def accuracy(mod, x, y, batch):
-    # BaseModule.score handles batching, padding, and the metric — no
-    # hand-rolled loop (which would drop remainder samples)
+    # BaseModule.score over an iterator — callers pass full-batch-sized
+    # arrays (see _trim) so no pad rows enter the metric
     it = mx.io.NDArrayIter(x, y, batch_size=batch)
     return mod.score(it, mx.metric.Accuracy())[0][1]
+
+
+def _trim(x, y, batch):
+    n = (x.shape[0] // batch) * batch
+    return x[:n], y[:n]
 
 
 def main():
@@ -88,6 +93,7 @@ def main():
             mod.update_metric(metric, batch.label)
         logging.info("epoch %d train-acc %.3f", ep, metric.get()[1])
 
+    vx, vy = _trim(vx, vy, args.batch_size)  # keep clean/adv sets identical
     clean_acc = accuracy(mod, vx, vy, args.batch_size)
 
     # FGSM: one forward/backward per batch with the TRUE labels, then step

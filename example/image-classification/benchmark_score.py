@@ -52,20 +52,20 @@ if __name__ == "__main__":
     parser.add_argument("--networks", type=str,
                         default="alexnet,vgg16,inception-bn,inception-v3,resnet-50")
     parser.add_argument("--batch-sizes", type=str, default="1,32")
-    parser.add_argument("--image-shape", type=str, default="3,224,224")
+    parser.add_argument("--image-shape", type=str, default=None,
+                        help="e.g. 3,224,224; default: per-net canonical "
+                             "shape (224, but 299 for inception-v3)")
     args = parser.parse_args()
 
-    image_shape = tuple(int(i) for i in args.image_shape.split(","))
-    # canonical input resolutions where they differ from 224 (reference
-    # benchmark_score.py special-cased inception-v3 the same way) — applied
-    # only when the user did not override --image-shape
+    base_shape = (tuple(int(i) for i in args.image_shape.split(","))
+                  if args.image_shape else (3, 224, 224))
+    # canonical resolutions where they differ from 224 (reference
+    # benchmark_score.py special-cased inception-v3 the same way); an
+    # explicit --image-shape wins for every net
     canonical = {"inception-v3": (3, 299, 299)}
-    user_shape = args.image_shape != parser.get_default("image_shape")
     for net in args.networks.split(","):
-        if not user_shape and net in canonical:
-            image_shape = canonical[net]
-        elif not user_shape:
-            image_shape = tuple(int(i) for i in args.image_shape.split(","))
+        image_shape = (base_shape if args.image_shape
+                       else canonical.get(net, base_shape))
         logging.info("network: %s (input %s)", net, image_shape)
         for b in (int(x) for x in args.batch_sizes.split(",")):
             speed = score(net, b, image_shape)

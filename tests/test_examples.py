@@ -1,0 +1,39 @@
+"""Example-script smoke tests (the reference ran its examples as the
+tests/python/train tier). Each runs a real example end-to-end in a
+subprocess at a deliberately tiny configuration — these catch API drift in
+the scripts (iterator contracts, metric names, symbol builders), not model
+quality; the quality numbers live in each example's default config."""
+import os
+import subprocess
+import sys
+
+import pytest
+
+ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+# (script, args) — configs sized to finish in tens of seconds on one core
+CASES = [
+    ("example/numpy-ops/custom_softmax.py",
+     ["--num-epochs", "2", "--batch-size", "64"]),
+    ("example/multi-task/multi_task.py",
+     ["--num-epochs", "1", "--train-size", "512"]),
+    ("example/autoencoder/manifold_ae.py",
+     ["--num-epochs", "2", "--train-size", "512"]),
+    ("example/recommenders/matrix_fact.py",
+     ["--num-epochs", "1", "--num-obs", "4000"]),
+    ("example/cnn_text_classification/text_cnn.py",
+     ["--num-epochs", "1", "--train-size", "512", "--val-size", "128"]),
+]
+
+
+@pytest.mark.slow
+@pytest.mark.parametrize("script,args", CASES,
+                         ids=[c[0].split("/")[1] for c in CASES])
+def test_example_runs(script, args):
+    env = dict(os.environ)
+    env.update({"JAX_PLATFORMS": "cpu", "MXNET_DEFAULT_CONTEXT": "cpu"})
+    out = subprocess.run(
+        [sys.executable, os.path.join(ROOT, script)] + args,
+        capture_output=True, text=True, timeout=600, env=env, cwd=ROOT)
+    assert out.returncode == 0, (
+        "%s failed:\n%s" % (script, (out.stderr or out.stdout)[-1500:]))
