@@ -124,3 +124,16 @@ class ProgressBar:
         percents = math.ceil(100.0 * count / float(self.total))
         prog_bar = "=" * filled_len + "-" * (self.bar_len - filled_len)
         logging.info("[%s] %s%s\r", prog_bar, percents, "%")
+
+
+class LogValidationMetricsCallback:
+    """Epoch-end eval callback: log every validation metric value
+    (reference: callback.py LogValidationMetricsCallback). Useful as
+    ``eval_end_callback`` when a Speedometer with ``auto_reset`` has
+    cleared the training metric mid-epoch."""
+
+    def __call__(self, param):
+        if not param.eval_metric:
+            return
+        for name, value in param.eval_metric.get_name_value():
+            logging.info("Epoch[%d] Validation-%s=%f", param.epoch, name, value)

@@ -99,3 +99,38 @@ def test_profiler_api(tmp_path):
     # per-op summary parses the trace (host events on the CPU backend)
     rows = profiler.summarize(device_only=False, top=10)
     assert rows and all({"name", "ms", "count", "process"} <= set(r) for r in rows)
+
+
+def test_libinfo_and_log():
+    """libinfo.find_lib_path lists the built native .so files; log.getLogger
+    yields a usable configured logger (reference: libinfo.py, log.py)."""
+    from mxnet_tpu import libinfo, log
+
+    libs = libinfo.find_lib_path()
+    assert libs, "no native libraries found — build/ missing or names drifted"
+    assert all(p.endswith(".so") for p in libs)
+    assert libinfo.__version__
+    lg = log.getLogger("mxtpu_test_logger", level=log.DEBUG)
+    try:
+        assert lg.isEnabledFor(log.DEBUG)
+        assert lg is log.getLogger("mxtpu_test_logger")  # idempotent
+        assert len(lg.handlers) == 1
+    finally:
+        lg.handlers.clear()  # don't leak handlers into other tests
+
+
+def test_log_validation_metrics_callback(caplog):
+    import collections
+    import logging
+
+    import numpy as np
+
+    import mxnet_tpu as mx
+
+    m = mx.metric.Accuracy()
+    m.update([mx.nd.array(np.array([1.0, 0.0]))],
+             [mx.nd.array(np.array([[0.1, 0.9], [0.8, 0.2]]))])
+    P = collections.namedtuple("P", ["epoch", "nbatch", "eval_metric", "locals"])
+    with caplog.at_level(logging.INFO):
+        mx.callback.LogValidationMetricsCallback()(P(3, 0, m, None))
+    assert any("Validation-accuracy" in r.message for r in caplog.records)
