@@ -23,6 +23,8 @@ CASES = [
      ["--num-epochs", "1", "--num-obs", "4000"]),
     ("example/cnn_text_classification/text_cnn.py",
      ["--num-epochs", "1", "--train-size", "512", "--val-size", "128"]),
+    ("example/nce-loss/nce_word2vec.py",
+     ["--num-epochs", "4", "--train-size", "2048"]),
 ]
 
 
