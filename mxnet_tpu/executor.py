@@ -178,6 +178,7 @@ class Executor:
         self.output_dict: Dict[str, NDArray] = {}
         self._last_rng = None
         self._monitor_callback = None
+        self._cached_vjp = None
 
     # ----------------------------------------------------------------- running
     def _collect(self):
@@ -220,38 +221,78 @@ class Executor:
 
     def forward(self, is_train=False, **kwargs):
         """Run forward; optional kwargs copy new values into bound args
-        (reference: executor.py forward)."""
+        (reference: executor.py forward).
+
+        With ``is_train=True`` the forward is run under ``jax.vjp`` and the
+        vjp closure (holding the forward-time residuals on device, like the
+        reference's retained activations) is cached so a later
+        ``backward()`` executes ONLY the backward computation — the manual
+        forward/backward idiom costs 1x fwd + 1x bwd, same as
+        ``forward_backward``'s single fused program."""
         for k, v in kwargs.items():
             if k not in self.arg_dict:
                 raise MXNetError("unknown argument %r" % k)
             self.arg_dict[k][:] = v
         args, aux = self._collect()
         rng = self._next_rng()
-        outs, new_aux = self._prog._fwd(bool(is_train))(args, aux, rng)
+        # release the previous step's residuals BEFORE tracing the new vjp —
+        # otherwise two full activation sets coexist on device
+        self._cached_vjp = None
+        if is_train and any(r != "null" for r in self._grad_req):
+            import jax
+
+            fn = self._prog._fwd(True)
+
+            def f(a):
+                return fn(a, aux, rng)
+
+            outs, vjp_fn, new_aux = jax.vjp(f, args, has_aux=True)
+            self._cached_vjp = (vjp_fn, tuple(o.dtype for o in outs))
+        else:
+            outs, new_aux = self._prog._fwd(bool(is_train))(args, aux, rng)
         if is_train:
             self._write_aux(new_aux)
         return self._set_outputs(outs)
 
     def backward(self, out_grads=None):
-        """Run backward, accumulating into grad arrays per grad_req. Reuses the
-        forward trace in one fused XLA computation (recompute-style — XLA CSEs
-        shared subexpressions; Module's hot path calls forward_backward which
-        runs this computation exactly once per step)."""
+        """Run backward, accumulating into grad arrays per grad_req.
+
+        After ``forward(is_train=True)`` this applies the cached vjp —
+        gradients come from the forward-time activations (reference
+        semantics) with no forward recompute. Without a cached vjp (e.g.
+        ``backward()`` cold) it falls back to the fused fwd+bwd program."""
+        if out_grads is not None:
+            if isinstance(out_grads, NDArray):
+                out_grads = [out_grads]
+            if len(out_grads) != len(self._prog.outputs):
+                raise MXNetError(
+                    "backward: expected %d head gradients, got %d"
+                    % (len(self._prog.outputs), len(out_grads))
+                )
+        cached = getattr(self, "_cached_vjp", None)
+        if cached is not None:
+            import jax.numpy as jnp
+
+            vjp_fn, out_dtypes = cached
+            if out_grads is None:
+                # loss-style outputs: custom-vjp loss ops ignore the incoming
+                # cotangent, so ones is the identity head gradient
+                cot = tuple(jnp.ones(o.shape, dt)
+                            for o, dt in zip(self.outputs, out_dtypes))
+            else:
+                cot = tuple(g._jax().astype(dt)
+                            for g, dt in zip(out_grads, out_dtypes))
+            (grads,) = vjp_fn(cot)
+            self._cached_vjp = None  # residuals consumed — free the activations
+            self._apply_grads(grads)
+            return
         args, aux = self._collect()
         rng = self._last_rng if self._last_rng is not None else self._next_rng()
         if out_grads is None:
-            head: tuple = ()
             fn = self._prog._fwd_bwd_cached(False)
             outs, grads, _ = fn(args, aux, (), rng)
         else:
-            if isinstance(out_grads, NDArray):
-                out_grads = [out_grads]
             head = tuple(g._jax() for g in out_grads)
-            if len(head) != len(self._prog.outputs):
-                raise MXNetError(
-                    "backward: expected %d head gradients, got %d"
-                    % (len(self._prog.outputs), len(head))
-                )
             fn = self._prog._fwd_bwd_cached(True)
             outs, grads, _ = fn(args, aux, head, rng)
         self._apply_grads(grads)
@@ -262,6 +303,7 @@ class Executor:
         (graph_executor.cc:690 InitOpSegs)."""
         args, aux = self._collect()
         rng = self._next_rng()
+        self._cached_vjp = None  # this step supersedes any cached forward
         if out_grads is None:
             fn = self._prog._fwd_bwd_cached(False)
             outs, grads, new_aux = fn(args, aux, (), rng)

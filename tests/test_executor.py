@@ -213,3 +213,49 @@ def test_rnn_symbol_bind():
     outs = ex.forward(is_train=True)
     assert outs[0].shape == (7, 2, 6)
     assert outs[1].shape == (1, 2, 6)
+
+
+def test_backward_does_not_recompute_forward():
+    """forward(is_train=True) + backward() must run the forward host-visible
+    computation exactly once (the cached-vjp path; previously backward
+    re-ran the fused fwd+bwd, silently doubling forward cost). Observed via
+    a CustomOp whose forward increments a host counter."""
+    from mxnet_tpu import operator as op
+
+    counters = {"fwd": 0}
+
+    @op.register("count_fwd_sigmoid")
+    class CountProp(op.CustomOpProp):
+        def __init__(self):
+            super().__init__(need_top_grad=True)
+
+        def create_operator(self, ctx, in_shapes, in_dtypes):
+            class CountOp(op.CustomOp):
+                def forward(self, is_train, req, in_data, out_data, aux):
+                    counters["fwd"] += 1
+                    x = in_data[0].asnumpy()
+                    self.assign(out_data[0], req[0], 1.0 / (1.0 + np.exp(-x)))
+
+                def backward(self, req, out_grad, in_data, out_data, in_grad, aux):
+                    y = out_data[0].asnumpy()
+                    self.assign(in_grad[0], req[0],
+                                out_grad[0].asnumpy() * y * (1.0 - y))
+
+            return CountOp()
+
+    data = mx.sym.Variable("data")
+    net = mx.sym.Custom(data=mx.sym.FullyConnected(data, num_hidden=4,
+                                                   name="fc"),
+                        op_type="count_fwd_sigmoid", name="sig")
+    exe = net.simple_bind(ctx=mx.cpu(), data=(2, 3))
+    rs = np.random.RandomState(0)
+    for name, arr in exe.arg_dict.items():
+        arr[:] = rs.rand(*arr.shape).astype("float32")
+    counters["fwd"] = 0
+    exe.forward(is_train=True)
+    assert counters["fwd"] == 1
+    exe.backward(out_grads=[mx.nd.ones((2, 4))])
+    assert counters["fwd"] == 1, (
+        "backward re-ran the forward %d extra time(s)" % (counters["fwd"] - 1))
+    g = exe.grad_dict["fc_weight"].asnumpy()
+    assert np.isfinite(g).all() and np.abs(g).sum() > 0
