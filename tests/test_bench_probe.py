@@ -1,0 +1,91 @@
+"""The bench.py backend probe must survive a flapping tunnel.
+
+Round 4's scoreboard was forfeited because the probe returned False on the
+first attempt timeout (old bench.py:53-55). The round-5 policy retries in
+fresh subprocesses with backoff across a window; these tests simulate
+fail -> fail -> succeed (a tunnel that heals) and a window that exhausts.
+"""
+import json
+import os
+import sys
+
+import pytest
+
+ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, ROOT)
+
+import bench  # noqa: E402
+
+
+def _flaky_probe_code(counter_path, fail_times):
+    """Probe snippet that fails its first ``fail_times`` invocations (each in
+    a fresh subprocess, so state lives in a file) then succeeds."""
+    return (
+        "import os, sys\n"
+        "p = %r\n"
+        "n = int(open(p).read()) if os.path.exists(p) else 0\n"
+        "open(p, 'w').write(str(n + 1))\n"
+        "if n < %d:\n"
+        "    sys.stderr.write('simulated tunnel flap %%d' %% n)\n"
+        "    sys.exit(1)\n"
+        "print('tpu')\n" % (counter_path, fail_times)
+    )
+
+
+def test_probe_recovers_from_flapping_tunnel(tmp_path, monkeypatch):
+    counter = str(tmp_path / "attempts")
+    monkeypatch.setenv("MXTPU_BENCH_PROBE_CODE",
+                       _flaky_probe_code(counter, fail_times=2))
+    monkeypatch.setenv("MXTPU_BENCH_PROBE_WINDOW", "600")
+    monkeypatch.setenv("MXTPU_BENCH_PROBE_TIMEOUT", "30")
+    # fail -> fail -> succeed: the probe must keep retrying and return True
+    monkeypatch.setattr(bench.time, "sleep", lambda s: None)  # skip backoff
+    assert bench._probe_backend() is True
+    assert int(open(counter).read()) == 3
+
+
+def test_probe_gives_up_when_window_exhausted(tmp_path, monkeypatch):
+    counter = str(tmp_path / "attempts")
+    monkeypatch.setenv("MXTPU_BENCH_PROBE_CODE",
+                       _flaky_probe_code(counter, fail_times=10 ** 6))
+    monkeypatch.setenv("MXTPU_BENCH_PROBE_WINDOW", "0.1")
+    monkeypatch.setenv("MXTPU_BENCH_PROBE_TIMEOUT", "30")
+    assert bench._probe_backend() is False
+    # window ~0 still grants at least the first attempt
+    assert int(open(counter).read()) >= 1
+
+
+def test_probe_retries_after_timeout(tmp_path, monkeypatch):
+    """A timed-out attempt must NOT end the probe (the round-4 bug): the next
+    attempt runs in a fresh subprocess and can succeed."""
+    counter = str(tmp_path / "attempts")
+    code = (
+        "import os, time\n"
+        "p = %r\n"
+        "n = int(open(p).read()) if os.path.exists(p) else 0\n"
+        "open(p, 'w').write(str(n + 1))\n"
+        "if n < 1:\n"
+        "    time.sleep(60)\n"  # simulated hang; killed by per-attempt timeout
+        "print('tpu')\n" % counter
+    )
+    monkeypatch.setenv("MXTPU_BENCH_PROBE_CODE", code)
+    monkeypatch.setenv("MXTPU_BENCH_PROBE_WINDOW", "600")
+    # per-attempt timeout must cover interpreter startup (sitecustomize
+    # imports jax) but be well under the simulated 60s hang
+    monkeypatch.setenv("MXTPU_BENCH_PROBE_TIMEOUT", "20")
+    monkeypatch.setattr(bench.time, "sleep", lambda s: None)
+    assert bench._probe_backend() is True
+    assert int(open(counter).read()) >= 2
+
+
+def test_onchip_artifact_pointer():
+    """Degraded output must point at the committed on-chip measurement."""
+    art = bench._onchip_artifact()
+    assert art is not None
+    assert art["file"].startswith("PERF_MEASURED_r")
+    assert art["img_s"] and art["img_s"] > 1000  # a real TPU number, not CPU
+    path = os.path.join(ROOT, art["file"])
+    with open(path) as f:
+        rec = json.load(f)
+    assert any(abs(r["img_s"] - art["img_s"]) < 1e-6
+               for r in rec["resnet50_train"])
