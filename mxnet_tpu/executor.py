@@ -21,6 +21,7 @@ Semantics kept from the reference:
 from __future__ import annotations
 
 
+import os
 from typing import Dict, List, Optional
 
 import numpy as np
@@ -37,10 +38,19 @@ class _GraphProgram:
     """The traced interpretation of a Symbol: pure functions over arg/aux
     tuples, compiled lazily per (is_train, shapes) by jax.jit."""
 
-    def __init__(self, symbol, group2ctx=None):
+    def __init__(self, symbol, group2ctx=None, fusion=True):
         self.symbol = symbol
         self.topo = symbol._topo()
         self.group2ctx = dict(group2ctx or {})
+        # conv+BN fusion plan (fusion.py): structural rewrite map onto the
+        # Pallas kernel stack; disabled under ctx-group placement (the fused
+        # subgraph would straddle a device boundary) and by env kill-switch
+        self._fusion_plan = {}
+        if fusion and not self.group2ctx and \
+                os.environ.get("MXNET_FUSED_CONV_BN", "auto") != "0":
+            from . import fusion as _fusion
+
+            self._fusion_plan = _fusion.plan(self.topo)
         # PlaceDevice-pass analogue (reference: graph_executor.cc:242
         # AssignContext → nnvm PlaceDevice inserting _CrossDeviceCopy): map
         # each node carrying a __ctx_group__ attr to its concrete device;
@@ -78,6 +88,10 @@ class _GraphProgram:
         """Run the graph on jax values. Returns (outputs, new_aux_tuple)."""
         import jax
 
+        fusion_on = bool(self._fusion_plan) and is_train
+        if fusion_on:
+            from . import fusion as _fusion
+
         vals = {}
         new_aux = list(aux_vals)
         for node in self.topo:
@@ -91,20 +105,32 @@ class _GraphProgram:
             parsed = node.parsed_attrs()
             n_aux = len(opdef.aux_names(parsed))
             ins = [vals[(id(inp), oi)] for inp, oi in node.inputs]
-            dev = self._node_devices.get(id(node))
-            if dev is not None:
-                # cross-device copy at a ctx-group boundary
-                ins = [jax.device_put(x, dev) for x in ins]
-            node_rng = None
-            if opdef.needs_rng:
-                node_rng = jax.random.fold_in(rng, self._rng_ids[id(node)])
-            outs, aux_out = opdef.apply(
-                parsed,
-                ins[: len(ins) - n_aux] if n_aux else ins,
-                aux=ins[len(ins) - n_aux :] if n_aux else [],
-                is_train=is_train,
-                rng=node_rng,
-            )
+            directive = self._fusion_plan.get(id(node)) if fusion_on else None
+            if directive is not None:
+                outs, aux_out = _fusion.execute(
+                    directive, node,
+                    ins[: len(ins) - n_aux] if n_aux else ins,
+                    ins[len(ins) - n_aux :] if n_aux else [],
+                    is_train)
+                if not isinstance(outs, tuple):
+                    outs = (outs,)
+            else:
+                if fusion_on:
+                    ins = [_fusion.resolve(x) for x in ins]
+                dev = self._node_devices.get(id(node))
+                if dev is not None:
+                    # cross-device copy at a ctx-group boundary
+                    ins = [jax.device_put(x, dev) for x in ins]
+                node_rng = None
+                if opdef.needs_rng:
+                    node_rng = jax.random.fold_in(rng, self._rng_ids[id(node)])
+                outs, aux_out = opdef.apply(
+                    parsed,
+                    ins[: len(ins) - n_aux] if n_aux else ins,
+                    aux=ins[len(ins) - n_aux :] if n_aux else [],
+                    is_train=is_train,
+                    rng=node_rng,
+                )
             for i, o in enumerate(outs):
                 vals[(id(node), i)] = o
             if n_aux:
@@ -115,6 +141,8 @@ class _GraphProgram:
                         )
                     new_aux[self._aux_index[inp.name]] = new
         outputs = tuple(vals[(id(n), i)] for n, i in self.outputs)
+        if fusion_on:
+            outputs = tuple(_fusion.resolve(o) for o in outputs)
         return outputs, tuple(new_aux)
 
     # --------------------------------------------------------------- compiled

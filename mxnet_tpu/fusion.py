@@ -1,0 +1,355 @@
+"""Conv+BatchNorm fusion plan for the graph executor (round-5 perf work).
+
+The reference reached vendor-kernel conv+BN throughput via cuDNN
+(/root/reference/src/operator/cudnn_convolution-inl.h with the CUDNN BN /
+fused-add epilogues of batch_norm.cu); the TPU translation is a graph pass
+that rewrites eligible subgraphs onto the Pallas kernel in
+``ops/pallas_conv_bn.py``. Three rewrites compose along the pre-activation
+ResNet chain (BN -> relu -> Conv -> [+res] -> BN ...; models/resnet.py):
+
+- **prologue fold**: a BatchNorm whose (relu) output feeds only eligible
+  convolutions never materializes — its per-channel ``scale``/``shift`` ride
+  into each consumer kernel's VMEM prologue (saves one activation write +
+  one read per edge).
+- **stats reuse**: a BatchNorm whose input carries kernel-emitted
+  ``(sum, sum_sq)`` skips its statistics pass entirely (saves one activation
+  read) whether or not it folds.
+- **residual defer**: a convolution whose only consumer is an elementwise
+  add runs *at the add site* with the other operand streamed into its
+  epilogue (saves the separate read-read-write add pass), and the sum's
+  statistics feed the next block's BatchNorm.
+
+The plan is structural (built once per program from the Symbol DAG); the
+per-shape engage/fallback decision is made at trace time against the
+committed on-chip WINS table (``ops/fused_conv_bn_table.py``), overridable
+with ``MXNET_FUSED_CONV_BN=0|1|auto``. Every fallback path degrades to the
+ordinary XLA lowering, including mid-chain (a Deferred input materializes
+its normalized activation once, cached, shared by all fallback consumers).
+
+Autodiff: only the Pallas kernel is a custom_vjp; the per-channel BN math
+here (mean/var from sums, scale/shift, moving-stat updates) is plain traced
+JAX, so gradients for gamma/beta flow through ``scale32``/``shift32`` into
+the kernel's hand-written f32-accumulated prologue cotangents.
+"""
+from __future__ import annotations
+
+import os
+
+import jax
+import jax.numpy as jnp
+
+from .ops.pallas_conv_bn import conv_block, supported
+
+__all__ = ["plan", "execute", "resolve", "gate"]
+
+
+# --------------------------------------------------------------------- values
+class Deferred:
+    """A folded BN(+relu) output: ``relu(raw * scale + shift)``, not yet
+    materialized. ``materialize()`` builds (and caches) the XLA elementwise
+    form for consumers that fall back."""
+
+    __slots__ = ("raw", "scale", "shift", "relu", "_mat")
+
+    def __init__(self, raw, scale, shift, relu=False):
+        self.raw, self.scale, self.shift, self.relu = raw, scale, shift, relu
+        self._mat = None
+
+    def with_relu(self):
+        return Deferred(self.raw, self.scale, self.shift, relu=True)
+
+    def materialize(self):
+        if self._mat is None:
+            out = _normalize(self.raw, self.scale, self.shift)
+            if self.relu:
+                out = jnp.maximum(out, 0)
+            self._mat = out
+        return self._mat
+
+
+class WithStats:
+    """A conv/add output plus the kernel's per-channel f32 (sum, sum_sq)."""
+
+    __slots__ = ("c", "ssum", "ssq")
+
+    def __init__(self, c, ssum, ssq):
+        self.c, self.ssum, self.ssq = c, ssum, ssq
+
+
+class PendingConv:
+    """A conv deferred to its consuming residual add."""
+
+    __slots__ = ("x", "w", "scale", "shift", "relu", "kernel", "stride")
+
+    def __init__(self, x, w, scale, shift, relu, kernel, stride):
+        self.x, self.w = x, w
+        self.scale, self.shift, self.relu = scale, shift, relu
+        self.kernel, self.stride = kernel, stride
+
+    def run(self, res):
+        return conv_block(self.x, self.w, self.scale, self.shift, res,
+                          self.kernel, self.stride, self.relu)
+
+
+def resolve(v):
+    """Any op that is not fusion-aware sees a plain tensor."""
+    if isinstance(v, WithStats):
+        return v.c
+    if isinstance(v, Deferred):
+        return v.materialize()
+    return v
+
+
+# ------------------------------------------------------- normalize (custom_vjp)
+@jax.custom_vjp
+def _normalize(x, scale32, shift32):
+    b = (1, -1) + (1,) * (x.ndim - 2)
+    return x * scale32.astype(x.dtype).reshape(b) \
+        + shift32.astype(x.dtype).reshape(b)
+
+
+def _normalize_fwd(x, scale32, shift32):
+    return _normalize(x, scale32, shift32), (x, scale32)
+
+
+def _normalize_bwd(saved, dout):
+    # explicit f32 accumulators for the per-channel reductions (plain
+    # autodiff would reduce in the activation dtype — bf16 over B*H*W)
+    x, scale32 = saved
+    b = (1, -1) + (1,) * (x.ndim - 2)
+    axes = (0,) + tuple(range(2, x.ndim))
+    dx = dout * scale32.astype(dout.dtype).reshape(b)
+    dout32 = dout.astype(jnp.float32)
+    dscale = jnp.sum(dout32 * x.astype(jnp.float32), axis=axes)
+    dshift = jnp.sum(dout32, axis=axes)
+    return dx, dscale, dshift
+
+
+_normalize.defvjp(_normalize_fwd, _normalize_bwd)
+
+
+# ----------------------------------------------------------------------- plan
+def _pair(v, fill):
+    v = tuple(v or ())
+    return v if len(v) == 2 else (fill, fill)
+
+
+def _conv_cfg(node):
+    """(kernel, stride) if this Convolution can run on the Pallas path
+    (structurally — shape gating happens at trace time), else None."""
+    if node.op != "Convolution" or len(node.inputs) != 2:  # bias present -> no
+        return None
+    a = node.parsed_attrs()
+    kernel = tuple(a.get("kernel") or ())
+    stride = _pair(a.get("stride"), 1)
+    pad = _pair(a.get("pad"), 0)
+    dilate = _pair(a.get("dilate"), 1)
+    if a.get("num_group", 1) != 1 or dilate != (1, 1):
+        return None
+    if kernel == (1, 1) and pad == (0, 0) and stride in ((1, 1), (2, 2)):
+        return kernel, stride
+    if kernel == (3, 3) and pad == (1, 1) and stride == (1, 1):
+        return kernel, stride
+    return None
+
+
+def _bn_ok(node):
+    if node.op != "BatchNorm":
+        return False
+    a = node.parsed_attrs()
+    return not a.get("use_global_stats") and not a.get("output_mean_var")
+
+
+def plan(topo):
+    """Build the fusion plan: id(node) -> directive dict. Structural only."""
+    consumers = {}
+    for node in topo:
+        for inp, oi in node.inputs:
+            consumers.setdefault(id(inp), []).append((node, oi))
+    order = {id(n): i for i, n in enumerate(topo)}
+
+    directives = {}
+    conv_nodes = {}
+    for node in topo:
+        if node.is_variable:
+            continue
+        cfg = _conv_cfg(node)
+        if cfg is not None:
+            directives[id(node)] = {"kind": "conv", "kernel": cfg[0],
+                                    "stride": cfg[1], "defer": False}
+            conv_nodes[id(node)] = node
+        elif _bn_ok(node):
+            directives[id(node)] = {"kind": "bn", "fold": False}
+
+    def _is_fusable_conv_data_edge(cons_node, producer):
+        d = directives.get(id(cons_node))
+        return (d is not None and d["kind"] == "conv"
+                and cons_node.inputs[0][0] is producer)
+
+    # prologue folds: BN (-> relu) whose every consumer is a fusable conv's
+    # data input
+    for node in topo:
+        d = directives.get(id(node))
+        if not d or d["kind"] != "bn":
+            continue
+        cons = consumers.get(id(node), [])
+        if not cons:
+            continue
+        relu_node = None
+        targets = [c for c, oi in cons if oi == 0]
+        if len(cons) == 1 and len(targets) == 1:
+            c0 = targets[0]
+            if (c0.op == "Activation"
+                    and c0.parsed_attrs().get("act_type") == "relu"):
+                relu_node = c0
+                targets = [c for c, oi in consumers.get(id(c0), []) if oi == 0]
+                if len(targets) != len(consumers.get(id(c0), [])):
+                    continue
+        src = relu_node if relu_node is not None else node
+        if targets and all(_is_fusable_conv_data_edge(c, src)
+                           for c in targets):
+            d["fold"] = True
+            if relu_node is not None:
+                directives[id(relu_node)] = {"kind": "relu_fold"}
+
+    # residual defers: elemwise_add with an operand whose only consumer is
+    # the add and whose producer is a fusable conv
+    for node in topo:
+        if node.op != "elemwise_add" or len(node.inputs) != 2:
+            continue
+        best = None
+        for slot, (inp, oi) in enumerate(node.inputs):
+            if oi != 0 or id(inp) not in conv_nodes:
+                continue
+            if len(consumers.get(id(inp), [])) != 1:
+                continue
+            if best is None or order[id(inp)] > order[id(best[1])]:
+                best = (slot, inp)
+        if best is not None:
+            slot, conv = best
+            directives[id(conv)]["defer"] = True
+            directives[id(node)] = {"kind": "resadd", "pending_slot": slot}
+    return directives
+
+
+# ----------------------------------------------------------------------- gate
+def _table_device_matches():
+    """The WINS table is an on-chip measurement: it only applies on the
+    device generation it was taken on (interpret-mode Pallas on CPU would be
+    orders of magnitude slower than the XLA path the table says it beats)."""
+    from .ops.fused_conv_bn_table import DEVICE
+
+    if DEVICE is None:
+        return False
+    import jax
+
+    try:
+        return jax.devices()[0].device_kind == DEVICE
+    except Exception:
+        return False
+
+
+def gate(kernel, stride, x_shape, w_shape, dtype, prologue):
+    """Per-shape engage decision: env override, else the committed on-chip
+    WINS table (device-matched), else off. Untileable calls never engage."""
+    env = os.environ.get("MXNET_FUSED_CONV_BN", "auto")
+    if env == "0" or not supported(x_shape, w_shape, stride,
+                                   itemsize=jnp.dtype(dtype).itemsize,
+                                   prologue=prologue):
+        return False
+    if env == "1":
+        return True
+    if not _table_device_matches():
+        return False
+    from .ops.fused_conv_bn_table import WINS
+
+    K = x_shape[1]
+    N = w_shape[0]
+    hw = (x_shape[2] // stride[0]) * (x_shape[3] // stride[1])
+    return WINS.get((kernel[0], K, N, hw, stride[0]), False)
+
+
+# -------------------------------------------------------------------- execute
+def execute(directive, node, ins, aux, is_train):
+    """Run one planned node during interpret(). ``ins`` are the raw values
+    (possibly fusion markers); returns (outs_tuple_or_marker, new_aux)."""
+    kind = directive["kind"]
+    if kind == "bn":
+        return _exec_bn(directive, node, ins, aux)
+    if kind == "relu_fold":
+        v = ins[0]
+        if isinstance(v, Deferred):
+            return (v.with_relu(),), ()
+        return (jnp.maximum(resolve(v), 0),), ()
+    if kind == "conv":
+        return _exec_conv(directive, node, ins), ()
+    if kind == "resadd":
+        return _exec_resadd(directive, ins), ()
+    raise AssertionError(kind)
+
+
+def _exec_bn(directive, node, ins, aux):
+    data_v, gamma, beta = ins
+    moving_mean, moving_var = aux
+    a = node.parsed_attrs()
+    eps, momentum = float(a["eps"]), float(a["momentum"])
+    fix_gamma = bool(a["fix_gamma"])
+
+    if isinstance(data_v, WithStats):
+        x, ssum, ssq = data_v.c, data_v.ssum, data_v.ssq
+    else:
+        x = resolve(data_v)
+        x32 = x.astype(jnp.float32)
+        axes = (0,) + tuple(range(2, x.ndim))
+        ssum = jnp.sum(x32, axis=axes)
+        ssq = jnp.sum(x32 * x32, axis=axes)
+    cnt = x.shape[0]
+    for dim in x.shape[2:]:
+        cnt *= dim
+    mean = ssum / cnt
+    var = ssq / cnt - mean * mean
+    istd = jax.lax.rsqrt(var + eps)
+    g32 = istd if fix_gamma else gamma.astype(jnp.float32) * istd
+    scale32 = g32
+    shift32 = beta.astype(jnp.float32) - mean * scale32
+
+    sg = jax.lax.stop_gradient
+    new_mean = moving_mean * momentum + sg(mean).astype(moving_mean.dtype) * (1 - momentum)
+    new_var = moving_var * momentum + sg(var).astype(moving_var.dtype) * (1 - momentum)
+
+    if directive["fold"]:
+        out = Deferred(x, scale32, shift32, relu=False)
+    else:
+        out = _normalize(x, scale32, shift32)
+    return (out,), (new_mean, new_var)
+
+
+def _exec_conv(directive, node, ins):
+    v, w = ins
+    kernel, stride = directive["kernel"], directive["stride"]
+    if isinstance(v, Deferred):
+        x, scale, shift, relu = v.raw, v.scale, v.shift, v.relu
+    else:
+        x, scale, shift, relu = resolve(v), None, None, False
+    if gate(kernel, stride, x.shape, w.shape, x.dtype, scale is not None):
+        if directive["defer"]:
+            return PendingConv(x, w, scale, shift, relu, kernel, stride)
+        c, s, q = conv_block(x, w, scale, shift, None, kernel, stride, relu)
+        return WithStats(c, s, q)
+    # fallback: materialize the normalized input (cached on the marker) and
+    # run the ordinary XLA conv
+    xn = v.materialize() if isinstance(v, Deferred) else x
+    pad = (kernel[0] - 1) // 2
+    c = jax.lax.conv_general_dilated(
+        xn, w, window_strides=stride, padding=[(pad, pad), (pad, pad)],
+        dimension_numbers=("NCHW", "OIHW", "NCHW"))
+    return c
+
+
+def _exec_resadd(directive, ins):
+    slot = directive["pending_slot"]
+    pending, other = ins[slot], ins[1 - slot]
+    if isinstance(pending, PendingConv):
+        c, s, q = pending.run(resolve(other))
+        return WithStats(c, s, q)
+    return resolve(pending) + resolve(other)

@@ -16,6 +16,10 @@ import jax.numpy as jnp
 
 from .registry import AttrSpec, register
 
+# trace-time dispatch counters (observability for tests and the multichip
+# dryrun: proves the seq-parallel path actually engaged)
+DISPATCH_COUNTS = {"ring": 0, "pallas": 0, "xla": 0}
+
 
 @register(
     "_contrib_MultiHeadAttention",
@@ -30,8 +34,37 @@ def _multi_head_attention(attrs, query, key, value):
     """softmax(QKᵀ·scale + mask)V over (B, H, T, D) tensors. Computation in
     fp32 for a stable softmax regardless of the IO dtype (bf16 fast path).
     ``MXNET_USE_PALLAS_ATTENTION=1`` routes to the hand-tiled flash kernel
-    (ops/pallas_attention.py) on TPU when the shapes tile cleanly."""
+    (ops/pallas_attention.py) on TPU when the shapes tile cleanly.
+
+    Sequence parallelism: when traced inside an SPMD step whose mesh has a
+    ``seq`` axis (parallel.make_mesh({"data": dp, "seq": sp})), self-attention
+    dispatches to ring attention (parallel/ring_attention.py) — q stays put,
+    k/v blocks rotate over ICI via ppermute, softmax accumulates online.
+    Disable with MXNET_RING_ATTENTION=0."""
     import os
+
+    mesh = None
+    if os.environ.get("MXNET_RING_ATTENTION", "1") == "1":
+        from ..parallel.mesh import current_trace_mesh
+
+        mesh = current_trace_mesh()
+    if (mesh is not None and "seq" in mesh.axis_names
+            and mesh.shape["seq"] > 1):
+        T = query.shape[2]
+        batch_ok = ("data" not in mesh.axis_names
+                    or query.shape[0] % mesh.shape["data"] == 0)
+        if key.shape[2] == T and T % mesh.shape["seq"] == 0 and batch_ok:
+            # self-attention with divisible shards only; else dense fallback
+            from ..parallel.ring_attention import ring_attention
+
+            DISPATCH_COUNTS["ring"] += 1
+            out = ring_attention(
+                query.transpose(0, 2, 1, 3), key.transpose(0, 2, 1, 3),
+                value.transpose(0, 2, 1, 3), mesh, seq_axis="seq",
+                causal=attrs["causal"],
+                scale=attrs["scale"] if attrs["scale"] > 0 else None,
+                batch_axis="data" if "data" in mesh.axis_names else None)
+            return out.transpose(0, 2, 1, 3)
 
     if os.environ.get("MXNET_USE_PALLAS_ATTENTION", "0") == "1":
         from . import pallas_attention as pa

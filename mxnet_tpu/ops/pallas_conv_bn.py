@@ -1,0 +1,378 @@
+"""Fused conv + BatchNorm Pallas TPU kernel stack (round-5 performance work).
+
+docs/PERF.md's roofline analysis pins the ResNet-50 step at the v5e HBM
+roofline (72.3 GB/step at 809 of 819 GB/s): every path to >=0.35 MFU is a
+bytes-cut, and the one remaining lever is the hand-fused conv+BN kernel —
+the TPU counterpart of the reference's vendor conv kernels
+(/root/reference/src/operator/cudnn_convolution-inl.h) behind its published
+speed table (example/image-classification/README.md:149-156).
+
+This module is that kernel. For NCHW activations viewed as ``(B, K, H*W)``
+(a free reshape — no transposes), one Pallas kernel computes
+
+    c[b, n, hw] = sum_k w[n, k] * xn[b, k, hw]            (1x1 conv = matmul)
+    c[b, n, hw] = sum_{k,t} w[t, n, k] * shift_t(xn)[b, k, hw]   (3x3, 9 taps)
+
+with three fusions XLA cannot do (a convolution cannot be a fusion producer):
+
+- **prologue**: ``xn = relu(x * scale + shift)`` applied in VMEM — the
+  upstream BatchNorm+ReLU output is never materialized in HBM. In the
+  pre-activation ResNet chain (BN -> relu -> Conv, models/resnet.py) this
+  deletes one full activation write + read per edge.
+- **residual epilogue**: ``c += res`` read tile-wise — the bottleneck-block
+  skip add costs no separate read-read-write pass.
+- **stats epilogue**: per-channel ``sum(c)`` and ``sum(c^2)`` accumulated
+  from the f32 MXU accumulator across the (B,) grid sweep — the downstream
+  BatchNorm's statistics pass re-reads nothing.
+
+Layout: grid ``(N/bn, B)`` (channel stripes parallel, batch sweep carries
+the stats accumulator); blocks keep the whole HW extent per instance (every
+ResNet-50 @224 shape fits VMEM this way — see ``choose_blocks``). The 3x3
+taps are static-slice rolls of the VMEM-resident xn tile with
+host-precomputed edge masks applied to the dot *result* (a per-column mask
+commutes with the contraction over K).
+
+The autodiff boundary is exactly this kernel (``conv_block`` is a
+custom_vjp): its backward is ``jax.vjp`` of the equivalent XLA convolution
+(the primal conv is dead code and DCE'd; the stats cotangents fold into the
+output cotangent as ``dc + ds + 2*c*dq`` using the saved output). All BN
+scalar math (mean/var/normalize, moving-stat updates) stays in plain JAX in
+the graph pass (executor fusion plan) so gradients flow through it
+naturally. Numerics note: the kernel's statistics come from the f32
+accumulator *before* the bf16 round of c; XLA's unfused lowering reduces
+the rounded activations — they differ at the bf16-epsilon level, inside BN's
+eps regime.
+"""
+from __future__ import annotations
+
+import functools
+import os
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+__all__ = ["conv_block", "supported", "plan_blocks", "choose_blocks"]
+
+_VMEM_BUDGET = 12 * 1024 * 1024
+
+
+def choose_blocks(B, K, N, HW, itemsize, taps=1, prologue=False):
+    """Pick the channel-stripe width ``bn`` (largest divisor of N, multiple
+    of 8, that keeps the per-instance VMEM working set under budget) for the
+    whole-HW tiling. Returns None if no stripe fits."""
+    for bn in (512, 256, 128, 64, 32, 16, 8):
+        if N % bn:
+            continue
+        est = (
+            2 * K * HW * itemsize          # x tile, double-buffered
+            + 2 * bn * HW * itemsize       # c tile, double-buffered
+            + bn * HW * 4                  # f32 accumulator
+            + taps * bn * K * itemsize     # weight stripe
+            + (K * HW * itemsize if (prologue or taps > 1) else 0)  # xn temp
+            + (K * HW * itemsize if taps > 1 else 0)                # shifted temp
+            + (taps * HW * 4 if taps > 1 else 0)                    # masks
+        )
+        if est <= _VMEM_BUDGET:
+            return bn
+    return None
+
+
+def plan_blocks(x_shape, w_shape, stride=(1, 1), itemsize=2, prologue=True):
+    """The kernel's tiling decision for a concrete call: the channel-stripe
+    width ``bn``, or None when this conv cannot (or should not) run on the
+    Pallas path. This is the single source of truth — ``supported`` and the
+    forward both call it, so a shape that passes the gate can never hit an
+    internal assert instead of the XLA fallback."""
+    if len(x_shape) != 4 or len(w_shape) != 4 or itemsize > 4:
+        return None
+    B, K, H, W = x_shape
+    N, K2, kh, kw = w_shape
+    if K != K2:
+        return None
+    if (kh, kw) == (1, 1):
+        if stride[0] != stride[1] or stride[0] not in (1, 2):
+            return None
+        H, W = H // stride[0], W // stride[1]
+        taps = 1
+    elif (kh, kw) == (3, 3):
+        if stride != (1, 1):
+            return None
+        taps = 9
+    else:
+        return None
+    if K % 8 or H * W < 8:
+        return None
+    return choose_blocks(B, K, N, H * W, itemsize, taps=taps,
+                         prologue=prologue)
+
+
+def supported(x_shape, w_shape, stride=(1, 1), itemsize=2, prologue=True):
+    """Whether the Pallas path can run this conv at all (the per-shape
+    win/lose decision against XLA is the WINS table in
+    fused_conv_bn_table.py, not this predicate). Defaults assume the bf16
+    training path with a prologue — pass the real ``itemsize``/``prologue``
+    for exact answers."""
+    return plan_blocks(x_shape, w_shape, stride, itemsize, prologue) is not None
+
+
+def _shift_masks(H, W):
+    """(9, 1, HW) f32 validity masks for the 3x3 taps at pad=1. Tap t =
+    (dy+1)*3 + (dx+1) reads input position (h+dy, w+dx); a flattened-HW roll
+    wraps row edges, so the mask zeroes every column whose source falls
+    outside the image."""
+    row = np.arange(H * W) // W
+    col = np.arange(H * W) % W
+    masks = np.zeros((9, 1, H * W), np.float32)
+    for dy in (-1, 0, 1):
+        for dx in (-1, 0, 1):
+            ok = ((row + dy >= 0) & (row + dy < H)
+                  & (col + dx >= 0) & (col + dx < W))
+            masks[(dy + 1) * 3 + (dx + 1), 0] = ok
+    return masks
+
+
+def _roll_cols(a, s, hw):
+    """xs[:, j] = a[:, (j + s) % hw] via static slices (Mosaic-friendly)."""
+    s %= hw
+    if s == 0:
+        return a
+    return jnp.concatenate([a[:, s:], a[:, :s]], axis=1)
+
+
+def _kernel(*refs, b_steps, bn, hw, taps, shifts, relu, has_prologue,
+            has_res):
+    import jax.experimental.pallas as pl
+
+    it = iter(refs)
+    x_ref = next(it)
+    w_ref = next(it)
+    mask_ref = next(it) if taps > 1 else None
+    scale_ref = next(it) if has_prologue else None
+    shift_ref = next(it) if has_prologue else None
+    res_ref = next(it) if has_res else None
+    c_ref, sum_ref, sq_ref, acc_s, acc_q = it
+
+    b = pl.program_id(1)
+
+    @pl.when(b == 0)
+    def _init():
+        acc_s[...] = jnp.zeros_like(acc_s)
+        acc_q[...] = jnp.zeros_like(acc_q)
+
+    xn = x_ref[0]  # (K, HW)
+    if has_prologue:
+        xn = xn * scale_ref[...] + shift_ref[...]
+        if relu:
+            xn = jnp.maximum(xn, jnp.zeros_like(xn))
+
+    if taps == 1:
+        c32 = jnp.dot(w_ref[...], xn, preferred_element_type=jnp.float32)
+    else:
+        c32 = jnp.zeros((bn, hw), jnp.float32)
+        for t in range(taps):
+            part = jnp.dot(w_ref[t], _roll_cols(xn, shifts[t], hw),
+                           preferred_element_type=jnp.float32)
+            c32 = c32 + part * mask_ref[t]
+    if has_res:
+        c32 = c32 + res_ref[0].astype(jnp.float32)
+    c_ref[0] = c32.astype(c_ref.dtype)
+    acc_s[...] += jnp.sum(c32, axis=1, keepdims=True)
+    acc_q[...] += jnp.sum(c32 * c32, axis=1, keepdims=True)
+
+    @pl.when(b == b_steps - 1)
+    def _flush():
+        sum_ref[...] = acc_s[...]
+        sq_ref[...] = acc_q[...]
+
+
+@functools.partial(jax.jit, static_argnames=("kernel_hw", "stride", "relu",
+                                             "interpret"))
+def _conv_block_fwd_impl(x, w, scale, shift, res, *, kernel_hw, stride,
+                         relu, interpret):
+    """Pallas forward. x (B,K,H,W); w (N,K,kh,kw); scale/shift (K,) or None;
+    res (B,N,H',W') or None. Returns (c, ssum, ssq)."""
+    import jax.experimental.pallas as pl
+    from jax.experimental.pallas import tpu as pltpu
+
+    B, K, H, W = x.shape
+    N = w.shape[0]
+    kh, kw = kernel_hw
+    if (kh, kw) == (1, 1) and stride != (1, 1):
+        x = x[:, :, :: stride[0], :: stride[1]]
+        B, K, H, W = x.shape
+    HW = H * W
+    taps = kh * kw
+    dt = x.dtype
+    has_prologue = scale is not None
+    bn = choose_blocks(B, K, N, HW, dt.itemsize, taps=taps,
+                       prologue=has_prologue)
+    assert bn is not None, (x.shape, w.shape)  # callers gate via plan_blocks
+    n_tiles = N // bn
+
+    x3 = x.reshape(B, K, HW)
+    inputs = [x3]
+    in_specs = [pl.BlockSpec((1, K, HW), lambda n, b: (b, 0, 0))]
+    if taps == 1:
+        inputs.append(w.reshape(N, K))
+        in_specs.append(pl.BlockSpec((bn, K), lambda n, b: (n, 0)))
+        shifts = (0,)
+    else:
+        # (N,K,3,3) -> (9, N, K): tap-major so each w_ref[t] is a (bn, K)
+        # stripe with K in lanes
+        inputs.append(jnp.transpose(w.reshape(N, K, taps), (2, 0, 1)))
+        in_specs.append(pl.BlockSpec((taps, bn, K), lambda n, b: (0, n, 0)))
+        inputs.append(jnp.asarray(_shift_masks(H, W)))
+        in_specs.append(pl.BlockSpec((taps, 1, HW), lambda n, b: (0, 0, 0)))
+        shifts = tuple(dy * W + dx for dy in (-1, 0, 1) for dx in (-1, 0, 1))
+    if has_prologue:
+        inputs.append(scale.astype(dt).reshape(K, 1))
+        inputs.append(shift.astype(dt).reshape(K, 1))
+        in_specs.append(pl.BlockSpec((K, 1), lambda n, b: (0, 0)))
+        in_specs.append(pl.BlockSpec((K, 1), lambda n, b: (0, 0)))
+    if res is not None:
+        inputs.append(res.reshape(B, N, HW))
+        in_specs.append(pl.BlockSpec((1, bn, HW), lambda n, b: (b, n, 0)))
+
+    params = None if interpret else pltpu.CompilerParams(
+        dimension_semantics=(pltpu.GridDimensionSemantics.PARALLEL,
+                             pltpu.GridDimensionSemantics.ARBITRARY))
+    c, s, q = pl.pallas_call(
+        functools.partial(
+            _kernel, b_steps=B, bn=bn, hw=HW, taps=taps, shifts=shifts,
+            relu=relu, has_prologue=has_prologue, has_res=res is not None),
+        grid=(n_tiles, B),
+        in_specs=in_specs,
+        out_specs=[
+            pl.BlockSpec((1, bn, HW), lambda n, b: (b, n, 0)),
+            pl.BlockSpec((bn, 1), lambda n, b: (n, 0)),
+            pl.BlockSpec((bn, 1), lambda n, b: (n, 0)),
+        ],
+        out_shape=[
+            jax.ShapeDtypeStruct((B, N, HW), dt),
+            jax.ShapeDtypeStruct((N, 1), jnp.float32),
+            jax.ShapeDtypeStruct((N, 1), jnp.float32),
+        ],
+        scratch_shapes=[pltpu.VMEM((bn, 1), jnp.float32),
+                        pltpu.VMEM((bn, 1), jnp.float32)],
+        compiler_params=params,
+        interpret=interpret,
+    )(*inputs)
+    return c.reshape(B, N, H, W), s[:, 0], q[:, 0]
+
+
+_DNUMS = ("NCHW", "OIHW", "NCHW")
+
+
+def _xla_conv(x, w, scale, shift, res, kernel_hw, stride, relu):
+    """The pure-XLA reference of the fused forward (also the fallback path
+    and the backward's differentiation target)."""
+    if scale is not None:
+        bshape = (1, -1, 1, 1)
+        xn = x * scale.astype(x.dtype).reshape(bshape) \
+            + shift.astype(x.dtype).reshape(bshape)
+        if relu:
+            xn = jnp.maximum(xn, 0)
+    else:
+        xn = x
+    pad = (kernel_hw[0] - 1) // 2
+    c = jax.lax.conv_general_dilated(
+        xn, w, window_strides=stride, padding=[(pad, pad), (pad, pad)],
+        dimension_numbers=_DNUMS,
+        preferred_element_type=jnp.promote_types(x.dtype, jnp.float32),
+    ).astype(x.dtype)
+    if res is not None:
+        c = c + res
+    return c
+
+
+def _stats_of(c):
+    c32 = c.astype(jnp.float32)
+    return jnp.sum(c32, axis=(0, 2, 3)), jnp.sum(c32 * c32, axis=(0, 2, 3))
+
+
+@functools.partial(jax.custom_vjp, nondiff_argnums=(5, 6, 7, 8))
+def conv_block(x, w, scale, shift, res, kernel_hw=(1, 1), stride=(1, 1),
+               relu=False, use_pallas=True):
+    """Fused (prologue-normalized) conv (+residual) with statistics epilogue.
+
+    Returns ``(c, ssum, ssq)``: the conv output (x.dtype) and per-channel
+    f32 sum / sum-of-squares over (B, H, W). ``scale``/``shift`` (or None)
+    fold the upstream BN+ReLU into the kernel; ``res`` (or None) is added
+    into the output tile before the statistics. Differentiable in x, w,
+    scale, shift, res.
+    """
+    c, s, q = _conv_block_fwd(x, w, scale, shift, res, kernel_hw, stride,
+                              relu, use_pallas)[0]
+    return c, s, q
+
+
+def _interpret_mode():
+    return jax.default_backend() != "tpu"
+
+
+def _conv_block_fwd(x, w, scale, shift, res, kernel_hw, stride, relu,
+                    use_pallas):
+    if use_pallas and plan_blocks(
+            x.shape, w.shape, stride, itemsize=x.dtype.itemsize,
+            prologue=scale is not None) is not None:
+        c, s, q = _conv_block_fwd_impl(
+            x, w, scale, shift, res, kernel_hw=kernel_hw, stride=stride,
+            relu=relu, interpret=_interpret_mode())
+    else:
+        c = _xla_conv(x, w, scale, shift, res, kernel_hw, stride, relu)
+        s, q = _stats_of(c)
+    return (c, s, q), (x, w, scale, shift, res, c)
+
+
+def _conv_block_bwd(kernel_hw, stride, relu, use_pallas, saved, cts):
+    x, w, scale, shift, res, c = saved
+    dc, ds, dq = cts
+    # fold the statistics cotangents into the output cotangent:
+    # d/dc [ sum(c) . ds + sum(c^2) . dq ] = ds + 2 c dq   (per channel)
+    bshape = (1, -1, 1, 1)
+    dc_eff = (dc.astype(jnp.float32)
+              + ds.reshape(bshape)
+              + 2.0 * c.astype(jnp.float32) * dq.reshape(bshape)
+              ).astype(c.dtype)
+
+    has_prologue = scale is not None
+    has_res = res is not None
+
+    if has_prologue:
+        xn = x * scale.astype(x.dtype).reshape(bshape) \
+            + shift.astype(x.dtype).reshape(bshape)
+        if relu:
+            xn = jnp.maximum(xn, 0)
+    else:
+        xn = x
+
+    pad = (kernel_hw[0] - 1) // 2
+
+    def conv_only(xn, w):
+        return jax.lax.conv_general_dilated(
+            xn, w, window_strides=stride, padding=[(pad, pad), (pad, pad)],
+            dimension_numbers=_DNUMS,
+            preferred_element_type=jnp.promote_types(x.dtype, jnp.float32),
+        ).astype(x.dtype)
+
+    # the recomputed primal is dead code (only dc_eff uses c, and that is the
+    # saved output) — XLA DCEs the duplicate convolution, keeping just the
+    # transposed data/weight grads; xn's recompute is fusible elementwise.
+    _, vjp_fn = jax.vjp(conv_only, xn, w)
+    dxn, dw = vjp_fn(dc_eff)
+    if has_prologue:
+        if relu:
+            dxn = dxn * (xn > 0).astype(dxn.dtype)
+        dx = dxn * scale.astype(dxn.dtype).reshape(bshape)
+        # per-channel reductions with explicit f32 accumulators (a bf16
+        # reduce over B*H*W elements would lose the gradient's low bits)
+        dxn32 = dxn.astype(jnp.float32)
+        dscale = jnp.sum(dxn32 * x.astype(jnp.float32), axis=(0, 2, 3))
+        dshift = jnp.sum(dxn32, axis=(0, 2, 3))
+    else:
+        dx, dscale, dshift = dxn, None, None
+    return dx, dw, dscale, dshift, (dc_eff if has_res else None)
+
+
+conv_block.defvjp(_conv_block_fwd, _conv_block_bwd)

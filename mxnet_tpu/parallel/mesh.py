@@ -1,9 +1,34 @@
-"""Device-mesh construction helpers."""
+"""Device-mesh construction helpers + the trace-time mesh context.
+
+``trace_mesh``/``current_trace_mesh`` let mesh-aware ops (ring attention
+dispatch in ops/attention.py) discover the SPMD mesh while the trainer's
+step is being traced — the op registry's apply signature carries no mesh,
+and threading one through every op would leak parallelism into the single-
+device API."""
 from __future__ import annotations
+
+import contextlib
+import contextvars
 
 import numpy as np
 
-__all__ = ["make_mesh", "local_mesh"]
+__all__ = ["make_mesh", "local_mesh", "trace_mesh", "current_trace_mesh"]
+
+_TRACE_MESH = contextvars.ContextVar("mxtpu_trace_mesh", default=None)
+
+
+def current_trace_mesh():
+    """The mesh of the SPMD step currently being traced, or None."""
+    return _TRACE_MESH.get()
+
+
+@contextlib.contextmanager
+def trace_mesh(mesh):
+    tok = _TRACE_MESH.set(mesh)
+    try:
+        yield mesh
+    finally:
+        _TRACE_MESH.reset(tok)
 
 
 def make_mesh(shape=None, axis_names=("data", "model"), devices=None):

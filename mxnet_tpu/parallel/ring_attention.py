@@ -52,11 +52,15 @@ def local_blockwise_attention(q, k, v, scale, causal, q_block, kv_block, block):
     return _block_attend(q, k, v, scale, mask)
 
 
-def ring_attention(q, k, v, mesh, seq_axis="seq", causal=False, scale=None):
+def ring_attention(q, k, v, mesh, seq_axis="seq", causal=False, scale=None,
+                   batch_axis=None):
     """Exact attention with q/k/v sharded on the sequence axis.
 
     q, k, v: (B, T, H, D) jax arrays (global view), T divisible by the size of
-    ``seq_axis``. Returns (B, T, H, D) with the same sharding as q."""
+    ``seq_axis``. Returns (B, T, H, D) with the same sharding as q.
+    ``batch_axis`` additionally keeps dim 0 sharded (dp x sp execution —
+    without it a batch-sharded operand would be gathered at the shard_map
+    boundary)."""
     import jax
     import jax.numpy as jnp
     from jax.sharding import NamedSharding, PartitionSpec as P
@@ -91,10 +95,14 @@ def ring_attention(q, k, v, mesh, seq_axis="seq", causal=False, scale=None):
         B, t, H, D = qb.shape
         # initial accumulators are constants; mark them device-varying so the
         # scan carry type matches the per-shard outputs (shard_map vma check)
-        pvary = getattr(jax.lax, "pvary", lambda x, _: x)
-        o0 = pvary(jnp.zeros((B, t, H, D), "float32"), (seq_axis,))
-        m0 = pvary(jnp.full((B, H, t), -jnp.inf, "float32"), (seq_axis,))
-        l0 = pvary(jnp.zeros((B, H, t), "float32"), (seq_axis,))
+        if hasattr(jax.lax, "pcast"):
+            pvary = lambda x, axes: jax.lax.pcast(x, axes, to="varying")
+        else:
+            pvary = getattr(jax.lax, "pvary", lambda x, _: x)
+        vary_axes = (seq_axis,) + ((batch_axis,) if batch_axis else ())
+        o0 = pvary(jnp.zeros((B, t, H, D), "float32"), vary_axes)
+        m0 = pvary(jnp.full((B, H, t), -jnp.inf, "float32"), vary_axes)
+        l0 = pvary(jnp.zeros((B, H, t), "float32"), vary_axes)
         (o, m, l, _, _), _ = jax.lax.scan(
             step, (o0, m0, l0, kb.astype("float32"), vb.astype("float32")),
             jnp.arange(n))
@@ -102,7 +110,7 @@ def ring_attention(q, k, v, mesh, seq_axis="seq", causal=False, scale=None):
         out = o / denom[..., None].swapaxes(1, 2)
         return out.astype(qb.dtype)
 
-    spec = P(None, seq_axis, None, None)
+    spec = P(batch_axis, seq_axis, None, None)
     fn = jax.shard_map(local, mesh=mesh, in_specs=(spec, spec, spec),
                        out_specs=spec)
     return fn(q, k, v)

@@ -40,10 +40,14 @@ class ShardingRules:
     Data/label batches are sharded on dim 0 over the data axis."""
 
     def __init__(self, mesh, data_axis="data", model_axis="model",
-                 param_rule: Optional[Callable] = None):
+                 param_rule: Optional[Callable] = None, seq_axis=None):
         self.mesh = mesh
         self.data_axis = data_axis if data_axis in mesh.axis_names else None
         self.model_axis = model_axis if model_axis in mesh.axis_names else None
+        # opt-in (sequence-parallel training): shard dim 1 of batch inputs —
+        # (B, T) token ids / labels — over this axis so activations enter the
+        # network seq-sharded and ring attention never gathers the sequence
+        self.seq_axis = seq_axis if seq_axis in (mesh.axis_names or ()) else None
         self._param_rule = param_rule
 
     @property
@@ -59,6 +63,9 @@ class ShardingRules:
 
         if not self.data_axis or not shape:
             return P()
+        if self.seq_axis and len(shape) >= 2:
+            return P(self.data_axis, self.seq_axis,
+                     *([None] * (len(shape) - 2)))
         return P(self.data_axis, *([None] * (len(shape) - 1)))
 
     def param_spec(self, name, shape):

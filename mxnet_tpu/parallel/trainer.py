@@ -74,7 +74,12 @@ class SPMDTrainer:
         self.symbol = symbol
         self.mesh = mesh
         self.rules = rules or ShardingRules(mesh)
-        self._prog = _GraphProgram(symbol)
+        # conv+BN Pallas fusion engages on single-device meshes only: a
+        # pallas_call has no SPMD partitioning rule, so under a >1-device
+        # mesh GSPMD would all-gather its operands (wrong cost model); the
+        # multi-device path keeps the XLA lowering
+        self._prog = _GraphProgram(
+            symbol, fusion=int(np.prod(mesh.devices.shape)) == 1)
         self._remat = remat
         self._compute_dtype = np.dtype(compute_dtype) if compute_dtype else None
 
@@ -215,8 +220,13 @@ class SPMDTrainer:
                 vals.append(v)
             return tuple(vals)
 
+        mesh = self.mesh
+
         def fwd(params, aux_tuple, inputs, rng):
-            outs, new_aux = prog.interpret(assemble(params, inputs), aux_tuple, True, rng)
+            from .mesh import trace_mesh
+
+            with trace_mesh(mesh):  # mesh-aware ops (ring attention) dispatch
+                outs, new_aux = prog.interpret(assemble(params, inputs), aux_tuple, True, rng)
             if cdt is not None:
                 new_aux = tuple(a.astype(o.dtype) if hasattr(o, "dtype") else a
                                 for a, o in zip(new_aux, aux_tuple))

@@ -297,3 +297,47 @@ def test_pallas_flash_attention_grad_8k_tpu():
         np.testing.assert_allclose(np.asarray(a, dtype=np.float32),
                                    np.asarray(b), rtol=5e-2, atol=5e-2,
                                    err_msg="d%s 8k mismatch" % name)
+
+
+@pytest.mark.parametrize("causal", [False, True])
+def test_ring_dispatch_in_op(causal):
+    """Under a trace mesh with a 'seq' axis, the MultiHeadAttention op must
+    dispatch to ring attention (dp x sp) and match the dense path exactly."""
+    import jax
+
+    from mxnet_tpu.ops import attention as attn_op
+    from mxnet_tpu.ops.registry import get_op
+    from mxnet_tpu.parallel.mesh import trace_mesh
+
+    rs = np.random.RandomState(3)
+    B, H, T, D = 4, 2, 16, 4
+    q, k, v = (rs.randn(B, H, T, D).astype("float32") for _ in range(3))
+    opdef = get_op("_contrib_MultiHeadAttention")
+    attrs = {"causal": causal, "scale": -1.0}
+    (dense,), _ = opdef.apply(attrs, [q, k, v])
+
+    mesh = parallel.make_mesh({"data": 2, "seq": 4}, devices=jax.devices()[:8])
+    before = attn_op.DISPATCH_COUNTS["ring"]
+    with trace_mesh(mesh):
+        (ring,), _ = opdef.apply(attrs, [q, k, v])
+    assert attn_op.DISPATCH_COUNTS["ring"] == before + 1
+    np.testing.assert_allclose(np.asarray(ring), np.asarray(dense),
+                               rtol=1e-4, atol=1e-5)
+
+
+def test_ring_dispatch_respects_kill_switch(monkeypatch):
+    import jax
+
+    from mxnet_tpu.ops import attention as attn_op
+    from mxnet_tpu.ops.registry import get_op
+    from mxnet_tpu.parallel.mesh import trace_mesh
+
+    monkeypatch.setenv("MXNET_RING_ATTENTION", "0")
+    rs = np.random.RandomState(4)
+    q, k, v = (rs.randn(2, 2, 16, 4).astype("float32") for _ in range(3))
+    mesh = parallel.make_mesh({"data": 2, "seq": 4}, devices=jax.devices()[:8])
+    before = attn_op.DISPATCH_COUNTS["ring"]
+    with trace_mesh(mesh):
+        get_op("_contrib_MultiHeadAttention").apply(
+            {"causal": True, "scale": -1.0}, [q, k, v])
+    assert attn_op.DISPATCH_COUNTS["ring"] == before

@@ -1,0 +1,150 @@
+"""Graph-level tests of the conv+BN fusion pass (fusion.py +
+ops/pallas_conv_bn.py): a pre-activation bottleneck trained through the
+executor must produce identical outputs/gradients/aux updates with the
+fusion force-engaged (MXNET_FUSED_CONV_BN=1, Pallas interpret mode on CPU)
+as with it disabled (=0). This is the fwd+bwd parity contract the WINS-table
+gating relies on."""
+import numpy as np
+import pytest
+
+import mxnet_tpu as mx
+from mxnet_tpu import fusion
+
+
+def _bottleneck(nf=16):
+    """Pre-activation bottleneck + shortcut conv (models/resnet.py shape):
+    exercises prologue folds, 1x1 + 3x3 kernels, residual defer, stats
+    reuse across the whole chain."""
+    sym = mx.sym
+    data = sym.Variable("data")
+    bn1 = sym.BatchNorm(data=data, fix_gamma=False, name="bn1")
+    act1 = sym.Activation(data=bn1, act_type="relu", name="relu1")
+    conv1 = sym.Convolution(data=act1, num_filter=nf // 2, kernel=(1, 1),
+                            stride=(1, 1), pad=(0, 0), no_bias=True,
+                            name="conv1")
+    bn2 = sym.BatchNorm(data=conv1, fix_gamma=False, name="bn2")
+    act2 = sym.Activation(data=bn2, act_type="relu", name="relu2")
+    conv2 = sym.Convolution(data=act2, num_filter=nf // 2, kernel=(3, 3),
+                            stride=(1, 1), pad=(1, 1), no_bias=True,
+                            name="conv2")
+    bn3 = sym.BatchNorm(data=conv2, fix_gamma=False, name="bn3")
+    act3 = sym.Activation(data=bn3, act_type="relu", name="relu3")
+    conv3 = sym.Convolution(data=act3, num_filter=nf, kernel=(1, 1),
+                            stride=(1, 1), pad=(0, 0), no_bias=True,
+                            name="conv3")
+    sc = sym.Convolution(data=act1, num_filter=nf, kernel=(1, 1),
+                         stride=(1, 1), pad=(0, 0), no_bias=True, name="sc")
+    out = conv3 + sc
+    pool = sym.Pooling(data=out, kernel=(1, 1), global_pool=True,
+                       pool_type="avg", name="pool")
+    fc = sym.FullyConnected(data=sym.Flatten(pool), num_hidden=10, name="fc")
+    return sym.SoftmaxOutput(data=fc, name="softmax")
+
+
+def _run(env_value, monkeypatch, seed=7):
+    monkeypatch.setenv("MXNET_FUSED_CONV_BN", env_value)
+    net = _bottleneck()
+    rs = np.random.RandomState(seed)
+    B, C, H, W = 4, 8, 8, 8
+    ex = net.simple_bind(mx.cpu(), data=(B, C, H, W),
+                         softmax_label=(B,), grad_req="write")
+    for name, arr in zip(net.list_arguments(), ex.arg_arrays):
+        if name == "data":
+            arr[:] = rs.uniform(-1, 1, arr.shape).astype("f")
+        elif name == "softmax_label":
+            arr[:] = rs.randint(0, 10, arr.shape).astype("f")
+        elif name.endswith(("_gamma",)):
+            arr[:] = rs.uniform(0.5, 1.5, arr.shape).astype("f")
+        elif name.endswith(("_beta",)):
+            arr[:] = rs.uniform(-0.2, 0.2, arr.shape).astype("f")
+        else:
+            arr[:] = (rs.uniform(-1, 1, arr.shape) * 0.2).astype("f")
+    out = ex.forward(is_train=True)[0].asnumpy()
+    ex.backward()
+    grads = {n: g.asnumpy() for n, g in zip(net.list_arguments(),
+                                            ex.grad_arrays) if g is not None}
+    aux = {n: a.asnumpy() for n, a in zip(net.list_auxiliary_states(),
+                                          ex.aux_arrays)}
+    return out, grads, aux
+
+
+def test_plan_structure():
+    """The planner must find every fold/defer in the bottleneck."""
+    net = _bottleneck()
+    topo = net._topo()
+    plan = fusion.plan(topo)
+    by_name = {n.name: plan.get(id(n)) for n in topo if not n.is_variable}
+    # bn2/bn3 feed exactly one relu feeding exactly one conv: folded.
+    assert by_name["bn2"] == {"kind": "bn", "fold": True}
+    assert by_name["bn3"] == {"kind": "bn", "fold": True}
+    # bn1 -> relu1 feeds BOTH conv1 and sc — fold still legal (each consumer
+    # re-applies the prologue in VMEM)
+    assert by_name["bn1"]["fold"] is True
+    assert by_name["relu1"] == {"kind": "relu_fold"}
+    # exactly one add operand (both are single-consumer eligible convs) is
+    # deferred into the add's epilogue; the other runs standalone
+    assert (by_name["conv3"]["defer"], by_name["sc"]["defer"]).count(True) == 1
+    add_name = [n.name for n in topo if n.op == "elemwise_add"][0]
+    assert by_name[add_name]["kind"] == "resadd"
+
+
+def test_fused_matches_unfused(monkeypatch):
+    out0, g0, aux0 = _run("0", monkeypatch)
+    out1, g1, aux1 = _run("1", monkeypatch)
+    np.testing.assert_allclose(out1, out0, rtol=1e-4, atol=1e-5)
+    assert set(g1) == set(g0)
+    for name in g0:
+        np.testing.assert_allclose(g1[name], g0[name], rtol=2e-3, atol=2e-4,
+                                   err_msg=name)
+    for name in aux0:
+        np.testing.assert_allclose(aux1[name], aux0[name], rtol=1e-4,
+                                   atol=1e-5, err_msg=name)
+
+
+def test_auto_mode_empty_table_falls_back(monkeypatch):
+    """auto + empty WINS table must produce the plain XLA numbers (the plan
+    exists, every gate declines)."""
+    out0, g0, _ = _run("0", monkeypatch)
+    outa, ga, _ = _run("auto", monkeypatch)
+    np.testing.assert_allclose(outa, out0, rtol=1e-4, atol=1e-5)
+    for name in g0:
+        np.testing.assert_allclose(ga[name], g0[name], rtol=2e-3, atol=2e-4,
+                                   err_msg=name)
+
+
+def test_eval_mode_unaffected(monkeypatch):
+    """is_train=False must bypass fusion (BN uses moving stats)."""
+    monkeypatch.setenv("MXNET_FUSED_CONV_BN", "1")
+    net = _bottleneck()
+    ex = net.simple_bind(mx.cpu(), data=(2, 8, 8, 8), softmax_label=(2,),
+                         grad_req="null")
+    rs = np.random.RandomState(1)
+    for arr in ex.arg_arrays:
+        arr[:] = rs.uniform(-0.5, 0.5, arr.shape).astype("f")
+    out_eval = ex.forward(is_train=False)[0].asnumpy()
+    assert np.isfinite(out_eval).all()
+
+
+def test_spmd_trainer_single_device_fused(monkeypatch):
+    """The SPMDTrainer path (bench.py's) engages fusion on a 1-device mesh
+    and trains: loss must drop over a few steps with fusion forced on."""
+    monkeypatch.setenv("MXNET_FUSED_CONV_BN", "1")
+    import jax
+
+    from mxnet_tpu import parallel
+
+    net = _bottleneck()
+    mesh = parallel.make_mesh((1,), axis_names=("data",),
+                              devices=[jax.devices()[0]])
+    tr = parallel.SPMDTrainer(net, mesh, optimizer="sgd",
+                              optimizer_params={"learning_rate": 0.05})
+    tr.init_params({"data": (4, 8, 8, 8)}, {"softmax_label": (4,)}, seed=0)
+    rs = np.random.RandomState(2)
+    x = jax.numpy.asarray(rs.uniform(-1, 1, (4, 8, 8, 8)).astype("f"))
+    y = jax.numpy.asarray(rs.randint(0, 10, (4,)).astype("f"))
+    losses = []
+    for _ in range(8):
+        outs = tr.step({"data": x}, {"softmax_label": y})
+        prob = np.asarray(outs[0])
+        losses.append(-np.log(prob[np.arange(4), y.astype(int)] + 1e-9).mean())
+    assert losses[-1] < losses[0] * 0.9, losses

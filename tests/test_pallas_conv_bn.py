@@ -1,0 +1,158 @@
+"""Parity tests for the fused conv+BN Pallas kernel stack (interpret mode on
+CPU; the on-TPU timing lives in tools/fused_stats_bench.py). The oracle is
+the pure-XLA reference implementation of the same fused contract."""
+import numpy as np
+import pytest
+
+import jax
+import jax.numpy as jnp
+
+from mxnet_tpu.ops import pallas_conv_bn as pcb
+
+
+def _mk(shape, seed, dtype=np.float32):
+    rs = np.random.RandomState(seed)
+    return jnp.asarray(rs.randn(*shape).astype(np.float32), dtype)
+
+
+def _ref(x, w, scale, shift, res, kernel_hw, stride, relu):
+    c = pcb._xla_conv(x, w, scale, shift, res, kernel_hw, stride, relu)
+    s, q = pcb._stats_of(c)
+    return c, s, q
+
+
+CASES = [
+    # (kernel, stride, prologue, relu, res)
+    ((1, 1), (1, 1), False, False, False),
+    ((1, 1), (1, 1), True, True, False),
+    ((1, 1), (1, 1), True, False, True),
+    ((1, 1), (2, 2), True, True, False),
+    ((3, 3), (1, 1), False, False, False),
+    ((3, 3), (1, 1), True, True, True),
+]
+
+
+@pytest.mark.parametrize("kernel,stride,prologue,relu,res", CASES)
+def test_forward_parity(kernel, stride, prologue, relu, res):
+    B, K, H, W, N = 4, 16, 8, 8, 32
+    x = _mk((B, K, H, W), 0)
+    w = _mk((N, K) + kernel, 1) * 0.1
+    scale = _mk((K,), 2) if prologue else None
+    shift = _mk((K,), 3) if prologue else None
+    Ho, Wo = H // stride[0], W // stride[1]
+    r = _mk((B, N, Ho, Wo), 4) if res else None
+    assert pcb.supported(x.shape, w.shape, stride)
+
+    c0, s0, q0 = _ref(x, w, scale, shift, r, kernel, stride, relu)
+    c1, s1, q1 = pcb.conv_block(x, w, scale, shift, r, kernel, stride, relu)
+    np.testing.assert_allclose(np.asarray(c1), np.asarray(c0),
+                               rtol=1e-4, atol=1e-4)
+    np.testing.assert_allclose(np.asarray(s1), np.asarray(s0),
+                               rtol=1e-4, atol=1e-3)
+    np.testing.assert_allclose(np.asarray(q1), np.asarray(q0),
+                               rtol=1e-4, atol=1e-3)
+
+
+@pytest.mark.parametrize("kernel,stride,prologue,relu,res", CASES)
+def test_gradient_parity(kernel, stride, prologue, relu, res):
+    """grad through conv_block == grad through the XLA reference, for a
+    loss that exercises all three outputs (c, ssum, ssq)."""
+    B, K, H, W, N = 2, 8, 8, 8, 16
+    x = _mk((B, K, H, W), 10)
+    w = _mk((N, K) + kernel, 11) * 0.1
+    scale = _mk((K,), 12) if prologue else None
+    shift = _mk((K,), 13) if prologue else None
+    Ho, Wo = H // stride[0], W // stride[1]
+    r = _mk((B, N, Ho, Wo), 14) if res else None
+
+    cos = _mk((B, N, Ho, Wo), 15)
+
+    def loss(fn, x, w, scale, shift, r):
+        c, s, q = fn(x, w, scale, shift, r)
+        return (jnp.sum(c.astype(jnp.float32) * cos.astype(jnp.float32))
+                + jnp.sum(jnp.sin(s)) + 1e-3 * jnp.sum(jnp.sqrt(q + 1.0)))
+
+    argnums = tuple(i for i, a in enumerate((x, w, scale, shift, r))
+                    if a is not None)
+    g_ref = jax.grad(
+        lambda *a: loss(lambda x, w, sc, sh, r: _ref(
+            x, w, sc, sh, r, kernel, stride, relu), *a),
+        argnums=argnums)(x, w, scale, shift, r)
+    g_pal = jax.grad(
+        lambda *a: loss(lambda x, w, sc, sh, r: pcb.conv_block(
+            x, w, sc, sh, r, kernel, stride, relu), *a),
+        argnums=argnums)(x, w, scale, shift, r)
+    for ga, gb in zip(g_pal, g_ref):
+        np.testing.assert_allclose(np.asarray(ga), np.asarray(gb),
+                                   rtol=1e-3, atol=1e-3)
+
+
+def test_fallback_unsupported_shape():
+    """Shapes the kernel cannot tile must silently take the XLA path."""
+    x = _mk((2, 6, 5, 5), 20)   # K=6 not a multiple of 8
+    w = _mk((7, 6, 1, 1), 21)
+    assert not pcb.supported(x.shape, w.shape)
+    c, s, q = pcb.conv_block(x, w, None, None, None, (1, 1), (1, 1), False)
+    c0, s0, q0 = _ref(x, w, None, None, None, (1, 1), (1, 1), False)
+    np.testing.assert_allclose(np.asarray(c), np.asarray(c0), rtol=1e-5)
+
+
+def test_resnet_shapes_supported():
+    """Every ResNet-50 @224 bottleneck conv except the stride-2 3x3s and the
+    7x7 stem must tile (batch 256 working-set check is analytic —
+    choose_blocks — so a small B here proves the same tiling)."""
+    sites = [
+        # (K, N, H, kernel, stride)
+        (64, 64, 56, (1, 1), (1, 1)),
+        (64, 64, 56, (3, 3), (1, 1)),
+        (64, 256, 56, (1, 1), (1, 1)),
+        (256, 64, 56, (1, 1), (1, 1)),
+        (256, 128, 56, (1, 1), (1, 1)),
+        (128, 128, 28, (3, 3), (1, 1)),
+        (128, 512, 28, (1, 1), (1, 1)),
+        (512, 128, 28, (1, 1), (1, 1)),
+        (256, 512, 56, (1, 1), (2, 2)),   # stage2 shortcut
+        (512, 256, 28, (1, 1), (1, 1)),
+        (256, 256, 14, (3, 3), (1, 1)),
+        (256, 1024, 14, (1, 1), (1, 1)),
+        (1024, 256, 14, (1, 1), (1, 1)),
+        (512, 1024, 28, (1, 1), (2, 2)),  # stage3 shortcut
+        (1024, 512, 14, (1, 1), (1, 1)),
+        (512, 512, 7, (3, 3), (1, 1)),
+        (512, 2048, 7, (1, 1), (1, 1)),
+        (2048, 512, 7, (1, 1), (1, 1)),
+        (1024, 2048, 14, (1, 1), (2, 2)),  # stage4 shortcut
+    ]
+    for K, N, H, kernel, stride in sites:
+        assert pcb.supported((256, K, H, H), (N, K) + kernel, stride), (
+            K, N, H, kernel, stride)
+
+
+def test_bf16_stats_precision():
+    """bf16 inputs: the kernel's f32-accumulator stats must be closer to the
+    f64 truth than naive bf16 accumulation would be (sanity of the epilogue
+    numerics)."""
+    B, K, H, W, N = 8, 16, 8, 8, 32
+    x = _mk((B, K, H, W), 30, jnp.bfloat16)
+    w = _mk((N, K, 1, 1), 31, jnp.bfloat16) * 0.1
+    c, s, q = pcb.conv_block(x, w, None, None, None, (1, 1), (1, 1), False)
+    c64 = np.asarray(c, np.float64)
+    np.testing.assert_allclose(np.asarray(s), c64.sum((0, 2, 3)),
+                               rtol=3e-2, atol=1e-2)
+    np.testing.assert_allclose(np.asarray(q), (c64 * c64).sum((0, 2, 3)),
+                               rtol=3e-2, atol=1e-2)
+
+
+def test_tight_vmem_falls_back_not_asserts():
+    """A shape whose f32+prologue working set exceeds the VMEM budget (but
+    would fit at bf16 without prologue) must take the XLA fallback, never an
+    internal assert (code-review regression: supported() and the kernel used
+    different tiling parameters)."""
+    x = _mk((1, 64, 112, 112), 40)  # float32
+    w = _mk((64, 64, 3, 3), 41) * 0.1
+    scale = _mk((64,), 42)
+    shift = _mk((64,), 43)
+    c, s, q = pcb.conv_block(x, w, scale, shift, None, (3, 3), (1, 1), True)
+    c0, s0, q0 = _ref(x, w, scale, shift, None, (3, 3), (1, 1), True)
+    np.testing.assert_allclose(np.asarray(c), np.asarray(c0),
+                               rtol=1e-4, atol=1e-4)

@@ -1,13 +1,20 @@
-"""Measure the Pallas matmul+BN-stats kernel against XLA's unfused lowering
-(matmul, then a separate statistics read-back pass) at ResNet-50 1x1-conv
-shapes, batch 256. The quantity under test is the one docs/PERF.md §4 says
-is the last MFU lever on the v5e: removing the statistics pass's re-read of
-the activation.
+"""Measure the fused conv+BN Pallas kernel against XLA's unfused lowering at
+every eligible ResNet-50 @224 conv+BN site, and emit the per-shape WINS table
+that gates graph integration (mxnet_tpu/ops/fused_conv_bn_table.py).
 
-Each timing amortizes ``--iters`` kernel executions inside one jitted scan
-(the axon tunnel adds ~2 ms per dispatch) and syncs by fetching a scalar.
+The contract under test is the in-graph one (fusion.py):
 
-    python tools/fused_stats_bench.py
+  unfused:  xn = relu(x*scale + shift)  [materialized]
+            c  = conv(xn);  s = sum(c32);  q = sum(c32^2)   [stats re-read c]
+  fused:    conv_block(x, w, scale, shift, ...) — prologue in VMEM, stats
+            from the f32 MXU accumulator, one HBM write for c.
+
+Each timing amortizes ``--iters`` executions inside one jitted scan (the
+axon tunnel adds ~2 ms per dispatch) and syncs by fetching a scalar
+(docs/PERF.md §0). A shape "wins" when fused time <= unfused time; wins are
+written with ``--emit-table`` and engage under MXNET_FUSED_CONV_BN=auto.
+
+    python tools/fused_stats_bench.py --batch 256 --emit-table
 """
 import argparse
 import functools
@@ -20,87 +27,166 @@ import numpy as np
 
 sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
 
-# (M, K, N) = (B*H*W, Cin, Cout) for b256 ResNet-50 bottleneck 1x1s
-SHAPES = [
-    (802816, 64, 256),    # stage1 expand, 56x56
-    (802816, 256, 64),    # stage1 reduce
-    (200704, 512, 128),   # stage2, 28x28
-    (50176, 1024, 256),   # stage3, 14x14
-    (12544, 2048, 512),   # stage4, 7x7
-]
+_TABLE = os.path.join(os.path.dirname(os.path.dirname(os.path.abspath(__file__))),
+                      "mxnet_tpu", "ops", "fused_conv_bn_table.py")
+
+
+def resnet50_sites(batch):
+    """Every conv+BN site of models/resnet.py resnet-50 @224 as
+    (kernel, stride, K, N, H, count). 53 convs total; the 7x7 stem and the
+    three stride-2 3x3s are structurally out (supported() false)."""
+    units = [3, 4, 6, 3]
+    filters = [64, 256, 512, 1024, 2048]
+    sites = {}
+
+    def add(kernel, stride, K, N, H):
+        key = (kernel, stride, K, N, H)
+        sites[key] = sites.get(key, 0) + 1
+
+    add((7, 7), (2, 2), 3, 64, 224)  # stem (reported, never supported)
+    H = 56
+    for stage, n_unit in enumerate(units):
+        stride = 1 if stage == 0 else 2
+        nf = filters[stage + 1]
+        K_in = filters[stage]
+        # unit 1 (dim_match=False)
+        add((1, 1), (1, 1), K_in, nf // 4, H)            # conv1
+        add((3, 3), (stride, stride), nf // 4, nf // 4, H)  # conv2 (strided)
+        Ho = H // stride
+        add((1, 1), (1, 1), nf // 4, nf, Ho)             # conv3
+        add((1, 1), (stride, stride), K_in, nf, H)       # shortcut
+        H = Ho
+        for _ in range(n_unit - 1):
+            add((1, 1), (1, 1), nf, nf // 4, H)
+            add((3, 3), (1, 1), nf // 4, nf // 4, H)
+            add((1, 1), (1, 1), nf // 4, nf, H)
+    total = sum(sites.values())
+    assert total == 53, total
+    return [(k, s, K, N, H, c) for (k, s, K, N, H), c in sorted(sites.items())]
 
 
 def main():
     ap = argparse.ArgumentParser()
-    ap.add_argument("--iters", type=int, default=20)
-    ap.add_argument("--block-m", type=int, default=512)
-    ap.add_argument("--block-n", type=int, default=256)
+    ap.add_argument("--batch", type=int, default=256)
+    ap.add_argument("--iters", type=int, default=10)
+    ap.add_argument("--dtype", default="bfloat16")
+    ap.add_argument("--emit-table", action="store_true")
+    ap.add_argument("--min-speedup", type=float, default=1.0,
+                    help="fused engages where t_xla/t_fused >= this")
     args = ap.parse_args()
 
     import jax
     import jax.numpy as jnp
 
-    from mxnet_tpu.ops.pallas_matmul_stats import matmul_with_stats, supported
+    from mxnet_tpu.ops.pallas_conv_bn import (conv_block, supported,
+                                              _xla_conv, _stats_of)
+
+    dt = jnp.dtype(args.dtype)
+    dev = jax.devices()[0]
 
     def sync(x):
         return np.asarray(jnp.sum(x.astype(jnp.float32)))
 
-    def timeit(fn, a, b):
+    def timeit(fn, *arrs):
         @jax.jit
-        def many(a, b):
+        def many(*arrs):
             def body(carry, _):
-                c, s, q = fn(a, b)
-                # fold outputs into the carry so no iteration is dead code
-                return carry + s[:1] + q[:1] + c[:1, :1].astype(jnp.float32).reshape(1), None
+                c, s, q = fn(*arrs)
+                return (carry + s[:1] + q[:1]
+                        + c.reshape(-1)[:1].astype(jnp.float32)), None
 
             out, _ = jax.lax.scan(body, jnp.zeros((1,), jnp.float32),
                                   None, length=args.iters)
             return out
 
-        sync(many(a, b))  # compile + warmup
-        t0 = time.perf_counter()
-        out = many(a, b)
-        sync(out)
-        return (time.perf_counter() - t0) / args.iters
-
-    def xla_path(a, b):
-        c = jnp.dot(a, b)                       # bf16 out, MXU
-        c32 = c.astype(jnp.float32)
-        return c, jnp.sum(c32, axis=0), jnp.sum(c32 * c32, axis=0)
+        sync(many(*arrs))  # compile + warmup
+        best = float("inf")
+        for _ in range(2):
+            t0 = time.perf_counter()
+            out = many(*arrs)
+            sync(out)
+            best = min(best, (time.perf_counter() - t0) / args.iters)
+        return best
 
     rs = np.random.RandomState(0)
-    for M, K, N in SHAPES:
-        # fall back through smaller M-blocks so every shape that CAN tile
-        # gets measured rather than silently skipped
-        bm = next((c for c in (args.block_m, 256, 128, 64)
-                   if supported(M, K, N, c, args.block_n, itemsize=2)), None)
-        if bm is None:
-            print(json.dumps({"shape": [M, K, N], "skipped": "tiling"}))
+    wins, rows = {}, []
+    for kernel, stride, K, N, H, count in resnet50_sites(args.batch):
+        B = args.batch
+        x_shape = (B, K, H, H)
+        w_shape = (N, K) + kernel
+        rec = {"kernel": kernel[0], "stride": stride[0], "K": K, "N": N,
+               "H": H, "count": count}
+        if not supported(x_shape, w_shape, stride, itemsize=dt.itemsize,
+                         prologue=True):
+            rec["skipped"] = "unsupported"
+            rows.append(rec)
+            print(json.dumps(rec))
             continue
-        a = jnp.asarray(rs.randn(M, K), jnp.bfloat16)
-        b = jnp.asarray(rs.randn(K, N), jnp.bfloat16)
+        x = jnp.asarray(rs.randn(*x_shape), dt)
+        w = jnp.asarray(rs.randn(*w_shape) * 0.1, dt)
+        scale = jnp.asarray(rs.uniform(0.5, 1.5, (K,)), jnp.float32)
+        shift = jnp.asarray(rs.uniform(-0.2, 0.2, (K,)), jnp.float32)
 
-        def pallas_path_bm(a, b, bm=bm):
-            return matmul_with_stats(a, b, block_m=bm, block_n=args.block_n)
+        def unfused(x, w, scale, shift):
+            c = _xla_conv(x, w, scale, shift, None, kernel, stride, True)
+            s, q = _stats_of(c)
+            return c, s, q
 
-        t_xla = timeit(xla_path, a, b)
-        t_pal = timeit(pallas_path_bm, a, b)
-        # correctness spot check: all three outputs (bf16 tolerances)
-        c0, s0, q0 = jax.jit(xla_path)(a, b)
-        c1, s1, q1 = jax.jit(pallas_path_bm)(a, b)
-        rel = lambda x, y: float(jnp.max(jnp.abs(x.astype(jnp.float32)
-                                                 - y.astype(jnp.float32)))
-                                 / (jnp.max(jnp.abs(x.astype(jnp.float32)))
-                                    + 1e-9))
-        print(json.dumps({
-            "shape": [M, K, N], "block_m": bm,
-            "xla_ms": round(t_xla * 1e3, 3),
-            "pallas_ms": round(t_pal * 1e3, 3),
-            "speedup": round(t_xla / t_pal, 3),
-            "stats_rel_err": round(rel(s0, s1), 5),
-            "sumsq_rel_err": round(rel(q0, q1), 5),
-            "c_rel_err": round(rel(c0, c1), 5),
-        }))
+        def fused(x, w, scale, shift):
+            return conv_block(x, w, scale, shift, None, kernel, stride, True)
+
+        try:
+            t_x = timeit(unfused, x, w, scale, shift)
+            t_p = timeit(fused, x, w, scale, shift)
+            c0, s0, q0 = jax.jit(unfused)(x, w, scale, shift)
+            c1, s1, q1 = jax.jit(fused)(x, w, scale, shift)
+            rel = lambda a, b: float(
+                jnp.max(jnp.abs(a.astype(jnp.float32) - b.astype(jnp.float32)))
+                / (float(jnp.max(jnp.abs(b.astype(jnp.float32)))) + 1e-9))
+            rec.update({
+                "xla_ms": round(t_x * 1e3, 3),
+                "pallas_ms": round(t_p * 1e3, 3),
+                "speedup": round(t_x / t_p, 3),
+                "c_rel_err": round(rel(c1, c0), 5),
+                "stats_rel_err": round(max(rel(s1, s0), rel(q1, q0)), 5),
+            })
+            Ho = H // stride[0]
+            if t_x / t_p >= args.min_speedup and rec["c_rel_err"] < 2e-2:
+                wins[(kernel[0], K, N, Ho * Ho, stride[0])] = True
+        except Exception as exc:
+            rec["error"] = "%s: %s" % (type(exc).__name__, exc)
+        rows.append(rec)
+        print(json.dumps(rec))
+
+    measured = [r for r in rows if "speedup" in r]
+    won = [r for r in measured if (r["kernel"], r["K"], r["N"],
+                                   (r["H"] // r["stride"]) ** 2,
+                                   r["stride"]) in wins]
+    summary = {
+        "device": dev.device_kind, "batch": args.batch, "dtype": str(dt),
+        "sites_total": sum(r["count"] for r in rows),
+        "sites_measured": sum(r["count"] for r in measured),
+        "sites_won": sum(r["count"] for r in won),
+        "unique_measured": len(measured), "unique_won": len(won),
+    }
+    print(json.dumps({"summary": summary}))
+
+    if args.emit_table:
+        with open(_TABLE, "w") as f:
+            f.write('"""Per-shape engage table for the fused conv+BN Pallas '
+                    'path - GENERATED by\n``tools/fused_stats_bench.py '
+                    '--emit-table`` from on-chip measurements; do not\n'
+                    'hand-edit. Key: ``(kernel_size, C_in, C_out, '
+                    'H_out*W_out, stride)``; value\nTrue means the Pallas '
+                    'kernel beat the unfused XLA lowering for that shape on\n'
+                    'the measured device (fusion.gate engages it under '
+                    'MXNET_FUSED_CONV_BN=auto).\n\nMeasurement: %s\n"""\n\n'
+                    % json.dumps(summary))
+            f.write("DEVICE = %r\n\nWINS = {\n" % dev.device_kind)
+            for key in sorted(wins):
+                f.write("    %r: True,\n" % (key,))
+            f.write("}\n")
+        print(json.dumps({"table_written": _TABLE, "entries": len(wins)}))
 
 
 if __name__ == "__main__":
