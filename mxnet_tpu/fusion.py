@@ -38,7 +38,7 @@ import os
 import jax
 import jax.numpy as jnp
 
-from .ops.pallas_conv_bn import conv_block, supported
+from .ops.pallas_conv_bn import _xla_conv, conv_block, supported
 
 __all__ = ["plan", "execute", "resolve", "gate"]
 
@@ -249,24 +249,27 @@ def _table_device_matches():
         return False
 
 
-def gate(kernel, stride, x_shape, w_shape, dtype, prologue):
+def gate(kernel, stride, x_shape, w_shape, dtype, prologue, res=False):
     """Per-shape engage decision: env override, else the committed on-chip
-    WINS table (device-matched), else off. Untileable calls never engage."""
+    WINS table (device-matched, per measured VARIANT — 'p' prologue-only,
+    'pr' prologue+residual; bare convs have no measured contract and never
+    engage in auto mode), else off. Untileable calls never engage."""
     env = os.environ.get("MXNET_FUSED_CONV_BN", "auto")
     if env == "0" or not supported(x_shape, w_shape, stride,
                                    itemsize=jnp.dtype(dtype).itemsize,
-                                   prologue=prologue):
+                                   prologue=prologue, res=res):
         return False
     if env == "1":
         return True
-    if not _table_device_matches():
+    if not prologue or not _table_device_matches():
         return False
     from .ops.fused_conv_bn_table import WINS
 
     K = x_shape[1]
     N = w_shape[0]
     hw = (x_shape[2] // stride[0]) * (x_shape[3] // stride[1])
-    return WINS.get((kernel[0], K, N, hw, stride[0]), False)
+    variant = "pr" if res else "p"
+    return WINS.get((kernel[0], K, N, hw, stride[0], variant), False)
 
 
 # -------------------------------------------------------------------- execute
@@ -331,19 +334,16 @@ def _exec_conv(directive, node, ins):
         x, scale, shift, relu = v.raw, v.scale, v.shift, v.relu
     else:
         x, scale, shift, relu = resolve(v), None, None, False
-    if gate(kernel, stride, x.shape, w.shape, x.dtype, scale is not None):
+    if gate(kernel, stride, x.shape, w.shape, x.dtype, scale is not None,
+            res=directive["defer"]):
         if directive["defer"]:
             return PendingConv(x, w, scale, shift, relu, kernel, stride)
         c, s, q = conv_block(x, w, scale, shift, None, kernel, stride, relu)
         return WithStats(c, s, q)
     # fallback: materialize the normalized input (cached on the marker) and
-    # run the ordinary XLA conv
+    # run the ordinary XLA conv (shared lowering from pallas_conv_bn)
     xn = v.materialize() if isinstance(v, Deferred) else x
-    pad = (kernel[0] - 1) // 2
-    c = jax.lax.conv_general_dilated(
-        xn, w, window_strides=stride, padding=[(pad, pad), (pad, pad)],
-        dimension_numbers=("NCHW", "OIHW", "NCHW"))
-    return c
+    return _xla_conv(xn, w, None, None, None, kernel, stride, False)
 
 
 def _exec_resadd(directive, ins):

@@ -57,7 +57,7 @@ __all__ = ["conv_block", "supported", "plan_blocks", "choose_blocks"]
 _VMEM_BUDGET = 12 * 1024 * 1024
 
 
-def choose_blocks(B, K, N, HW, itemsize, taps=1, prologue=False):
+def choose_blocks(B, K, N, HW, itemsize, taps=1, prologue=False, res=False):
     """Pick the channel-stripe width ``bn`` (largest divisor of N, multiple
     of 8, that keeps the per-instance VMEM working set under budget) for the
     whole-HW tiling. Returns None if no stripe fits."""
@@ -69,6 +69,7 @@ def choose_blocks(B, K, N, HW, itemsize, taps=1, prologue=False):
             + 2 * bn * HW * itemsize       # c tile, double-buffered
             + bn * HW * 4                  # f32 accumulator
             + taps * bn * K * itemsize     # weight stripe
+            + (2 * bn * HW * itemsize if res else 0)  # residual stream, db
             + (K * HW * itemsize if (prologue or taps > 1) else 0)  # xn temp
             + (K * HW * itemsize if taps > 1 else 0)                # shifted temp
             + (taps * HW * 4 if taps > 1 else 0)                    # masks
@@ -78,12 +79,14 @@ def choose_blocks(B, K, N, HW, itemsize, taps=1, prologue=False):
     return None
 
 
-def plan_blocks(x_shape, w_shape, stride=(1, 1), itemsize=2, prologue=True):
+def plan_blocks(x_shape, w_shape, stride=(1, 1), itemsize=2, prologue=True,
+                res=False):
     """The kernel's tiling decision for a concrete call: the channel-stripe
     width ``bn``, or None when this conv cannot (or should not) run on the
     Pallas path. This is the single source of truth — ``supported`` and the
-    forward both call it, so a shape that passes the gate can never hit an
-    internal assert instead of the XLA fallback."""
+    forward both call it with the SAME flags (itemsize, prologue, residual),
+    so a call that passes the gate can never hit an internal assert instead
+    of the XLA fallback."""
     if len(x_shape) != 4 or len(w_shape) != 4 or itemsize > 4:
         return None
     B, K, H, W = x_shape
@@ -104,16 +107,17 @@ def plan_blocks(x_shape, w_shape, stride=(1, 1), itemsize=2, prologue=True):
     if K % 8 or H * W < 8:
         return None
     return choose_blocks(B, K, N, H * W, itemsize, taps=taps,
-                         prologue=prologue)
+                         prologue=prologue, res=res)
 
 
-def supported(x_shape, w_shape, stride=(1, 1), itemsize=2, prologue=True):
+def supported(x_shape, w_shape, stride=(1, 1), itemsize=2, prologue=True,
+              res=False):
     """Whether the Pallas path can run this conv at all (the per-shape
     win/lose decision against XLA is the WINS table in
     fused_conv_bn_table.py, not this predicate). Defaults assume the bf16
-    training path with a prologue — pass the real ``itemsize``/``prologue``
-    for exact answers."""
-    return plan_blocks(x_shape, w_shape, stride, itemsize, prologue) is not None
+    training path with a prologue — pass the real flags for exact answers."""
+    return plan_blocks(x_shape, w_shape, stride, itemsize, prologue,
+                       res) is not None
 
 
 def _shift_masks(H, W):
@@ -206,7 +210,7 @@ def _conv_block_fwd_impl(x, w, scale, shift, res, *, kernel_hw, stride,
     dt = x.dtype
     has_prologue = scale is not None
     bn = choose_blocks(B, K, N, HW, dt.itemsize, taps=taps,
-                       prologue=has_prologue)
+                       prologue=has_prologue, res=res is not None)
     assert bn is not None, (x.shape, w.shape)  # callers gate via plan_blocks
     n_tiles = N // bn
 
@@ -315,7 +319,7 @@ def _conv_block_fwd(x, w, scale, shift, res, kernel_hw, stride, relu,
                     use_pallas):
     if use_pallas and plan_blocks(
             x.shape, w.shape, stride, itemsize=x.dtype.itemsize,
-            prologue=scale is not None) is not None:
+            prologue=scale is not None, res=res is not None) is not None:
         c, s, q = _conv_block_fwd_impl(
             x, w, scale, shift, res, kernel_hw=kernel_hw, stride=stride,
             relu=relu, interpret=_interpret_mode())

@@ -126,42 +126,56 @@ def main():
         w = jnp.asarray(rs.randn(*w_shape) * 0.1, dt)
         scale = jnp.asarray(rs.uniform(0.5, 1.5, (K,)), jnp.float32)
         shift = jnp.asarray(rs.uniform(-0.2, 0.2, (K,)), jnp.float32)
+        Ho, Wo = H // stride[0], H // stride[1]
+        r = jnp.asarray(rs.randn(B, N, Ho, Wo) * 0.1, dt)
+        rel = lambda a, b: float(
+            jnp.max(jnp.abs(a.astype(jnp.float32) - b.astype(jnp.float32)))
+            / (float(jnp.max(jnp.abs(b.astype(jnp.float32)))) + 1e-9))
 
-        def unfused(x, w, scale, shift):
-            c = _xla_conv(x, w, scale, shift, None, kernel, stride, True)
-            s, q = _stats_of(c)
-            return c, s, q
+        # two measured contracts: 'p' = prologue-only (every in-graph conv
+        # with a folded BN), 'pr' = prologue + residual epilogue (convs
+        # deferred into the block's skip add). gate() engages exactly the
+        # variant that was measured.
+        for variant, res in (("p", None), ("pr", r)):
+            if res is not None and not supported(
+                    x_shape, w_shape, stride, itemsize=dt.itemsize,
+                    prologue=True, res=True):
+                continue
 
-        def fused(x, w, scale, shift):
-            return conv_block(x, w, scale, shift, None, kernel, stride, True)
+            def unfused(x, w, scale, shift, res=res):
+                c = _xla_conv(x, w, scale, shift, res, kernel, stride, True)
+                s, q = _stats_of(c)
+                return c, s, q
 
-        try:
-            t_x = timeit(unfused, x, w, scale, shift)
-            t_p = timeit(fused, x, w, scale, shift)
-            c0, s0, q0 = jax.jit(unfused)(x, w, scale, shift)
-            c1, s1, q1 = jax.jit(fused)(x, w, scale, shift)
-            rel = lambda a, b: float(
-                jnp.max(jnp.abs(a.astype(jnp.float32) - b.astype(jnp.float32)))
-                / (float(jnp.max(jnp.abs(b.astype(jnp.float32)))) + 1e-9))
-            rec.update({
-                "xla_ms": round(t_x * 1e3, 3),
-                "pallas_ms": round(t_p * 1e3, 3),
-                "speedup": round(t_x / t_p, 3),
-                "c_rel_err": round(rel(c1, c0), 5),
-                "stats_rel_err": round(max(rel(s1, s0), rel(q1, q0)), 5),
-            })
-            Ho = H // stride[0]
-            if t_x / t_p >= args.min_speedup and rec["c_rel_err"] < 2e-2:
-                wins[(kernel[0], K, N, Ho * Ho, stride[0])] = True
-        except Exception as exc:
-            rec["error"] = "%s: %s" % (type(exc).__name__, exc)
+            def fused(x, w, scale, shift, res=res):
+                return conv_block(x, w, scale, shift, res, kernel, stride,
+                                  True)
+
+            try:
+                t_x = timeit(unfused, x, w, scale, shift)
+                t_p = timeit(fused, x, w, scale, shift)
+                c0, s0, q0 = jax.jit(unfused)(x, w, scale, shift)
+                c1, s1, q1 = jax.jit(fused)(x, w, scale, shift)
+                rec.update({
+                    "xla_ms_%s" % variant: round(t_x * 1e3, 3),
+                    "pallas_ms_%s" % variant: round(t_p * 1e3, 3),
+                    "speedup_%s" % variant: round(t_x / t_p, 3),
+                    "c_rel_err_%s" % variant: round(rel(c1, c0), 5),
+                    "stats_rel_err_%s" % variant:
+                        round(max(rel(s1, s0), rel(q1, q0)), 5),
+                })
+                if (t_x / t_p >= args.min_speedup
+                        and rec["c_rel_err_%s" % variant] < 2e-2):
+                    wins[(kernel[0], K, N, Ho * Ho, stride[0], variant)] = True
+            except Exception as exc:
+                rec["error_%s" % variant] = "%s: %s" % (type(exc).__name__, exc)
         rows.append(rec)
         print(json.dumps(rec))
 
-    measured = [r for r in rows if "speedup" in r]
+    measured = [r for r in rows if "speedup_p" in r]
     won = [r for r in measured if (r["kernel"], r["K"], r["N"],
                                    (r["H"] // r["stride"]) ** 2,
-                                   r["stride"]) in wins]
+                                   r["stride"], "p") in wins]
     summary = {
         "device": dev.device_kind, "batch": args.batch, "dtype": str(dt),
         "sites_total": sum(r["count"] for r in rows),
@@ -177,9 +191,11 @@ def main():
                     'path - GENERATED by\n``tools/fused_stats_bench.py '
                     '--emit-table`` from on-chip measurements; do not\n'
                     'hand-edit. Key: ``(kernel_size, C_in, C_out, '
-                    'H_out*W_out, stride)``; value\nTrue means the Pallas '
-                    'kernel beat the unfused XLA lowering for that shape on\n'
-                    'the measured device (fusion.gate engages it under '
+                    'H_out*W_out, stride, variant)`` with\nvariant "p" = '
+                    'prologue-only, "pr" = prologue+residual; value True '
+                    'means the\nPallas kernel beat the unfused XLA lowering '
+                    'for that measured contract on\nthe measured device '
+                    '(fusion.gate engages it under '
                     'MXNET_FUSED_CONV_BN=auto).\n\nMeasurement: %s\n"""\n\n'
                     % json.dumps(summary))
             f.write("DEVICE = %r\n\nWINS = {\n" % dev.device_kind)
