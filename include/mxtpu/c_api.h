@@ -1,0 +1,104 @@
+/*
+ * Training-side C ABI (minimal imperative slice).
+ *
+ * Reference surface: include/mxnet/c_api.h (115 functions). This is the
+ * ~20-function subset that makes end-to-end training reachable from C or a
+ * foreign-language binding: NDArray CRUD + synchronous host copies,
+ * imperative op invocation by registered name (the reference's
+ * MXImperativeInvoke, src/c_api/c_api_ndarray.cc:322, keyed by
+ * AtomicSymbolCreator; here ops are addressed by their registry name),
+ * executor bind/forward/backward over a symbol JSON, and KVStore
+ * init/push/pull. The compute path is XLA behind the mxnet_tpu package; this
+ * ABI embeds CPython exactly like c_predict_api (src/predict_api.cc) and is
+ * GIL-correct from any thread.
+ *
+ * Conventions: every function returns 0 on success, -1 on failure with the
+ * message available from MXGetLastError() (thread-local). Pointer outputs
+ * returned by List/GetShape calls point at handle-owned storage valid until
+ * the next call on the same handle.
+ */
+#ifndef MXTPU_C_API_H_
+#define MXTPU_C_API_H_
+
+#include <stdint.h>
+#include <stddef.h>
+
+#ifdef __cplusplus
+extern "C" {
+#endif
+
+typedef uint32_t mx_uint;
+typedef void* NDArrayHandle;
+typedef void* ExecutorHandle;
+typedef void* KVStoreHandle;
+
+const char* MXGetLastError(void);
+
+/* ---- NDArray ---------------------------------------------------------- */
+/* Create a zero-initialized float32 NDArray on the default context.
+ * (dev_type/dev_id accepted for reference-signature compatibility; device
+ * placement is the embedding process's MXNET_DEFAULT_CONTEXT.) */
+int MXNDArrayCreate(const mx_uint* shape, mx_uint ndim, int dev_type,
+                    int dev_id, int delay_alloc, NDArrayHandle* out);
+int MXNDArrayFree(NDArrayHandle handle);
+int MXNDArraySyncCopyFromCPU(NDArrayHandle handle, const float* data,
+                             size_t size);
+int MXNDArraySyncCopyToCPU(NDArrayHandle handle, float* data, size_t size);
+int MXNDArrayGetShape(NDArrayHandle handle, mx_uint* out_dim,
+                      const mx_uint** out_pdata);
+int MXNDArrayWaitAll(void);
+
+/* ---- Imperative invoke ------------------------------------------------ */
+/* Invoke a registered op by name. If *num_outputs is 0 on entry the op
+ * allocates its outputs and *outputs points at handle storage owned by the
+ * library (valid until the next invoke on this thread; the caller owns the
+ * returned handles and must MXNDArrayFree them). If *num_outputs > 0,
+ * *outputs supplies write-target arrays (in-place update, the optimizer-op
+ * idiom). Attribute values are strings, parsed exactly like symbol JSON. */
+int MXImperativeInvokeByName(const char* op_name, int num_inputs,
+                             NDArrayHandle* inputs, int* num_outputs,
+                             NDArrayHandle** outputs, int num_params,
+                             const char** param_keys,
+                             const char** param_vals);
+
+/* ---- Executor (bind by symbol JSON) ----------------------------------- */
+/* simple_bind: infer every shape from the named input shapes (CSR layout as
+ * in MXPredCreate), allocate args/grads (grad_req=write), return a training
+ * executor. */
+int MXTrainExecutorCreate(const char* symbol_json, mx_uint num_inputs,
+                          const char** input_keys,
+                          const mx_uint* input_shape_indptr,
+                          const mx_uint* input_shape_data,
+                          ExecutorHandle* out);
+int MXExecutorForward(ExecutorHandle handle, int is_train);
+/* head_grads may be NULL (loss-style outputs supply their own). */
+int MXExecutorBackward(ExecutorHandle handle, mx_uint num_head,
+                       NDArrayHandle* head_grads);
+int MXExecutorNumOutputs(ExecutorHandle handle, int* out);
+int MXExecutorGetOutput(ExecutorHandle handle, mx_uint index,
+                        NDArrayHandle* out);
+/* Names valid until the handle is freed. */
+int MXExecutorListArguments(ExecutorHandle handle, mx_uint* out_size,
+                            const char*** out_names);
+int MXExecutorGetArg(ExecutorHandle handle, const char* name,
+                     NDArrayHandle* out);
+/* *out is NULL (rc 0) for inputs with no gradient (data/labels). */
+int MXExecutorGetGrad(ExecutorHandle handle, const char* name,
+                      NDArrayHandle* out);
+int MXExecutorFree(ExecutorHandle handle);
+
+/* ---- KVStore ---------------------------------------------------------- */
+int MXKVStoreCreate(const char* type, KVStoreHandle* out);
+int MXKVStoreInit(KVStoreHandle handle, mx_uint num, const int* keys,
+                  NDArrayHandle* vals);
+int MXKVStorePush(KVStoreHandle handle, mx_uint num, const int* keys,
+                  NDArrayHandle* vals, int priority);
+int MXKVStorePull(KVStoreHandle handle, mx_uint num, const int* keys,
+                  NDArrayHandle* outs, int priority);
+int MXKVStoreFree(KVStoreHandle handle);
+
+#ifdef __cplusplus
+}  /* extern "C" */
+#endif
+
+#endif  /* MXTPU_C_API_H_ */

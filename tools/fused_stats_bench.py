@@ -172,16 +172,22 @@ def main():
         rows.append(rec)
         print(json.dumps(rec))
 
-    measured = [r for r in rows if "speedup_p" in r]
-    won = [r for r in measured if (r["kernel"], r["K"], r["N"],
-                                   (r["H"] // r["stride"]) ** 2,
-                                   r["stride"], "p") in wins]
+    def _key(r, variant):
+        return (r["kernel"], r["K"], r["N"], (r["H"] // r["stride"]) ** 2,
+                r["stride"], variant)
+
+    measured = [r for r in rows
+                if "speedup_p" in r or "speedup_pr" in r]
+    won_p = [r for r in measured if _key(r, "p") in wins]
+    won_pr = [r for r in measured if _key(r, "pr") in wins]
     summary = {
         "device": dev.device_kind, "batch": args.batch, "dtype": str(dt),
         "sites_total": sum(r["count"] for r in rows),
         "sites_measured": sum(r["count"] for r in measured),
-        "sites_won": sum(r["count"] for r in won),
-        "unique_measured": len(measured), "unique_won": len(won),
+        "sites_won_p": sum(r["count"] for r in won_p),
+        "sites_won_pr": sum(r["count"] for r in won_pr),
+        "unique_measured": len(measured),
+        "unique_won_p": len(won_p), "unique_won_pr": len(won_pr),
     }
     print(json.dumps({"summary": summary}))
 
