@@ -15,14 +15,23 @@ from __future__ import annotations
 import logging
 import os
 
-__all__ = ["init", "is_initialized", "rank", "num_workers", "shutdown"]
+__all__ = ["init", "is_initialized", "rank", "num_workers", "shutdown",
+           "num_dead_nodes"]
 
 # env contract with tools/launch.py (the DMLC_* vars of the reference)
 ENV_COORDINATOR = "MXNET_TPU_COORDINATOR"  # host:port of process 0
 ENV_NUM_WORKERS = "MXNET_TPU_NUM_WORKERS"
 ENV_WORKER_ID = "MXNET_TPU_WORKER_ID"
+# failure detection (reference: ps-lite heartbeats scanned by
+# kvstore_dist.h:158-167 behind KVStore::get_num_dead_node,
+# include/mxnet/kvstore.h:234-244): each worker touches
+# $MXNET_TPU_HEARTBEAT_DIR/worker-<rank> on a timer; the launcher (and
+# num_dead_nodes below) treat a stale file as a dead/hung worker
+ENV_HEARTBEAT_DIR = "MXNET_TPU_HEARTBEAT_DIR"
+ENV_HEARTBEAT_INTERVAL = "MXNET_TPU_HEARTBEAT_INTERVAL"
 
 _initialized = False
+_heartbeat_thread = None
 
 
 def is_initialized() -> bool:
@@ -64,8 +73,64 @@ def init(coordinator_address=None, num_processes=None, process_id=None):
             "NDArrays or binding modules. Original error: %s" % e
         ) from e
     _initialized = True
+    _start_heartbeat(process_id)
     logging.info("mxnet_tpu.dist: worker %d/%d connected to %s",
                  process_id, num_processes, coordinator_address)
+
+
+def _start_heartbeat(process_id):
+    """Touch the per-worker heartbeat file on a timer (daemon thread). A
+    killed/frozen/OOM-thrashed worker stops beating and the launcher's
+    watchdog (tools/launch.py) sees the stale file. Note the limit: a worker
+    whose MAIN thread is deadlocked in a collective keeps beating (the
+    daemon thread is alive) — liveness here means 'process running', the
+    same contract as the reference's ps-lite node heartbeats."""
+    global _heartbeat_thread
+    hb_dir = os.environ.get(ENV_HEARTBEAT_DIR)
+    if not hb_dir or _heartbeat_thread is not None:
+        return
+    import threading
+    import time
+
+    interval = float(os.environ.get(ENV_HEARTBEAT_INTERVAL, "5"))
+    path = os.path.join(hb_dir, "worker-%d" % process_id)
+
+    def beat():
+        while _initialized:
+            try:
+                os.makedirs(hb_dir, exist_ok=True)
+                with open(path, "a"):
+                    os.utime(path, None)
+            except OSError:
+                pass
+            time.sleep(interval)
+
+    _heartbeat_thread = threading.Thread(target=beat, daemon=True,
+                                         name="mxtpu-heartbeat")
+    _heartbeat_thread.start()
+
+
+def num_dead_nodes(timeout=60.0):
+    """Count workers whose heartbeat is missing or older than ``timeout``
+    seconds (reference: KVStore::get_num_dead_node,
+    include/mxnet/kvstore.h:234-244). Returns 0 when heartbeating is not
+    configured (single-process, or launcher without a heartbeat dir)."""
+    import time
+
+    hb_dir = os.environ.get(ENV_HEARTBEAT_DIR)
+    if not hb_dir or not os.path.isdir(hb_dir):
+        return 0
+    n = int(os.environ.get(ENV_NUM_WORKERS, "1"))
+    now = time.time()
+    dead = 0
+    for r in range(n):
+        path = os.path.join(hb_dir, "worker-%d" % r)
+        try:
+            if now - os.path.getmtime(path) > timeout:
+                dead += 1
+        except OSError:
+            dead += 1  # never heartbeated
+    return dead
 
 
 def rank() -> int:
@@ -81,9 +146,10 @@ def num_workers() -> int:
 
 
 def shutdown():
-    global _initialized
+    global _initialized, _heartbeat_thread
     if _initialized:
         import jax
 
         jax.distributed.shutdown()
         _initialized = False
+        _heartbeat_thread = None  # a later init() must restart the beat

@@ -97,3 +97,110 @@ def test_rcnn_example_end_to_end():
         capture_output=True, text=True, timeout=500, env=env)
     assert r.returncode == 0, (r.stderr or r.stdout)[-800:]
     assert "RCNN end-to-end training finished" in r.stdout
+
+
+@pytest.mark.slow
+def test_launcher_restarts_after_worker_death(tmp_path):
+    """VERDICT r4 #6 done-criterion: worker 1 of 2 dies mid-run; the
+    launcher detects it, tears down, relaunches with --auto-restart, and
+    the job resumes from rank 0's checkpoint to the closed-form answer."""
+    env = {k: v for k, v in os.environ.items()
+           if k not in ("XLA_FLAGS", "MXNET_TPU_COORDINATOR")}
+    proc = subprocess.run(
+        [sys.executable, os.path.join(ROOT, "tools", "launch.py"), "-n", "2",
+         "--launcher", "local", "--cpu-devices", "1", "--auto-restart", "1",
+         "--heartbeat-timeout", "120",
+         sys.executable, os.path.join(ROOT, "tests", "nightly",
+                                      "dist_crash_resume.py"), str(tmp_path)],
+        capture_output=True, text=True, timeout=600, cwd=ROOT, env=env)
+    assert proc.returncode == 0, (proc.stdout[-1000:], proc.stderr[-2000:])
+    assert "simulating death" in proc.stdout
+    assert "restart 1/1" in proc.stderr
+    assert "resumed from epoch" in proc.stdout
+    # both workers reached the closed-form final value
+    assert proc.stdout.count("OK") == 2
+    # the crash marker proves the death happened on attempt 1
+    assert (tmp_path / "crashed-once").exists()
+
+
+@pytest.mark.slow
+def test_launcher_detects_hung_worker(tmp_path):
+    """A worker that wedges (no exit, no heartbeat progress is NOT the
+    trigger here — the heartbeat thread keeps beating; the trigger is a
+    worker whose PROCESS stops beating, simulated with SIGSTOP-like sleep
+    via a worker that never starts heartbeating) is detected by the
+    heartbeat watchdog and the job is torn down instead of hanging."""
+    script = textwrap.dedent("""
+        import os, sys, time
+        rank = int(os.environ["MXNET_TPU_WORKER_ID"])
+        hb = os.environ["MXNET_TPU_HEARTBEAT_DIR"]
+        if rank == 0:
+            # beat by hand, then wait (worker 1 never beats: wedged pre-init)
+            for _ in range(200):
+                open(os.path.join(hb, "worker-0"), "a").close()
+                os.utime(os.path.join(hb, "worker-0"))
+                time.sleep(0.1)
+        else:
+            open(os.path.join(hb, "worker-1"), "a").close()
+            os.utime(os.path.join(hb, "worker-1"),
+                     (time.time() - 3600, time.time() - 3600))
+            time.sleep(600)  # wedged: heartbeat never advances
+    """)
+    env = {k: v for k, v in os.environ.items()
+           if k not in ("XLA_FLAGS", "MXNET_TPU_COORDINATOR")}
+    proc = subprocess.run(
+        [sys.executable, os.path.join(ROOT, "tools", "launch.py"), "-n", "2",
+         "--launcher", "local", "--heartbeat-timeout", "3",
+         "--heartbeat-interval", "0.5",
+         sys.executable, "-c", script],
+        capture_output=True, text=True, timeout=120, cwd=ROOT, env=env)
+    assert proc.returncode == 124, (proc.returncode, proc.stderr[-800:])
+    assert "heartbeat stale" in proc.stderr
+
+
+def test_num_dead_nodes_counts_stale_heartbeats(tmp_path, monkeypatch):
+    """kv.num_dead_nodes analog (reference kvstore.h:234-244): stale or
+    missing heartbeat files count as dead."""
+    import time
+
+    from mxnet_tpu import dist
+
+    hb = tmp_path / "hb"
+    hb.mkdir()
+    monkeypatch.setenv("MXNET_TPU_HEARTBEAT_DIR", str(hb))
+    monkeypatch.setenv("MXNET_TPU_NUM_WORKERS", "3")
+    now = time.time()
+    (hb / "worker-0").touch()
+    (hb / "worker-1").touch()
+    os.utime(hb / "worker-1", (now - 400, now - 400))  # stale
+    # worker-2 never heartbeated
+    assert dist.num_dead_nodes(timeout=60) == 2
+    assert dist.num_dead_nodes(timeout=1000) == 1  # only the missing one
+
+
+@pytest.mark.slow
+def test_launcher_ignores_finished_workers_heartbeat(tmp_path):
+    """A worker that exits 0 early must NOT be declared stale while the
+    rest keep running past the heartbeat timeout (review regression)."""
+    script = textwrap.dedent("""
+        import os, sys, time
+        rank = int(os.environ["MXNET_TPU_WORKER_ID"])
+        hb = os.environ["MXNET_TPU_HEARTBEAT_DIR"]
+        p = os.path.join(hb, "worker-%d" % rank)
+        open(p, "a").close()
+        if rank == 1:
+            sys.exit(0)  # done early; its heartbeat file freezes
+        for _ in range(80):  # keep running ~8s >> the 2s timeout
+            open(p, "a").close(); os.utime(p)
+            time.sleep(0.1)
+    """)
+    env = {k: v for k, v in os.environ.items()
+           if k not in ("XLA_FLAGS", "MXNET_TPU_COORDINATOR")}
+    proc = subprocess.run(
+        [sys.executable, os.path.join(ROOT, "tools", "launch.py"), "-n", "2",
+         "--launcher", "local", "--heartbeat-timeout", "2",
+         "--heartbeat-interval", "0.5",
+         sys.executable, "-c", script],
+        capture_output=True, text=True, timeout=120, cwd=ROOT, env=env)
+    assert proc.returncode == 0, (proc.returncode, proc.stderr[-800:])
+    assert "heartbeat stale" not in proc.stderr

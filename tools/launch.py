@@ -37,11 +37,14 @@ def _free_port():
         return s.getsockname()[1]
 
 
-def _worker_env(base, args, coordinator, rank):
+def _worker_env(base, args, coordinator, rank, hb_dir=None):
     env = dict(base)
     env["MXNET_TPU_COORDINATOR"] = coordinator
     env["MXNET_TPU_NUM_WORKERS"] = str(args.num_workers)
     env["MXNET_TPU_WORKER_ID"] = str(rank)
+    if hb_dir:
+        env["MXNET_TPU_HEARTBEAT_DIR"] = hb_dir
+        env["MXNET_TPU_HEARTBEAT_INTERVAL"] = str(args.heartbeat_interval)
     if args.cpu_devices:
         flags = env.get("XLA_FLAGS", "")
         env["XLA_FLAGS"] = (
@@ -51,40 +54,112 @@ def _worker_env(base, args, coordinator, rank):
     return env
 
 
-def _wait_all(procs):
-    """Wait for every worker; if one fails, terminate the rest instead of
-    blocking forever on survivors stuck in collective init."""
+def _stale_worker(hb_dir, ranks, timeout):
+    """Rank (among the still-LIVE ranks) whose heartbeat went stale, else
+    None. Exited workers are excluded — a finished worker's frozen file is
+    not a failure."""
+    import time
+
+    now = time.time()
+    for r in ranks:
+        path = os.path.join(hb_dir, "worker-%d" % r)
+        try:
+            if now - os.path.getmtime(path) > timeout:
+                return r
+        except OSError:
+            pass  # not written yet: startup, covered by process polling
+    return None
+
+
+def _terminate(procs, grace=10):
+    """SIGTERM, wait up to ``grace`` seconds, then SIGKILL — a worker
+    blocked in a dead collective cannot run a SIGTERM handler."""
+    import time
+
+    for p in procs:
+        if p.poll() is None:
+            p.send_signal(signal.SIGTERM)
+    deadline = time.time() + grace
+    while any(p.poll() is None for p in procs) and time.time() < deadline:
+        time.sleep(0.2)
+    for p in procs:
+        if p.poll() is None:
+            p.kill()
+    for p in procs:
+        try:
+            p.wait(timeout=10)
+        except subprocess.TimeoutExpired:
+            pass
+
+
+def _wait_all(procs, hb_dir=None, hb_timeout=0):
+    """Wait for every worker. Failure detection (reference: ps-lite
+    heartbeats behind KVStore::get_num_dead_node, kvstore.h:234-244 /
+    kvstore_dist.h:158-167): a nonzero exit, OR a stale heartbeat from a
+    live worker process (catches frozen/SIGSTOPped/OOM-thrashed workers
+    whose runtime stopped beating — NOT a live-but-deadlocked collective,
+    whose heartbeat thread keeps running; that case needs job-level
+    timeouts), terminates the whole job with SIGTERM-then-SIGKILL — the
+    caller decides whether to restart from the last checkpoint."""
     import time
 
     code = 0
-    live = list(procs)
+    live = dict(enumerate(procs))  # rank -> proc (Popen order is rank order)
+    failed = False
     while live:
-        for p in list(live):
+        for r, p in list(live.items()):
             rc = p.poll()
             if rc is None:
                 continue
-            live.remove(p)
+            del live[r]
             if rc != 0:
                 code = code or rc
-                for q in live:
-                    if q.poll() is None:
-                        q.send_signal(signal.SIGTERM)
+                failed = True
+        if not failed and hb_dir and hb_timeout > 0 and live:
+            stale = _stale_worker(hb_dir, sorted(live), hb_timeout)
+            if stale is not None:
+                sys.stderr.write(
+                    "launch: worker %d heartbeat stale > %gs — declaring the "
+                    "job dead\n" % (stale, hb_timeout))
+                code = 124
+                failed = True
+        if failed and live:
+            _terminate(list(live.values()))
         time.sleep(0.2)
     return code
 
 
 def launch_local(args, command):
-    coordinator = "127.0.0.1:%d" % _free_port()
-    procs = []
-    try:
-        for rank in range(args.num_workers):
-            env = _worker_env(os.environ, args, coordinator, rank)
-            procs.append(subprocess.Popen(command, env=env))
-        return _wait_all(procs)
-    finally:
-        for p in procs:
-            if p.poll() is None:
-                p.send_signal(signal.SIGTERM)
+    """Run the job; on worker death/freeze, tear down and relaunch up to
+    ``--auto-restart`` times. Training scripts resume from their last
+    checkpoint (model.find_last_checkpoint / fit(begin_epoch=...))."""
+    import shutil
+    import tempfile
+
+    attempts = 0
+    while True:
+        coordinator = "127.0.0.1:%d" % _free_port()
+        hb_dir = tempfile.mkdtemp(prefix="mxtpu-hb-") \
+            if args.heartbeat_timeout > 0 else None
+        procs = []
+        try:
+            for rank in range(args.num_workers):
+                env = _worker_env(os.environ, args, coordinator, rank, hb_dir)
+                procs.append(subprocess.Popen(command, env=env))
+            code = _wait_all(procs, hb_dir, args.heartbeat_timeout)
+        finally:
+            # every old worker must be DEAD before cleanup/relaunch: a
+            # straggler could race the next attempt's checkpoint resume (and
+            # its beat thread would recreate hb_dir after rmtree)
+            _terminate(procs)
+            if hb_dir:
+                shutil.rmtree(hb_dir, ignore_errors=True)
+        if code == 0 or attempts >= args.auto_restart:
+            return code
+        attempts += 1
+        sys.stderr.write(
+            "launch: job failed (rc=%d) — restart %d/%d from last "
+            "checkpoint\n" % (code, attempts, args.auto_restart))
 
 
 def launch_ssh(args, command):
@@ -130,6 +205,17 @@ def main():
     parser.add_argument("--cpu-devices", type=int, default=0,
                         help="give each worker this many virtual CPU devices "
                              "(multi-host testing without TPU hardware)")
+    parser.add_argument("--auto-restart", type=int, default=0,
+                        help="(local) relaunch the whole job up to this many "
+                             "times after a worker dies or hangs; workers "
+                             "resume from their last checkpoint")
+    parser.add_argument("--heartbeat-timeout", type=float, default=60.0,
+                        help="(local) declare the job dead when a LIVE "
+                             "worker's heartbeat file is older than this "
+                             "many seconds — catches frozen/stopped worker "
+                             "processes (0 disables)")
+    parser.add_argument("--heartbeat-interval", type=float, default=5.0,
+                        help="how often workers touch their heartbeat file")
     parser.add_argument("command", nargs=argparse.REMAINDER,
                         help="the training command to run on every worker")
     args = parser.parse_args()
