@@ -38,17 +38,23 @@ def greedy_decode(mod, src, seq_len, batch_size):
     """Argmax decoding, one position per pass through the fixed-shape
     decoder."""
     n = src.shape[0]
-    dec = np.full((n, seq_len), BOS, dtype="float32")
+    # pad up to a whole number of batches: predict's per-batch pad trimming
+    # assumes batch-row outputs, but this model emits batch*seq_len rows per
+    # batch, so a partial final batch would misalign the concatenation
+    n_pad = (-n) % batch_size
+    src = np.concatenate([src, np.repeat(src[:1], n_pad, axis=0)]) \
+        if n_pad else src
+    dec = np.full((n + n_pad, seq_len), BOS, dtype="float32")
     out = np.zeros((n, seq_len), dtype="int64")
     for t in range(seq_len):
         it = mx.io.NDArrayIter({"data": src, "dec_data": dec},
                                batch_size=batch_size,
-                               last_batch_handle="pad")
-        scores = mod.predict(it).asnumpy()[:n * seq_len]  # (B*T, vocab) rows
-        step = scores.reshape(n, seq_len, -1)[:, t, :].argmax(axis=1)
+                               last_batch_handle="discard")
+        scores = mod.predict(it).asnumpy()
+        step = scores.reshape(n + n_pad, seq_len, -1)[:n, t, :].argmax(axis=1)
         out[:, t] = step
         if t + 1 < seq_len:
-            dec[:, t + 1] = step
+            dec[:n, t + 1] = step
     return out
 
 

@@ -251,6 +251,13 @@ class Executor:
         """Run forward; optional kwargs copy new values into bound args
         (reference: executor.py forward).
 
+        Cost note: every train-mode forward re-runs the jax.vjp
+        linearization (a Python retrace, unlike the cached fused
+        forward_backward program) and pins the residual set on device until
+        ``backward()`` or the next forward — callers that never backward
+        should pass ``is_train=False`` (or use Module's fused path) to skip
+        both costs.
+
         With ``is_train=True`` the forward is run under ``jax.vjp`` and the
         vjp closure (holding the forward-time residuals on device, like the
         reference's retained activations) is cached so a later

@@ -317,9 +317,11 @@ class ImageRecordIter(_io.DataIter):
         native_ok = (aug_list is None and pad == 0 and num_parts == 1
                      and not (brightness or contrast or saturation)
                      and data_shape[0] == 3
-                     # subclasses (ImageDetIter) post-process labels in ways
-                     # the fixed-width native label copy can't express
-                     and type(self) is ImageRecordIter)
+                     # classes that know how to consume the native batches:
+                     # ImageDetIter rides them bbox-aware via the pipeline's
+                     # per-sample augment records (unknown subclasses fall
+                     # back to the Python path)
+                     and type(self) in (ImageRecordIter, ImageDetIter))
         if native_ok:
             from . import image_native
 
@@ -342,7 +344,7 @@ class ImageRecordIter(_io.DataIter):
                         rand_mirror=rand_mirror,
                         mean=(mean_r, mean_g, mean_b),
                         std=(std_r, std_g, std_b),
-                        label_width=label_width,
+                        label_width=getattr(self, "_native_lw", label_width),
                         shuffle_buf=4096 if shuffle else 0, seed=seed,
                         idx_path=idx if shuffle else None)
                 except Exception:
@@ -452,21 +454,81 @@ ImageRecordUInt8Iter = ImageRecordIter
 class ImageDetIter(ImageRecordIter):
     """Detection variant (reference: ImageDetRecordIter,
     src/io/iter_image_det_recordio.cc:563): labels are variable-length
-    ``[cls, xmin, ymin, xmax, ymax]`` rows, padded with -1 to
-    ``(batch, max_objects, 5)``."""
+    ``[cls, xmin, ymin, xmax, ymax]`` rows (coords normalized to the
+    original image), padded with -1 to ``(batch, max_objects, 5)``.
+
+    Rides the native C++ decode/augment pipeline bbox-aware (reference:
+    src/io/image_det_aug_default.cc did the box math in C++): pixels are
+    cropped/mirrored natively and the boxes are transformed here from each
+    sample's augment record {pre-crop W/H, crop origin, mirror} — an
+    aspect-preserving resize leaves normalized coords unchanged, so crop
+    geometry + mirror is the whole transform. Boxes are clipped to the crop
+    and dropped when degenerate. The Python fallback path (custom aug_list,
+    pad, jitter...) does NOT adjust boxes for crop/mirror — it warns when
+    those augments are requested."""
 
     def __init__(self, *args, max_objects=8, **kwargs):
         self._max_objects = max_objects
+        # native label copy: room for max_objects rows (extra rows are
+        # truncated, matching _scalar_label)
+        self._native_lw = max_objects * 5
         kwargs.setdefault("label_name", "label")
         super().__init__(*args, **kwargs)
         self.provide_label = [_io.DataDesc(
             self.label_name, (self.batch_size, max_objects, 5))]
+        if self._native is None and (kwargs.get("rand_crop")
+                                     or kwargs.get("rand_mirror")):
+            import logging
+
+            logging.warning(
+                "ImageDetIter: Python fallback path does not adjust bboxes "
+                "for rand_crop/rand_mirror — use the native pipeline "
+                "(default augments, MXNET_NATIVE_IMAGE_PIPELINE=1) for "
+                "geometry-consistent detection labels")
 
     def _scalar_label(self, label):
         rows = np.asarray(label, np.float32).reshape(-1, 5)
         out = -np.ones((self._max_objects, 5), np.float32)
         out[: min(len(rows), self._max_objects)] = rows[: self._max_objects]
         return out
+
+    def _next_native(self):
+        self._started = True
+        data, labels, aug, n = self._native.next_batch(with_aug=True)
+        if n == 0 or (not self._round_batch and n < self.batch_size):
+            raise StopIteration
+        data = data.copy()  # the pipeline reuses its staging buffers
+        out_h, out_w = self.data_shape[1], self.data_shape[2]
+        lab = -np.ones((self.batch_size, self._max_objects, 5), np.float32)
+        for j in range(n):
+            length = int(aug[j, 5])
+            rows = labels[j, : length - (length % 5)].reshape(-1, 5).copy()
+            W, H, x0, y0, mirror = aug[j, :5]
+            identity = (x0 == 0 and y0 == 0 and mirror == 0
+                        and W == out_w and H == out_h)
+            if len(rows) and not identity:
+                rows[:, 1] = (rows[:, 1] * W - x0) / out_w
+                rows[:, 3] = (rows[:, 3] * W - x0) / out_w
+                rows[:, 2] = (rows[:, 2] * H - y0) / out_h
+                rows[:, 4] = (rows[:, 4] * H - y0) / out_h
+                if mirror:
+                    rows[:, 1], rows[:, 3] = 1.0 - rows[:, 3], 1.0 - rows[:, 1]
+                # clip to the crop, drop boxes the crop removed — ONLY when
+                # geometry changed (an un-augmented record's rows pass
+                # through verbatim, matching the Python path exactly)
+                np.clip(rows[:, 1:], 0.0, 1.0, out=rows[:, 1:])
+                keep = ((rows[:, 3] - rows[:, 1] > 1e-4)
+                        & (rows[:, 4] - rows[:, 2] > 1e-4))
+                rows = rows[keep]
+            rows = rows[: self._max_objects]
+            lab[j, : len(rows)] = rows
+        for j in range(n, self.batch_size):  # round_batch tail pad
+            data[j] = data[j % n]
+            lab[j] = lab[j % n]
+        return _io.DataBatch(
+            data=[nd.array(data)], label=[nd.array(lab)],
+            pad=self.batch_size - n,
+            provide_data=self.provide_data, provide_label=self.provide_label)
 
 
 class ImageIter(_io.DataIter):

@@ -55,6 +55,10 @@ def _load():
         lib.mximg_next_batch.argtypes = [
             ctypes.c_void_p, ctypes.POINTER(ctypes.c_float),
             ctypes.POINTER(ctypes.c_float)]
+        lib.mximg_next_batch_aug.restype = ctypes.c_int
+        lib.mximg_next_batch_aug.argtypes = [
+            ctypes.c_void_p, ctypes.POINTER(ctypes.c_float),
+            ctypes.POINTER(ctypes.c_float), ctypes.POINTER(ctypes.c_float)]
         lib.mximg_reset.argtypes = [ctypes.c_void_p, ctypes.c_int]
         lib.mximg_decode_errors.restype = ctypes.c_long
         lib.mximg_decode_errors.argtypes = [ctypes.c_void_p]
@@ -97,18 +101,28 @@ class NativeImagePipeline:
             raise IOError("cannot open %r" % path)
         self._data = np.empty((batch_size, c, h, w), np.float32)
         self._labels = np.empty((batch_size, label_width), np.float32)
+        self._aug = np.empty((batch_size, 6), np.float32)
 
-    def next_batch(self):
+    def next_batch(self, with_aug=False):
         """(data, labels, n) — n < batch_size marks the epoch's tail; n == 0
-        means exhausted. The returned arrays are reused between calls.
-        Raises on mid-file corruption (the Python reader's invalid-magic
-        contract)."""
-        n = self._lib.mximg_next_batch(
-            self._handle,
-            self._data.ctypes.data_as(ctypes.POINTER(ctypes.c_float)),
-            self._labels.ctypes.data_as(ctypes.POINTER(ctypes.c_float)))
+        means exhausted. With ``with_aug``: (data, labels, aug, n) where aug
+        is (batch, 6) float {pre-crop W, pre-crop H, crop x0, crop y0,
+        mirror, true label length} per sample — the geometry a bbox-aware
+        consumer (ImageDetIter) needs to transform detection labels. The
+        returned arrays are reused between calls. Raises on mid-file
+        corruption (the Python reader's invalid-magic contract)."""
+        dp = self._data.ctypes.data_as(ctypes.POINTER(ctypes.c_float))
+        lp = self._labels.ctypes.data_as(ctypes.POINTER(ctypes.c_float))
+        if with_aug:
+            n = self._lib.mximg_next_batch_aug(
+                self._handle, dp, lp,
+                self._aug.ctypes.data_as(ctypes.POINTER(ctypes.c_float)))
+        else:
+            n = self._lib.mximg_next_batch(self._handle, dp, lp)
         if self._lib.mximg_file_error(self._handle):
             raise IOError("invalid RecordIO framing mid-file (corrupt .rec)")
+        if with_aug:
+            return self._data, self._labels, self._aug, int(n)
         return self._data, self._labels, int(n)
 
     def reset(self):

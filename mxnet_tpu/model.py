@@ -9,6 +9,7 @@ interoperate (SURVEY.md §5.4).
 from __future__ import annotations
 
 import logging
+import os
 
 import numpy as np
 
@@ -29,15 +30,68 @@ __all__ = ["save_checkpoint", "load_checkpoint", "find_last_checkpoint",
            "resume_or_init", "FeedForward"]
 
 
+# per-prefix engine variables: successive epoch writes to one prefix are
+# serialized; readers (load/find_last_checkpoint) wait on the same var
+_ckpt_vars = {}
+# a failed async write must not vanish: the error re-raises at the next
+# save/load/find on the same prefix (and is logged when it happens)
+_ckpt_errors = {}
+
+
 def save_checkpoint(prefix, epoch, symbol, arg_params, aux_params):
-    """(reference: model.py:319)"""
+    """(reference: model.py:319).
+
+    The device->host parameter fetch is synchronous (the arrays may be
+    mutated by the next step), but the DISK write is pushed through the
+    execution engine (mx.engine — the reference's Engine::Push with a
+    write var on the prefix), so epoch checkpoints overlap with training
+    under ThreadedEngine and serialize under MXNET_ENGINE_TYPE=NaiveEngine.
+    ``nd.waitall()`` (or any load/find on the same prefix) drains the
+    pending write."""
+    from . import engine
+
     if symbol is not None:
         symbol.save("%s-symbol.json" % prefix)
     save_dict = {("arg:%s" % k): v for k, v in arg_params.items()}
     save_dict.update({("aux:%s" % k): v for k, v in aux_params.items()})
+    # snapshot on host NOW; the engine thread only touches the file
+    snap = {k: nd.array(v.asnumpy()) if isinstance(v, nd.NDArray) else nd.array(v)
+            for k, v in save_dict.items()}
     param_name = "%s-%04d.params" % (prefix, epoch)
-    nd.save(param_name, save_dict)
-    logging.info('Saved checkpoint to "%s"', param_name)
+    key = os.path.abspath(prefix)
+    _raise_pending_ckpt_error(key)
+    eng = engine.get()
+    if key not in _ckpt_vars:
+        _ckpt_vars[key] = eng.new_variable()
+    var = _ckpt_vars[key]
+
+    def write():
+        try:
+            nd.save(param_name, snap)
+            logging.info('Saved checkpoint to "%s"', param_name)
+        except Exception as exc:  # surfaced at the next save/load/find
+            logging.error('checkpoint write to "%s" FAILED: %s',
+                          param_name, exc)
+            _ckpt_errors[key] = exc
+
+    eng.push(write, const_vars=(), mutable_vars=(var,))
+
+
+def _raise_pending_ckpt_error(key):
+    exc = _ckpt_errors.pop(key, None)
+    if exc is not None:
+        raise MXNetError("earlier async checkpoint write failed: %s" % exc) \
+            from exc
+
+
+def _wait_checkpoint_writes(prefix):
+    key = os.path.abspath(prefix)
+    var = _ckpt_vars.get(key)
+    if var is not None:
+        from . import engine
+
+        engine.get().wait_for_var(var)
+    _raise_pending_ckpt_error(key)
 
 
 def find_last_checkpoint(prefix):
@@ -47,6 +101,7 @@ def find_last_checkpoint(prefix):
     import glob
     import re
 
+    _wait_checkpoint_writes(prefix)
     best = None
     for path in glob.glob(glob.escape(prefix) + "-*.params"):
         m = re.search(r"-(\d{4,})\.params$", path)
@@ -74,6 +129,7 @@ def resume_or_init(prefix):
 
 def load_checkpoint(prefix, epoch):
     """(reference: model.py:349) → (symbol, arg_params, aux_params)"""
+    _wait_checkpoint_writes(prefix)
     symbol = sym_mod.load("%s-symbol.json" % prefix)
     save_dict = nd.load("%s-%04d.params" % (prefix, epoch))
     arg_params = {}

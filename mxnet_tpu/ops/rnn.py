@@ -63,13 +63,16 @@ def _unpack_params(params, num_layers, input_size, state_size, bidirectional, mo
 
 
 def _cell_step(mode, state_size):
+    """Per-timestep recurrence consuming the PRE-COMPUTED input-side gates
+    ``zx_t = x_t @ wx.T + bx`` — only the hidden-side matmul stays inside
+    the scan (see _run_layer)."""
     H = state_size
 
     if mode == "lstm":
 
-        def step(carry, x_t, wx, wh, bx, bh):
+        def step(carry, zx_t, wh, bh):
             h, c = carry
-            z = x_t @ wx.T + h @ wh.T + bx + bh
+            z = zx_t + h @ wh.T + bh
             i = jax.nn.sigmoid(z[:, :H])
             f = jax.nn.sigmoid(z[:, H : 2 * H])
             gg = jnp.tanh(z[:, 2 * H : 3 * H])
@@ -80,22 +83,20 @@ def _cell_step(mode, state_size):
 
     elif mode == "gru":
 
-        def step(carry, x_t, wx, wh, bx, bh):
+        def step(carry, zx_t, wh, bh):
             (h,) = carry
-            zx = x_t @ wx.T + bx
             zh = h @ wh.T + bh
-            r = jax.nn.sigmoid(zx[:, :H] + zh[:, :H])
-            z = jax.nn.sigmoid(zx[:, H : 2 * H] + zh[:, H : 2 * H])
-            n = jnp.tanh(zx[:, 2 * H :] + r * zh[:, 2 * H :])
+            r = jax.nn.sigmoid(zx_t[:, :H] + zh[:, :H])
+            z = jax.nn.sigmoid(zx_t[:, H : 2 * H] + zh[:, H : 2 * H])
+            n = jnp.tanh(zx_t[:, 2 * H :] + r * zh[:, 2 * H :])
             h_new = (1 - z) * n + z * h
             return (h_new,), h_new
 
     else:
-        act = jnp.maximum if mode == "rnn_relu" else None
 
-        def step(carry, x_t, wx, wh, bx, bh):
+        def step(carry, zx_t, wh, bh):
             (h,) = carry
-            z = x_t @ wx.T + h @ wh.T + bx + bh
+            z = zx_t + h @ wh.T + bh
             h_new = jnp.maximum(z, 0) if mode == "rnn_relu" else jnp.tanh(z)
             return (h_new,), h_new
 
@@ -103,13 +104,20 @@ def _cell_step(mode, state_size):
 
 
 def _run_layer(mode, state_size, x, h0, c0, wx, wh, bx, bh, reverse=False):
+    """One recurrent layer. The input-side gate GEMM has no sequential
+    dependency, so it is hoisted OUT of the scan as one (T*B, I) x (I, G*H)
+    matmul — T MXU-starved (B, I) matmuls become a single large one and the
+    loop keeps only the irreducibly-sequential h @ wh.T (the cuDNN fused-RNN
+    economics, reference src/operator/cudnn_rnn-inl.h; docs/PERF.md §6)."""
     step = _cell_step(mode, state_size)
     carry0 = (h0, c0) if mode == "lstm" else (h0,)
+    # x: (T, B, I) -> zx: (T, B, G*H), one GEMM over all timesteps
+    zx_all = x @ wx.T + bx
 
-    def scan_fn(carry, x_t):
-        return step(carry, x_t, wx, wh, bx, bh)
+    def scan_fn(carry, zx_t):
+        return step(carry, zx_t, wh, bh)
 
-    carry, ys = jax.lax.scan(scan_fn, carry0, x, reverse=reverse)
+    carry, ys = jax.lax.scan(scan_fn, carry0, zx_all, reverse=reverse)
     return carry, ys
 
 

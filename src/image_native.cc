@@ -154,9 +154,19 @@ struct RawRecord {
   uint64_t seq = 0;
 };
 
+// Per-sample augment record for bbox-aware consumers (ImageDetIter):
+// {pre-crop W, pre-crop H, crop x0, crop y0, mirror, true label length}.
+// Detection boxes are normalized to the ORIGINAL image; aspect-preserving
+// resizes keep normalized coords, so the consumer only needs the crop
+// geometry + mirror flag to transform them (the reference did the box math
+// in C++, src/io/image_det_aug_default.cc — here pixels stay native and the
+// 5-float box transform stays in Python).
+constexpr int kAugFloats = 6;
+
 struct Sample {
   std::vector<float> data;    // C*H*W
   std::vector<float> label;   // label_width
+  float aug[kAugFloats] = {0, 0, 0, 0, 0, 0};
   bool ok = false;            // false = decode failed; consumer skips seq
 };
 
@@ -290,11 +300,13 @@ struct Pipeline {
     size_t payload_n = rec.bytes.size() - kIRHeaderBytes;
 
     out->label.assign(static_cast<size_t>(label_width), 0.f);
+    size_t label_len = 1;
     if (flag > 0) {
       size_t lab_bytes = static_cast<size_t>(flag) * 4;
       if (payload_n < lab_bytes) return false;
       size_t n = std::min<size_t>(label_width, flag);
       memcpy(out->label.data(), payload, n * 4);
+      label_len = n;
       payload += lab_bytes;
       payload_n -= lab_bytes;
     } else {
@@ -332,6 +344,12 @@ struct Pipeline {
       x0 = max_x / 2;
     }
     bool mirror = rand_mirror && ((*rng)() & 1);
+    out->aug[0] = static_cast<float>(img.w);
+    out->aug[1] = static_cast<float>(img.h);
+    out->aug[2] = static_cast<float>(x0);
+    out->aug[3] = static_cast<float>(y0);
+    out->aug[4] = mirror ? 1.f : 0.f;
+    out->aug[5] = static_cast<float>(label_len);
 
     // RGB HWC u8 crop -> CHW float with mean/std, one fused pass
     out->data.resize(3u * out_h * out_w);
@@ -473,8 +491,11 @@ void* mximg_open(const char* rec_path, const char* idx_path, int num_workers,
 }
 
 // Fills up to batch_size samples IN RECORD ORDER; returns the count
-// (0 = epoch exhausted).
-int mximg_next_batch(void* handle, float* data, float* labels) {
+// (0 = epoch exhausted). ``aug`` (optional, batch x 6 floats) receives each
+// sample's augment record {W, H, x0, y0, mirror, label_len} for bbox-aware
+// consumers.
+static int next_batch_impl(void* handle, float* data, float* labels,
+                           float* aug) {
   auto* p = static_cast<Pipeline*>(handle);
   const size_t img_f = 3u * p->out_h * p->out_w;
   int got = 0;
@@ -501,9 +522,21 @@ int mximg_next_batch(void* handle, float* data, float* labels) {
            img_f * sizeof(float));
     memcpy(labels + static_cast<size_t>(got) * p->label_width,
            s.label.data(), p->label_width * sizeof(float));
+    if (aug)
+      memcpy(aug + static_cast<size_t>(got) * kAugFloats, s.aug,
+             kAugFloats * sizeof(float));
     ++got;
   }
   return got;
+}
+
+int mximg_next_batch(void* handle, float* data, float* labels) {
+  return next_batch_impl(handle, data, labels, nullptr);
+}
+
+int mximg_next_batch_aug(void* handle, float* data, float* labels,
+                         float* aug) {
+  return next_batch_impl(handle, data, labels, aug);
 }
 
 // Rewind for the next epoch (new reader/decoder generation, new sample order
