@@ -1,0 +1,208 @@
+/*
+ * Header-only C++ wrapper over the training C ABI (the cpp-package
+ * analogue for the training surface; reference: cpp-package/include/
+ * mxnet-cpp Executor/NDArray/Optimizer). RAII handles, std::vector IO,
+ * exceptions from MXGetLastError.
+ *
+ *   mxtpu::Trainer tr(json, {{"data", {8, 1, 28, 28}},
+ *                            {"softmax_label", {8}}});
+ *   tr.SetArg("conv1_weight", weights);
+ *   tr.Forward(true);
+ *   std::vector<float> probs = tr.GetOutput(0);
+ *   tr.Backward();
+ *   tr.SGDUpdate(0.01f);            // in-place sgd_update on every param
+ */
+#ifndef MXTPU_CPP_TRAINER_HPP_
+#define MXTPU_CPP_TRAINER_HPP_
+
+#include <cstdio>
+#include <map>
+#include <stdexcept>
+#include <string>
+#include <vector>
+
+#include "../c_api.h"
+
+namespace mxtpu {
+
+class TrainError : public std::runtime_error {
+ public:
+  using std::runtime_error::runtime_error;
+};
+
+namespace detail {
+inline void check(int rc, const char* what) {
+  if (rc != 0)
+    throw TrainError(std::string(what) + ": " + MXGetLastError());
+}
+
+// RAII over one NDArrayHandle
+class NDHandle {
+ public:
+  NDHandle() = default;
+  explicit NDHandle(NDArrayHandle h) : h_(h) {}
+  NDHandle(NDHandle&& o) noexcept : h_(o.h_) { o.h_ = nullptr; }
+  NDHandle& operator=(NDHandle&& o) noexcept {
+    if (this != &o) { reset(); h_ = o.h_; o.h_ = nullptr; }
+    return *this;
+  }
+  NDHandle(const NDHandle&) = delete;
+  NDHandle& operator=(const NDHandle&) = delete;
+  ~NDHandle() { reset(); }
+  void reset() { if (h_) { MXNDArrayFree(h_); h_ = nullptr; } }
+  NDArrayHandle get() const { return h_; }
+  explicit operator bool() const { return h_ != nullptr; }
+
+  size_t Size() const {
+    mx_uint nd; const mx_uint* shp;
+    check(MXNDArrayGetShape(h_, &nd, &shp), "MXNDArrayGetShape");
+    size_t n = 1;
+    for (mx_uint i = 0; i < nd; ++i) n *= shp[i];
+    return n;
+  }
+  std::vector<float> ToVector() const {
+    std::vector<float> out(Size());
+    check(MXNDArraySyncCopyToCPU(h_, out.data(), out.size()),
+          "MXNDArraySyncCopyToCPU");
+    return out;
+  }
+  void FromVector(const std::vector<float>& v) const {
+    check(MXNDArraySyncCopyFromCPU(h_, v.data(), v.size()),
+          "MXNDArraySyncCopyFromCPU");
+  }
+
+ private:
+  NDArrayHandle h_ = nullptr;
+};
+}  // namespace detail
+
+class Trainer {
+ public:
+  using Shapes = std::map<std::string, std::vector<mx_uint>>;
+
+  // simple_bind over symbol JSON; ``input_shapes`` names the data/label
+  // inputs (they get no gradient; everything else is a trainable param).
+  Trainer(const std::string& symbol_json, const Shapes& input_shapes) {
+    std::vector<const char*> keys;
+    std::vector<mx_uint> indptr{0};
+    std::vector<mx_uint> dims;
+    for (const auto& kv : input_shapes) {
+      keys.push_back(kv.first.c_str());
+      dims.insert(dims.end(), kv.second.begin(), kv.second.end());
+      indptr.push_back(static_cast<mx_uint>(dims.size()));
+    }
+    detail::check(
+        MXTrainExecutorCreate(symbol_json.c_str(),
+                              static_cast<mx_uint>(keys.size()), keys.data(),
+                              indptr.data(), dims.data(), &handle_),
+        "MXTrainExecutorCreate");
+  }
+  ~Trainer() { if (handle_) MXExecutorFree(handle_); }
+  Trainer(const Trainer&) = delete;
+  Trainer& operator=(const Trainer&) = delete;
+
+  std::vector<std::string> ArgNames() const {
+    mx_uint n; const char** names;
+    detail::check(MXExecutorListArguments(handle_, &n, &names),
+                  "MXExecutorListArguments");
+    return std::vector<std::string>(names, names + n);
+  }
+
+  std::vector<float> GetArg(const std::string& name) const {
+    return arg_(name).ToVector();
+  }
+  void SetArg(const std::string& name, const std::vector<float>& v) const {
+    arg_(name).FromVector(v);
+  }
+  size_t ArgSize(const std::string& name) const { return arg_(name).Size(); }
+  // false when the argument is a data/label input (no gradient)
+  bool HasGrad(const std::string& name) const {
+    NDArrayHandle g = nullptr;
+    detail::check(MXExecutorGetGrad(handle_, name.c_str(), &g),
+                  "MXExecutorGetGrad");
+    detail::NDHandle owned(g);
+    return static_cast<bool>(owned);
+  }
+  std::vector<float> GetGrad(const std::string& name) const {
+    NDArrayHandle g = nullptr;
+    detail::check(MXExecutorGetGrad(handle_, name.c_str(), &g),
+                  "MXExecutorGetGrad");
+    if (!g) throw TrainError(name + " has no gradient");
+    return detail::NDHandle(g).ToVector();
+  }
+
+  void Forward(bool is_train) const {
+    detail::check(MXExecutorForward(handle_, is_train ? 1 : 0),
+                  "MXExecutorForward");
+  }
+  void Backward() const {
+    detail::check(MXExecutorBackward(handle_, 0, nullptr),
+                  "MXExecutorBackward");
+  }
+  int NumOutputs() const {
+    int n = 0;
+    detail::check(MXExecutorNumOutputs(handle_, &n), "MXExecutorNumOutputs");
+    return n;
+  }
+  std::vector<float> GetOutput(mx_uint index) const {
+    NDArrayHandle h = nullptr;
+    detail::check(MXExecutorGetOutput(handle_, index, &h),
+                  "MXExecutorGetOutput");
+    return detail::NDHandle(h).ToVector();
+  }
+  std::vector<mx_uint> GetOutputShape(mx_uint index) const {
+    NDArrayHandle h = nullptr;
+    detail::check(MXExecutorGetOutput(handle_, index, &h),
+                  "MXExecutorGetOutput");
+    detail::NDHandle owned(h);
+    mx_uint nd; const mx_uint* shp;
+    detail::check(MXNDArrayGetShape(owned.get(), &nd, &shp),
+                  "MXNDArrayGetShape");
+    return std::vector<mx_uint>(shp, shp + nd);
+  }
+
+  // one in-place sgd_update over every parameter with a gradient
+  // (MXImperativeInvokeByName, the reference's optimizer-op idiom)
+  void SGDUpdate(float lr) const {
+    char lr_str[32];
+    std::snprintf(lr_str, sizeof(lr_str), "%g", lr);
+    const char* keys[] = {"lr"};
+    const char* vals[] = {lr_str};
+    for (const auto& name : ArgNames()) {
+      NDArrayHandle g = nullptr;
+      detail::check(MXExecutorGetGrad(handle_, name.c_str(), &g),
+                    "MXExecutorGetGrad");
+      if (!g) continue;
+      detail::NDHandle grad(g);
+      detail::NDHandle weight;
+      {
+        NDArrayHandle w = nullptr;
+        detail::check(MXExecutorGetArg(handle_, name.c_str(), &w),
+                      "MXExecutorGetArg");
+        weight = detail::NDHandle(w);
+      }
+      NDArrayHandle ins[2] = {weight.get(), grad.get()};
+      NDArrayHandle out = weight.get();
+      NDArrayHandle* outs = &out;
+      int n_out = 1;
+      detail::check(MXImperativeInvokeByName("sgd_update", 2, ins, &n_out,
+                                             &outs, 1, keys, vals),
+                    "MXImperativeInvokeByName(sgd_update)");
+    }
+  }
+
+ private:
+  detail::NDHandle arg_(const std::string& name) const {
+    NDArrayHandle h = nullptr;
+    detail::check(MXExecutorGetArg(handle_, name.c_str(), &h),
+                  "MXExecutorGetArg");
+    if (!h) throw TrainError("unknown argument " + name);
+    return detail::NDHandle(h);
+  }
+
+  ExecutorHandle handle_ = nullptr;
+};
+
+}  // namespace mxtpu
+
+#endif  // MXTPU_CPP_TRAINER_HPP_

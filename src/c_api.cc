@@ -11,6 +11,8 @@
 #define PY_SSIZE_T_CLEAN
 #include <Python.h>
 
+#include "embed_runtime.h"
+
 #include <cstdint>
 #include <cstring>
 #include <mutex>
@@ -29,13 +31,18 @@ thread_local std::string g_last_error;
 // storage for handle arrays returned by MXImperativeInvokeByName
 thread_local std::vector<NDArrayHandle> g_invoke_outs;
 
+
+
 void ensure_python() {
   std::lock_guard<std::mutex> lk(g_init_mu);
   if (!Py_IsInitialized()) {
     Py_InitializeEx(0);
     PyEval_SaveThread();
+    mxtpu_embed::ensure_exit_guard();
   }
 }
+
+
 
 struct Gil {
   PyGILState_STATE st;
@@ -297,16 +304,20 @@ int MXTrainExecutorCreate(const char* symbol_json, mx_uint num_inputs,
   auto* h = new Exec();
   h->ex = ex;
   *out = h;
+  mxtpu_embed::ensure_exit_guard();  // jax imports dlopened during bind
   return 0;
 }
 
 int MXExecutorForward(ExecutorHandle handle, int is_train) {
   auto* h = static_cast<Exec*>(handle);
   if (!h) return fail("null handle");
-  Gil gil;
-  PyObject* r = PyObject_CallMethod(h->ex, "forward", "i", is_train);
-  if (!r) return fail_from_python();
-  Py_DECREF(r);
+  {
+    Gil gil;
+    PyObject* r = PyObject_CallMethod(h->ex, "forward", "i", is_train);
+    if (!r) return fail_from_python();
+    Py_DECREF(r);
+  }
+  mxtpu_embed::ensure_exit_guard();  // first compile dlopens lazily
   return 0;
 }
 
@@ -409,6 +420,8 @@ int MXExecutorFree(ExecutorHandle handle) {
     Py_XDECREF(h->arg_names);
   }
   delete h;
+  mxtpu_embed::quiesce();
+  mxtpu_embed::ensure_exit_guard();
   return 0;
 }
 
@@ -424,6 +437,7 @@ int MXKVStoreCreate(const char* type, KVStoreHandle* out) {
   auto* h = new KV();
   h->kv = kv;
   *out = h;
+  mxtpu_embed::ensure_exit_guard();
   return 0;
 }
 
@@ -468,6 +482,8 @@ int MXKVStoreFree(KVStoreHandle handle) {
     Py_XDECREF(h->kv);
   }
   delete h;
+  mxtpu_embed::quiesce();
+  mxtpu_embed::ensure_exit_guard();
   return 0;
 }
 

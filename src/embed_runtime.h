@@ -1,0 +1,106 @@
+// Shared embedded-interpreter lifecycle machinery for the C ABI shims
+// (c_api.cc, predict_api.cc). Internal linkage on purpose: each .so gets
+// its own copy and counter — external linkage would interpose between
+// libmxtpu_c.so and libmxtpu_predict.so when a host loads both.
+//
+// The problem this solves (measured, not theoretical): a host that frees
+// its last handle and promptly exits races the backend's in-flight
+// asynchronous work (buffer-deallocation callbacks on jax's pool threads)
+// against process teardown — an intermittent exit-time SIGSEGV (~15% of
+// runs from a C++ host on an 8-device CPU backend). Two pieces close it:
+//
+//  * quiesce(): gc + a short settle sleep, run at handle-Free entry points
+//    (rare, end-of-life calls) so async frees retire before the host can
+//    reach exit().
+//  * an exit guard: the FIRST exit handler _exit()s after flushing stdio,
+//    skipping every static destructor (destructor order vs live pool
+//    threads is the underlying hazard). Exit handlers run LIFO and jax
+//    keeps dlopening lazily (imports, first compile), each dlopen
+//    registering destructors ABOVE an earlier guard — so the guard is
+//    re-armed whenever the loaded-DSO count changed, from the create/
+//    forward/free entry points (not per-call hot paths).
+//
+// Documented tradeoff: once this library has been used, host atexit
+// handlers registered BEFORE the library's latest guard re-arm are
+// skipped at exit (the guard _exit()s first). Hosts that need their own
+// atexit work should do it before exit() or register after their last
+// mxtpu call.
+#ifndef MXTPU_SRC_EMBED_RUNTIME_H_
+#define MXTPU_SRC_EMBED_RUNTIME_H_
+
+#include <Python.h>
+
+#include <cstdio>
+#include <cstdlib>
+#include <ctime>
+#include <link.h>
+#include <mutex>
+#include <unistd.h>
+
+namespace mxtpu_embed {
+
+inline std::mutex& guard_mu() {
+  static std::mutex mu;
+  return mu;
+}
+
+inline double monotonic_s() {
+  struct timespec ts;
+  clock_gettime(CLOCK_MONOTONIC, &ts);
+  return ts.tv_sec + ts.tv_nsec * 1e-9;
+}
+
+inline double& last_quiesce() {
+  static double t = -1e9;
+  return t;
+}
+
+// gc + settle sleep so the backend's async callbacks retire while the
+// interpreter is fully alive. Safe from any thread (takes the GIL).
+inline void quiesce() {
+  if (!Py_IsInitialized()) return;
+  PyGILState_STATE st = PyGILState_Ensure();
+  PyRun_SimpleString(
+      "import gc, time\n"
+      "gc.collect()\n"
+      "time.sleep(0.05)\n");
+  PyGILState_Release(st);
+  std::lock_guard<std::mutex> lk(guard_mu());
+  last_quiesce() = monotonic_s();
+}
+
+inline int count_dsos() {
+  int n = 0;
+  dl_iterate_phdr([](struct dl_phdr_info*, size_t, void* p) {
+    ++*static_cast<int*>(p);
+    return 0;
+  }, &n);
+  return n;
+}
+
+// Re-arm the exit guard if new shared objects appeared since last time.
+inline void ensure_exit_guard() {
+  std::lock_guard<std::mutex> lk(guard_mu());
+  static int last = -1;
+  int n = count_dsos();
+  if (n == last) return;
+  last = n;
+  on_exit([](int status, void*) {
+    bool settled;
+    {
+      std::lock_guard<std::mutex> lk(guard_mu());
+      settled = monotonic_s() - last_quiesce() < 2.0;
+    }
+    // if nothing quiesced recently (host exited without freeing handles),
+    // settle now. This takes the GIL and can block behind a long-running
+    // call on another thread — bounded by that call, same as any API entry.
+    if (!settled) quiesce();
+    fflush(stdout);
+    fflush(stderr);
+    _exit(status);
+  }, nullptr);
+}
+
+}  // namespace mxtpu_embed
+
+#endif  // MXTPU_SRC_EMBED_RUNTIME_H_

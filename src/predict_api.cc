@@ -14,6 +14,8 @@
 #define PY_SSIZE_T_CLEAN
 #include <Python.h>
 
+#include "embed_runtime.h"
+
 #include <cstdint>
 #include <cstring>
 #include <mutex>
@@ -82,13 +84,18 @@ int fetch_output(Pred* p, mx_uint index) {
   return 0;
 }
 
+
+
 void ensure_python() {
   std::lock_guard<std::mutex> lk(g_init_mu);
   if (!Py_IsInitialized()) {
     Py_InitializeEx(0);  // the interpreter lives for the process lifetime
     PyEval_SaveThread();  // release the GIL so PyGILState_Ensure works
+    mxtpu_embed::ensure_exit_guard();
   }
 }
+
+
 
 struct Gil {
   PyGILState_STATE st;
@@ -205,6 +212,7 @@ int create_impl(const char* symbol_json_str, const void* param_bytes,
   p->param_bytes = params;
   p->output_names = outputs;
   *out = p;
+  mxtpu_embed::ensure_exit_guard();  // jax imports dlopened during create
   return 0;
 }
 
@@ -283,6 +291,7 @@ int MXPredForward(PredictorHandle handle) {
   if (!r) return fail_from_python();
   Py_DECREF(r);
   p->cached_index = -1;  // new forward invalidates the output cache
+  mxtpu_embed::ensure_exit_guard();  // first compile dlopens lazily
   return 0;
 }
 
@@ -356,6 +365,8 @@ int MXPredFree(PredictorHandle handle) {
     Py_XDECREF(p->output_names);
   }
   delete p;
+  mxtpu_embed::quiesce();
+  mxtpu_embed::ensure_exit_guard();
   return 0;
 }
 

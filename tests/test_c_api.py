@@ -198,3 +198,74 @@ def test_imperative_invoke_allocating_mode(libc_api):
     assert out2 is a
     np.testing.assert_allclose(a.asnumpy(),
                                [[0.0, 0.0], [0.0, 0.0]], atol=1e-6)
+
+
+CPP_TRAIN = r"""
+#include <cmath>
+#include <cstdio>
+#include <fstream>
+#include <random>
+#include <sstream>
+#include "mxtpu/cpp/trainer.hpp"
+
+int main(int argc, char** argv) {
+  std::ifstream jf(argv[1]);
+  std::stringstream ss; ss << jf.rdbuf();
+  const int B = 8, NCLS = 10;
+  mxtpu::Trainer tr(ss.str(), {{"data", {B, 1, 28, 28}},
+                               {"softmax_label", {B}}});
+  std::mt19937 rng(7);
+  std::uniform_real_distribution<float> u(-0.1f, 0.1f);
+  std::vector<float> label(B);
+  for (int i = 0; i < B; ++i) label[i] = float(i % NCLS);
+  for (const auto& name : tr.ArgNames()) {
+    if (name == "softmax_label") { tr.SetArg(name, label); continue; }
+    std::vector<float> v(tr.ArgSize(name));
+    float s = (name == "data") ? 5.0f : 1.0f;
+    for (auto& x : v) x = u(rng) * s;
+    tr.SetArg(name, v);
+  }
+  float first = 0, last = 0;
+  for (int step = 0; step < 10; ++step) {
+    tr.Forward(true);
+    std::vector<float> prob = tr.GetOutput(0);
+    float loss = 0;
+    for (int i = 0; i < B; ++i)
+      loss += -std::log(prob[i * NCLS + int(label[i])] + 1e-9f);
+    loss /= B;
+    if (step == 0) first = loss;
+    last = loss;
+    tr.Backward();
+    tr.SGDUpdate(0.01f);
+  }
+  std::printf("first %f last %f\n", first, last);
+  if (!(last < first * 0.9f)) return 7;
+  // the input must have no gradient (bind contract)
+  if (tr.HasGrad("data") || tr.HasGrad("softmax_label")) return 8;
+  return 0;
+}
+"""
+
+
+@pytest.mark.slow
+def test_cpp_trainer_wrapper(tmp_path, libc_api):
+    """The header-only C++ RAII trainer (cpp-package training analogue)
+    trains LeNet through the same ABI."""
+    net = lenet.get_symbol(num_classes=10)
+    json_path = tmp_path / "lenet-symbol.json"
+    json_path.write_text(net.tojson())
+    cpp = tmp_path / "train.cc"
+    cpp.write_text(CPP_TRAIN)
+    exe = tmp_path / "train_cpp"
+    subprocess.run(
+        ["g++", "-std=c++17", str(cpp), "-I", os.path.join(ROOT, "include"),
+         "-o", str(exe), str(libc_api),
+         "-Wl,-rpath," + os.path.dirname(str(libc_api))],
+        check=True, capture_output=True)
+    env = dict(os.environ)
+    env["PYTHONPATH"] = ROOT + os.pathsep + env.get("PYTHONPATH", "")
+    env.setdefault("JAX_PLATFORMS", "cpu")
+    env.setdefault("MXNET_DEFAULT_CONTEXT", "cpu")
+    r = subprocess.run([str(exe), str(json_path)], capture_output=True,
+                       text=True, timeout=600, env=env)
+    assert r.returncode == 0, (r.returncode, r.stdout[-300:], r.stderr[-800:])
