@@ -87,6 +87,11 @@ class PendingConv:
         self.kernel, self.stride = kernel, stride
 
     def run(self, res):
+        kind, mesh, _ = _mesh_kind()
+        if kind == _MESH_DP:
+            return _conv_block_sharded(
+                mesh, self.x, self.w, self.scale, self.shift, res,
+                self.kernel, self.stride, self.relu)
         return conv_block(self.x, self.w, self.scale, self.shift, res,
                           self.kernel, self.stride, self.relu)
 
@@ -327,6 +332,66 @@ def _exec_bn(directive, node, ins, aux):
     return (out,), (new_mean, new_var)
 
 
+_MESH_NONE, _MESH_DP, _MESH_OTHER = 0, 1, 2
+
+
+def _mesh_kind():
+    """Tri-state: (_MESH_NONE, None, 0) outside any SPMD trace or on a
+    1-device mesh (run the kernel directly); (_MESH_DP, mesh, dp) on a
+    pure data-parallel mesh over a 'data' axis (run per-shard under
+    shard_map with psum'd statistics); (_MESH_OTHER, None, 0) on any other
+    multi-device mesh — tensor/seq-sharded, or a dp axis not named 'data' —
+    where a raw pallas_call would make GSPMD gather its operands: those
+    take the XLA fallback unconditionally."""
+    from .parallel.mesh import current_trace_mesh
+
+    mesh = current_trace_mesh()
+    if mesh is None or mesh.size <= 1:
+        return _MESH_NONE, None, 0
+    dp = mesh.shape.get("data", 0) if "data" in mesh.axis_names else 0
+    if dp == mesh.size:
+        return _MESH_DP, mesh, dp
+    return _MESH_OTHER, None, 0
+
+
+def _conv_block_sharded(mesh, x, w, scale, shift, res, kernel, stride, relu):
+    """Run the kernel per data-shard (pallas_call has no SPMD partitioning
+    rule, so GSPMD would gather its operands); the per-shard statistics
+    psum over 'data' so the downstream BN sees GLOBAL-batch moments —
+    identical semantics to the unfused dp path, where XLA turns the stats
+    reduction over a sharded batch into the same collective."""
+    import jax
+    from jax.sharding import PartitionSpec as P
+
+    args = [x, w]
+    specs = [P("data", *([None] * (x.ndim - 1))), P(*([None] * w.ndim))]
+    has_p, has_r = scale is not None, res is not None
+    if has_p:
+        args += [scale, shift]
+        specs += [P(None), P(None)]
+    if has_r:
+        args.append(res)
+        specs.append(P("data", *([None] * (res.ndim - 1))))
+
+    def local(*a):
+        it = iter(a)
+        x_, w_ = next(it), next(it)
+        sc = next(it) if has_p else None
+        sh = next(it) if has_p else None
+        r_ = next(it) if has_r else None
+        c, s, q = conv_block(x_, w_, sc, sh, r_, kernel, stride, relu)
+        return (c, jax.lax.psum(s, "data"), jax.lax.psum(q, "data"))
+
+    # check_vma=False: pallas_call's out_shape structs carry no vma
+    # annotation, which the checker rejects; the specs here are simple
+    # enough to state outright
+    fn = jax.shard_map(
+        local, mesh=mesh, in_specs=tuple(specs),
+        out_specs=(P("data", *([None] * (x.ndim - 1))), P(None), P(None)),
+        check_vma=False)
+    return fn(*args)
+
+
 def _exec_conv(directive, node, ins):
     v, w = ins
     kernel, stride = directive["kernel"], directive["stride"]
@@ -334,12 +399,25 @@ def _exec_conv(directive, node, ins):
         x, scale, shift, relu = v.raw, v.scale, v.shift, v.relu
     else:
         x, scale, shift, relu = resolve(v), None, None, False
-    if gate(kernel, stride, x.shape, w.shape, x.dtype, scale is not None,
-            res=directive["defer"]):
+    kind, mesh, dp = _mesh_kind()
+    if kind == _MESH_DP:
+        local_shape = (x.shape[0] // dp,) + x.shape[1:]
+        if (x.shape[0] % dp == 0
+                and gate(kernel, stride, local_shape, w.shape, x.dtype,
+                         scale is not None, res=directive["defer"])):
+            if directive["defer"]:
+                return PendingConv(x, w, scale, shift, relu, kernel, stride)
+            c, s, q = _conv_block_sharded(mesh, x, w, scale, shift, None,
+                                          kernel, stride, relu)
+            return WithStats(c, s, q)
+    elif kind == _MESH_NONE and gate(kernel, stride, x.shape, w.shape,
+                                     x.dtype, scale is not None,
+                                     res=directive["defer"]):
         if directive["defer"]:
             return PendingConv(x, w, scale, shift, relu, kernel, stride)
         c, s, q = conv_block(x, w, scale, shift, None, kernel, stride, relu)
         return WithStats(c, s, q)
+    # kind == _MESH_OTHER (tensor/seq-sharded) always lands here: XLA path
     # fallback: materialize the normalized input (cached on the marker) and
     # run the ordinary XLA conv (shared lowering from pallas_conv_bn)
     xn = v.materialize() if isinstance(v, Deferred) else x

@@ -74,12 +74,12 @@ class SPMDTrainer:
         self.symbol = symbol
         self.mesh = mesh
         self.rules = rules or ShardingRules(mesh)
-        # conv+BN Pallas fusion engages on single-device meshes only: a
-        # pallas_call has no SPMD partitioning rule, so under a >1-device
-        # mesh GSPMD would all-gather its operands (wrong cost model); the
-        # multi-device path keeps the XLA lowering
-        self._prog = _GraphProgram(
-            symbol, fusion=int(np.prod(mesh.devices.shape)) == 1)
+        # conv+BN Pallas fusion: single-device meshes run the kernel
+        # directly; pure-dp meshes run it per-shard under shard_map with
+        # psum'd statistics (fusion._conv_block_sharded — a pallas_call has
+        # no GSPMD partitioning rule of its own); tensor/seq-sharded meshes
+        # fall back to the XLA lowering at trace time
+        self._prog = _GraphProgram(symbol)
         self._remat = remat
         self._compute_dtype = np.dtype(compute_dtype) if compute_dtype else None
 
