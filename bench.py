@@ -178,15 +178,52 @@ def _bench_resnet50(on_tpu, models, parallel, dev):
                 raise
             trainer = None
     n_steps = 10 if on_tpu else 3
-    t0 = time.perf_counter()
-    for _ in range(n_steps):
-        outs = trainer.step({"data": x}, {"softmax_label": y})
-    _sync(outs)
-    dt = time.perf_counter() - t0
-    img_s = batch * n_steps / dt
-    return {"img_s": img_s, "batch": batch, "image": image,
-            "step_ms": 1000 * dt / n_steps,
-            "flops_per_img": _TRAIN_FLOPS_PER_IMG * (image / 224.0) ** 2}
+
+    def timed(tr):
+        t0 = time.perf_counter()
+        for _ in range(n_steps):
+            outs = tr.step({"data": x}, {"softmax_label": y})
+        _sync(outs)
+        return batch * n_steps / (time.perf_counter() - t0)
+
+    img_s = timed(trainer)
+    res = {"img_s": img_s, "batch": batch, "image": image,
+           "step_ms": 1000 * batch / img_s,
+           "flops_per_img": _TRAIN_FLOPS_PER_IMG * (image / 224.0) ** 2}
+
+    # A/B the fused conv+BN Pallas path (docs/PERF.md §6) on the chip. The
+    # WINS table may predate this device (or be empty); forcing the path
+    # here measures it regardless, and the HEADLINE number is whichever
+    # lowering is faster — the same per-shape decision the gate makes, at
+    # whole-step granularity. Failures fall back silently with a note.
+    # Skipped when the caller pinned the env to 0 (fusion off) or 1 (the
+    # baseline above already ran fused — nothing to compare).
+    prev_env = os.environ.get("MXNET_FUSED_CONV_BN")
+    if on_tpu and (prev_env or "auto") == "auto":
+        trainer = None  # release baseline params/opt state before tr2
+        try:
+            os.environ["MXNET_FUSED_CONV_BN"] = "1"
+            tr2 = _make_trainer(
+                net, dev, {"data": (batch, 3, image, image),
+                           "softmax_label": (batch,)},
+                "bfloat16", parallel)
+            for _ in range(3):
+                outs = tr2.step({"data": x}, {"softmax_label": y})
+            _sync(outs)
+            fused = timed(tr2)
+            res["fused_img_s"] = fused
+            res["fused_faster"] = bool(fused > img_s)
+            if fused > img_s:
+                res["img_s"] = fused
+                res["step_ms"] = 1000 * batch / fused
+        except Exception as exc:
+            res["fused_error"] = "%s: %s" % (type(exc).__name__, exc)
+        finally:
+            if prev_env is None:
+                os.environ.pop("MXNET_FUSED_CONV_BN", None)
+            else:
+                os.environ["MXNET_FUSED_CONV_BN"] = prev_env
+    return res
 
 
 def _bench_lstm(on_tpu, models, parallel, dev):
