@@ -25,6 +25,8 @@ CASES = [
      ["--num-epochs", "1", "--train-size", "512", "--val-size", "128"]),
     ("example/nce-loss/nce_word2vec.py",
      ["--num-epochs", "4", "--train-size", "2048"]),
+    ("example/long-context/ring_attention_lm.py",
+     ["--dp", "2", "--sp", "4", "--seq-len", "32", "--steps", "120"]),
 ]
 
 
