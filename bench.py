@@ -404,6 +404,14 @@ def main():
     if "error" not in ar:
         result["allreduce_gbps"] = round(ar["gbps"], 3)
         result["allreduce_fabric"] = ar["fabric"]
+        if ar["fabric"].startswith("cpu"):
+            # interpretive guard: this number is host shared-memory loopback
+            # through 8 local processes — it measures the kvstore code path,
+            # NOT an interconnect. ICI/DCN bandwidth requires a pod slice
+            # (v5e ICI spec ~186 GB/s/link; see tools/bandwidth/measure.py).
+            result["allreduce_note"] = (
+                "host-loopback (no TPU fabric attached); measures the "
+                "kvstore path, not interconnect bandwidth")
         if "device_mesh_gbps" in ar:
             result["allreduce_device_mesh_gbps"] = ar["device_mesh_gbps"]
             result["allreduce_device_mesh_fabric"] = ar.get(
