@@ -678,3 +678,33 @@ def test_every_registered_op_is_covered():
         "registered ops with no sweep spec and no EXEMPT pointer: %s"
         % missing)
     assert not stale, "EXEMPT pointers that do not mention the op: %s" % stale
+
+
+# gather-family gradients: differentiable w.r.t. the DATA argument only
+# (indices have no tangent space) — check_numeric_gradient restricted via
+# grad_nodes so finite differences never perturb the integer inputs.
+_GATHER_GRADS = {
+    "take": (lambda: (mx.sym.take(mx.sym.Variable("a"), mx.sym.Variable("i")),
+                      {"a": _u(-1, 1, (5, 3), 13),
+                       "i": np.array([0., 2., 4.], "f")}), ["a"]),
+    "batch_take": (lambda: (mx.sym.batch_take(mx.sym.Variable("a"),
+                                              mx.sym.Variable("i")),
+                            {"a": _u(-1, 1, (3, 4), 13),
+                             "i": np.array([0., 3., 1.], "f")}), ["a"]),
+    "pick": (lambda: (mx.sym.pick(mx.sym.Variable("a"),
+                                  mx.sym.Variable("i"), axis=1),
+                      {"a": _u(-1, 1, (3, 4), 14),
+                       "i": np.array([1., 0., 3.], "f")}), ["a"]),
+    "Embedding": (lambda: (mx.sym.Embedding(mx.sym.Variable("i"),
+                                            mx.sym.Variable("w"),
+                                            input_dim=5, output_dim=3),
+                           {"i": np.array([1., 4., 0.], "f"),
+                            "w": _u(-1, 1, (5, 3), 15)}), ["w"]),
+}
+
+
+@pytest.mark.parametrize("opname", sorted(_GATHER_GRADS))
+def test_gather_gradients(opname):
+    build, grad_nodes = _GATHER_GRADS[opname]
+    sym, loc = build()
+    test_utils.check_numeric_gradient(sym, loc, grad_nodes=grad_nodes)
