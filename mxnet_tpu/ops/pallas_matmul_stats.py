@@ -18,10 +18,11 @@ flushes them on the final M step. The statistics come from the f32 MXU
 accumulator BEFORE the bf16 round of C — at least as accurate as reducing
 the stored bf16 activations.
 
-This is the measured prototype of PERF.md §4's "hand-fused conv+BN stack"
-— the only remaining lever toward >=0.35 MFU on the v5e. The general-conv
-variant (and the graph pass that rewrites Conv1x1+BatchNorm sites onto it)
-builds on this kernel.
+This was the round-4 measured prototype of PERF.md §4's "hand-fused
+conv+BN stack". SUPERSEDED in round 5 by ``ops/pallas_conv_bn.py`` (the
+NCHW-native kernel with prologue/residual/stats fusions and the fusion.py
+graph pass, PERF.md §6); kept as the minimal 2-D reference kernel its
+tests and the §5 loss table describe.
 """
 from __future__ import annotations
 
