@@ -57,6 +57,7 @@ def __getattr__(name):
     import importlib
 
     lazy = {
+        "analysis": ".analysis",
         "sym": ".symbol",
         "symbol": ".symbol",
         "executor": ".executor",

@@ -1,0 +1,92 @@
+"""mxnet_tpu.analysis: static graph-lint & engine-race analysis.
+
+The home for every static pass over the Symbol DAG, executor bind metadata,
+and recorded engine schedules (ISSUE 1 tentpole; Relay/PyGraph lineage in
+PAPERS.md). Three entry points:
+
+* ``lint(symbol, shapes=..., types=...)`` — run the graph passes, get a
+  ``Report`` of structured ``Diagnostic``s (stable ``GLxxx`` codes).
+* ``MXNET_GRAPHLINT=warn|error`` — ``executor.bind``/``simple_bind`` run the
+  same passes on every bind; ``warn`` logs, ``error`` raises ``MXNetError``
+  with the formatted report instead of a JAX traceback.
+* ``tools/graphlint`` — the CLI: lints bundled models or a serialized
+  Symbol JSON (``python tools/graphlint --all-models``).
+
+Engine schedules are analyzed separately (they are runtime traces, not
+graphs): wrap any engine in ``RecordingEngine``, run the workload, then
+``analyze_trace(engine.trace)``. See ``docs/static_analysis.md`` for every
+diagnostic code.
+"""
+from __future__ import annotations
+
+import logging
+import os
+
+from ..base import MXNetError
+from .diagnostics import CODES, Diagnostic, Report, Severity, describe_code
+from .engine_race import RecordingEngine, ScheduleTrace, analyze_trace
+from .manager import GraphContext, graph_pass, list_passes, run_graph_passes
+
+__all__ = [
+    "CODES", "Diagnostic", "Report", "Severity", "describe_code",
+    "GraphContext", "graph_pass", "list_passes", "run_graph_passes",
+    "RecordingEngine", "ScheduleTrace", "analyze_trace",
+    "lint", "lint_bind", "graphlint_mode",
+]
+
+_LOG = logging.getLogger("mxnet_tpu.graphlint")
+
+
+def lint(symbol, shapes=None, types=None, strict_shapes=None, passes=None,
+         target="") -> Report:
+    """Run the registered graph passes over ``symbol``.
+
+    ``shapes``/``types`` are name->shape / name->dtype hints (same contract
+    as ``Symbol.infer_shape``/``infer_type`` kwargs). ``strict_shapes``
+    defaults to True when shape hints are given: underdetermined arguments
+    are then GL002 errors rather than expected polymorphism (GL203).
+    """
+    return run_graph_passes(symbol, shape_hints=shapes, type_hints=types,
+                            strict_shapes=strict_shapes, passes=passes,
+                            target=target)
+
+
+_warned_modes = set()
+
+
+def graphlint_mode():
+    """The MXNET_GRAPHLINT env knob: None (off, the default), 'warn', or
+    'error'. Boolean-style truthy values ('1', 'true', 'on') mean 'warn'
+    (every other knob in docs/ENV_VARS.md is 0/1, so honor the idiom);
+    anything else logs a one-time warning and stays off rather than letting
+    the user believe a gate is active that never runs."""
+    raw = os.environ.get("MXNET_GRAPHLINT", "0").strip().lower()
+    if raw in ("warn", "error"):
+        return raw
+    if raw in ("1", "true", "on"):
+        return "warn"
+    if raw not in ("", "0", "false", "off") and raw not in _warned_modes:
+        _warned_modes.add(raw)
+        _LOG.warning("MXNET_GRAPHLINT=%r is not a recognized mode "
+                     "(0|warn|error); graphlint stays OFF", raw)
+    return None
+
+
+def lint_bind(symbol, shapes, types, mode, target="bind"):
+    """Bind-time hook used by ``executor.bind``: lint with the concrete
+    bind shapes/dtypes, log findings, and under ``error`` raise MXNetError
+    when any error-severity diagnostic fires."""
+    report = lint(symbol, shapes=shapes, types=types, strict_shapes=True,
+                  target=target)
+    for d in report:
+        if d.severity == Severity.ERROR:
+            _LOG.error(d.format())
+        elif d.severity == Severity.WARNING:
+            _LOG.warning(d.format())
+        else:
+            _LOG.debug(d.format())
+    if mode == "error" and report.errors:
+        raise MXNetError(
+            "graphlint found %d error(s) at bind (MXNET_GRAPHLINT=error):\n%s"
+            % (len(report.errors), report.format(min_severity=Severity.WARNING)))
+    return report

@@ -1,0 +1,194 @@
+"""``tools/graphlint`` CLI implementation.
+
+Lints bundled model-zoo networks (by name) or serialized Symbol JSON files
+(by path) with the full static-analysis pass suite and prints structured
+diagnostics. Exit code: 0 clean, 1 findings at/above the failure severity
+(error by default, warning with ``--strict``), 2 usage or load failure.
+
+Examples::
+
+    python tools/graphlint resnet-18 --shape data=1,3,32,32
+    python tools/graphlint model-symbol.json --format json
+    python tools/graphlint --all-models
+    python tools/graphlint --list-codes
+"""
+from __future__ import annotations
+
+import argparse
+import json
+import sys
+
+from .diagnostics import CODES, Severity, describe_code
+
+# Default lint shapes/dtypes per zoo model: enough hints that the full
+# shape/dtype propagation runs end to end (labels backward-derive via
+# shape_rules where possible). Models without an entry lint structurally.
+DEFAULT_SHAPES = {
+    "lenet": {"data": (1, 1, 28, 28)},
+    "mlp": {"data": (1, 784)},
+    "alexnet": {"data": (1, 3, 224, 224)},
+    "vgg": {"data": (1, 3, 224, 224)},
+    "vgg16": {"data": (1, 3, 224, 224)},
+    "vgg19": {"data": (1, 3, 224, 224)},
+    "inception-bn": {"data": (1, 3, 224, 224)},
+    "inception_bn": {"data": (1, 3, 224, 224)},
+    "inception-v3": {"data": (1, 3, 299, 299)},
+    "inception_v3": {"data": (1, 3, 299, 299)},
+    "resnet": {"data": (1, 3, 224, 224)},
+    "resnet-18": {"data": (1, 3, 224, 224)},
+    "resnet-34": {"data": (1, 3, 224, 224)},
+    "resnet-50": {"data": (1, 3, 224, 224)},
+    "resnet-101": {"data": (1, 3, 224, 224)},
+    "resnet-152": {"data": (1, 3, 224, 224)},
+    "lstm": {"data": (32, 32), "softmax_label": (32, 32)},
+    "transformer": {"data": (2, 64), "softmax_label": (2, 64)},
+}
+DEFAULT_TYPES = {
+    "lstm": {"data": "int32"},
+    "transformer": {"data": "int32"},
+}
+
+
+def _parse_kv_shape(spec: str):
+    if "=" not in spec:
+        raise ValueError("--shape expects NAME=d0,d1,... got %r" % spec)
+    name, dims = spec.split("=", 1)
+    shape = tuple(int(x) for x in dims.strip("()[] ").split(",") if x.strip())
+    return name.strip(), shape
+
+
+def _parse_kv_type(spec: str):
+    if "=" not in spec:
+        raise ValueError("--type expects NAME=dtype, got %r" % spec)
+    name, dt = spec.split("=", 1)
+    return name.strip(), dt.strip()
+
+
+def _zoo_sweep_names():
+    """Deduped zoo keys for --all-models (aliases collapse to one entry)."""
+    from ..models import _ZOO
+
+    seen, names = set(), []
+    for key in sorted(_ZOO):
+        fn = _ZOO[key]
+        marker = getattr(fn, "__wrapped__", None) or fn
+        if id(marker) in seen:
+            continue
+        seen.add(id(marker))
+        names.append(key)
+    return names
+
+
+def _load_target(name, shapes, types, use_defaults):
+    """Resolve one CLI target to (label, symbol, shape_hints, type_hints)."""
+    if name.endswith(".json"):
+        from .. import symbol as sym_mod
+
+        return name, sym_mod.load(name), dict(shapes), dict(types)
+    from .. import models
+
+    sym = models.get_symbol(name)
+    key = name.lower()  # get_symbol lowercases; the shape table must too
+    sh = dict(DEFAULT_SHAPES.get(key, {})) if use_defaults else {}
+    ty = dict(DEFAULT_TYPES.get(key, {})) if use_defaults else {}
+    sh.update(shapes)
+    ty.update(types)
+    return name, sym, sh, ty
+
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser(
+        prog="graphlint",
+        description="Static graph lint for mxnet_tpu Symbols "
+                    "(shape/dtype propagation, retrace guard, fusion "
+                    "explainer). See docs/static_analysis.md.")
+    ap.add_argument("targets", nargs="*",
+                    help="model-zoo names (e.g. resnet-18) or *-symbol.json paths")
+    ap.add_argument("--all-models", action="store_true",
+                    help="lint every bundled model in mxnet_tpu/models/")
+    ap.add_argument("--shape", action="append", default=[],
+                    metavar="NAME=d0,d1,...",
+                    help="shape hint for an input (repeatable)")
+    ap.add_argument("--type", action="append", default=[], dest="types",
+                    metavar="NAME=dtype",
+                    help="dtype hint for an input (repeatable)")
+    ap.add_argument("--no-default-shapes", action="store_true",
+                    help="lint structurally; skip the built-in per-model "
+                         "default shape table")
+    ap.add_argument("--format", choices=("text", "json"), default="text")
+    ap.add_argument("--min-severity", choices=("info", "warning", "error"),
+                    default="info", help="suppress findings below this level "
+                                         "in text output")
+    ap.add_argument("--strict", action="store_true",
+                    help="warnings also fail (exit 1)")
+    ap.add_argument("--passes", default=None,
+                    help="comma-separated pass subset (default: all)")
+    ap.add_argument("--list-codes", action="store_true",
+                    help="print every diagnostic code and exit")
+    args = ap.parse_args(argv)
+
+    if args.list_codes:
+        for code in sorted(CODES):
+            print(describe_code(code))
+        return 0
+
+    targets = list(args.targets)
+    if args.all_models:
+        targets.extend(n for n in _zoo_sweep_names() if n not in targets)
+    if not targets:
+        ap.print_usage(sys.stderr)
+        print("graphlint: no targets (give model names, JSON paths, or "
+              "--all-models)", file=sys.stderr)
+        return 2
+
+    try:
+        shapes = dict(_parse_kv_shape(s) for s in args.shape)
+        types = dict(_parse_kv_type(s) for s in args.types)
+    except ValueError as exc:
+        print("graphlint: %s" % exc, file=sys.stderr)
+        return 2
+
+    from . import lint
+
+    passes = args.passes.split(",") if args.passes else None
+    failed = False
+    load_failed = False
+    json_out = []
+    for target in targets:
+        try:
+            label, sym, sh, ty = _load_target(
+                target, shapes, types, not args.no_default_shapes)
+        except Exception as exc:
+            # keep going: the other targets' reports (and, in json mode,
+            # a machine-readable load_error entry) must still come out
+            print("graphlint: cannot load %r: %s: %s"
+                  % (target, type(exc).__name__, exc), file=sys.stderr)
+            if args.format == "json":
+                json_out.append({"target": target,
+                                 "load_error": "%s: %s"
+                                               % (type(exc).__name__, exc),
+                                 "diagnostics": []})
+            load_failed = True
+            continue
+        try:
+            report = lint(sym, shapes=sh, types=ty, passes=passes,
+                          target=label)
+        except ValueError as exc:  # unknown --passes selection
+            print("graphlint: %s" % exc, file=sys.stderr)
+            return 2
+        if not report.ok(strict=args.strict):
+            failed = True
+        if args.format == "json":
+            json_out.append(json.loads(report.to_json()))
+        else:
+            print(report.format(min_severity=args.min_severity))
+            print()
+    if args.format == "json":
+        print(json.dumps(json_out, indent=2))
+    if load_failed:
+        return 2
+    return 1 if failed else 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

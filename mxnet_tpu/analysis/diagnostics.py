@@ -1,0 +1,203 @@
+"""Structured diagnostics for the static-analysis subsystem.
+
+The reference framework surfaces graph errors through nnvm pass exceptions
+(InferShape failures are a C++ throw with the node name baked into the
+message); XLA surfaces them as multi-page tracebacks from deep inside jit
+tracing. Both lose the *graph-level* story. A ``Diagnostic`` keeps it:
+every finding has a stable code (``GL001`` ...), a severity, the node it
+anchors to, a one-line message, an optional fix hint, and a provenance
+chain (producer nodes with their inferred shapes/dtypes) so the user reads
+"conv1's data input is rank 2 because flatten0 collapsed it" instead of a
+``jax.eval_shape`` stack.
+
+Codes are grouped by pass family:
+  * ``GL0xx`` — shape/dtype propagation lint (``shape_lint.py``)
+  * ``GL1xx`` — engine race analysis (``engine_race.py``)
+  * ``GL2xx`` — pjit retrace guard (``retrace_guard.py``)
+  * ``GL3xx`` — fusion eligibility explainer (``fusion_explain.py``)
+"""
+from __future__ import annotations
+
+import json
+from typing import List, Optional, Sequence
+
+__all__ = ["Severity", "Diagnostic", "Report", "CODES", "describe_code"]
+
+
+class Severity:
+    """Ordered severity levels. ``ERROR`` means a bind/run would fail or
+    produce wrong results; ``WARNING`` means probably-unintended behavior;
+    ``INFO`` is explanatory (fusion rejections, retrace economics)."""
+
+    INFO = "info"
+    WARNING = "warning"
+    ERROR = "error"
+
+    _ORDER = {INFO: 0, WARNING: 1, ERROR: 2}
+
+    @classmethod
+    def rank(cls, sev: str) -> int:
+        return cls._ORDER[sev]
+
+
+# code -> (default severity, one-line description). docs/static_analysis.md
+# documents each in depth; tests/test_graphlint.py triggers each one.
+CODES = {
+    # --- shape/dtype propagation lint ------------------------------------
+    "GL001": (Severity.ERROR,
+              "unbindable node: op-level shape/dtype inference failed"),
+    "GL002": (Severity.ERROR,
+              "underdetermined argument shape after applying all hints"),
+    "GL003": (Severity.ERROR,
+              "declared shape conflicts with the inferred shape"),
+    "GL004": (Severity.WARNING,
+              "silent dtype promotion across mixed-dtype inputs"),
+    "GL005": (Severity.ERROR,
+              "duplicate node name (bind-by-name would collide)"),
+    "GL006": (Severity.ERROR,
+              "input rank violates the op's declared rank constraints"),
+    # --- engine race analysis --------------------------------------------
+    "GL101": (Severity.WARNING,
+              "variable appears in both const_vars and mutable_vars of one push"),
+    "GL102": (Severity.WARNING,
+              "wait_for_var on a variable no push ever writes"),
+    "GL103": (Severity.WARNING,
+              "duplicate variable inside one push's mutable_vars (write-write)"),
+    "GL104": (Severity.WARNING,
+              "read of a variable with no preceding write (unordered read-write)"),
+    "GL105": (Severity.ERROR,
+              "runtime engine-discipline violation (ops overlapped on a var)"),
+    # --- retrace guard -----------------------------------------------------
+    "GL201": (Severity.INFO,
+              "python scalar baked into the trace as an op attribute"),
+    "GL202": (Severity.WARNING,
+              "weak-dtype input alongside explicitly-typed variables"),
+    "GL203": (Severity.INFO,
+              "shape-polymorphic inputs: compile-cache cardinality grows per shape"),
+    # --- fusion explainer --------------------------------------------------
+    "GL301": (Severity.INFO,
+              "convolution rejected by the conv+BN fusion planner"),
+    "GL302": (Severity.INFO,
+              "BatchNorm not folded into its consumers by the fusion planner"),
+}
+
+
+def describe_code(code: str) -> str:
+    sev, desc = CODES[code]
+    return "%s [%s] %s" % (code, sev, desc)
+
+
+class Diagnostic:
+    """One finding: ``code``, ``severity``, ``node``, ``message``,
+    ``fix_hint``, ``provenance`` (producer chain lines)."""
+
+    __slots__ = ("code", "severity", "node", "op", "message", "fix_hint",
+                 "provenance", "pass_name")
+
+    def __init__(self, code: str, message: str, node: Optional[str] = None,
+                 op: Optional[str] = None, fix_hint: Optional[str] = None,
+                 provenance: Optional[Sequence[str]] = None,
+                 severity: Optional[str] = None, pass_name: str = ""):
+        if code not in CODES:
+            raise KeyError("unknown diagnostic code %r" % code)
+        self.code = code
+        self.severity = severity or CODES[code][0]
+        self.node = node
+        self.op = op
+        self.message = message
+        self.fix_hint = fix_hint
+        self.provenance = list(provenance or [])
+        self.pass_name = pass_name
+
+    def format(self, color: bool = False) -> str:
+        where = ""
+        if self.node:
+            where = " @ %s" % self.node
+            if self.op:
+                where += " (%s)" % self.op
+        head = "%s %s%s: %s" % (self.code, self.severity, where, self.message)
+        lines = [head]
+        for p in self.provenance:
+            lines.append("    | " + p)
+        if self.fix_hint:
+            lines.append("    hint: " + self.fix_hint)
+        return "\n".join(lines)
+
+    def to_dict(self) -> dict:
+        return {
+            "code": self.code,
+            "severity": self.severity,
+            "node": self.node,
+            "op": self.op,
+            "message": self.message,
+            "fix_hint": self.fix_hint,
+            "provenance": list(self.provenance),
+            "pass": self.pass_name,
+        }
+
+    def __repr__(self):
+        return "<Diagnostic %s %s @ %s>" % (self.code, self.severity, self.node)
+
+
+class Report:
+    """An ordered collection of diagnostics from one lint run."""
+
+    def __init__(self, target: str = ""):
+        self.target = target
+        self.diagnostics: List[Diagnostic] = []
+
+    def add(self, diag: Diagnostic):
+        self.diagnostics.append(diag)
+
+    def extend(self, diags):
+        self.diagnostics.extend(diags)
+
+    def __iter__(self):
+        return iter(self.diagnostics)
+
+    def __len__(self):
+        return len(self.diagnostics)
+
+    def by_code(self, code: str) -> List[Diagnostic]:
+        return [d for d in self.diagnostics if d.code == code]
+
+    def codes(self):
+        return sorted({d.code for d in self.diagnostics})
+
+    def at_least(self, severity: str) -> List[Diagnostic]:
+        floor = Severity.rank(severity)
+        return [d for d in self.diagnostics if Severity.rank(d.severity) >= floor]
+
+    @property
+    def errors(self) -> List[Diagnostic]:
+        return self.at_least(Severity.ERROR)
+
+    @property
+    def warnings(self) -> List[Diagnostic]:
+        return [d for d in self.diagnostics if d.severity == Severity.WARNING]
+
+    def ok(self, strict: bool = False) -> bool:
+        """No errors (and, with ``strict``, no warnings either)."""
+        return not self.at_least(Severity.WARNING if strict else Severity.ERROR)
+
+    def format(self, min_severity: str = Severity.INFO) -> str:
+        shown = self.at_least(min_severity)
+        lines = []
+        if self.target:
+            lines.append("== graphlint: %s ==" % self.target)
+        if not shown:
+            lines.append("clean (%d suppressed below %r)"
+                         % (len(self.diagnostics) - len(shown), min_severity)
+                         if self.diagnostics else "clean")
+        for d in shown:
+            lines.append(d.format())
+        n_err, n_warn = len(self.errors), len(self.warnings)
+        lines.append("%d error(s), %d warning(s), %d total finding(s)"
+                     % (n_err, n_warn, len(self.diagnostics)))
+        return "\n".join(lines)
+
+    def to_json(self) -> str:
+        return json.dumps({
+            "target": self.target,
+            "diagnostics": [d.to_dict() for d in self.diagnostics],
+        }, indent=2)

@@ -1,0 +1,99 @@
+"""Fusion-eligibility explainer (GL301/GL302).
+
+``fusion.plan`` silently skips every conv/BN it cannot rewrite onto the
+Pallas kernel stack — correct, but invisible: a model author who expected
+the fused path has no way to learn *which* predicate failed short of
+reading the planner. This pass re-runs the plan and reports, for every
+rejected Convolution (GL301) and every unfolded BatchNorm (GL302), the
+exact predicate, quoting ``fusion.conv_reject_reason`` /
+``fusion.bn_reject_reason`` for op-level predicates and re-deriving the
+consumer-structure predicates for fold rejections.
+
+All findings are INFO severity: an unfused graph is slower, not wrong.
+"""
+from __future__ import annotations
+
+from .diagnostics import Diagnostic
+from .manager import GraphContext, graph_pass
+
+__all__ = ["fusion_explain"]
+
+
+def _is_relu(node):
+    return (node.op == "Activation"
+            and node.parsed_attrs().get("act_type") == "relu")
+
+
+def _explain_no_fold(ctx: GraphContext, node, directives):
+    """Why an eligible BatchNorm's directive has fold=False — mirrors the
+    consumer walk in fusion.plan, returning the failed predicate."""
+    from .. import fusion
+
+    cons = ctx.consumers.get(id(node), [])
+    if not cons:
+        return "its output is a graph head; there is no consumer to fold into"
+    bad_index = [c for c, oi in cons if oi != 0]
+    if bad_index:
+        return ("outputs other than the normalized activation are consumed "
+                "(e.g. by %s)" % bad_index[0].name)
+    targets = [c for c, _ in cons]
+    src, src_desc = node, "the BN output"
+    if len(targets) == 1 and _is_relu(targets[0]):
+        relu = targets[0]
+        relu_cons = ctx.consumers.get(id(relu), [])
+        if any(oi != 0 for _, oi in relu_cons):
+            return "the relu's secondary outputs are consumed"
+        targets = [c for c, _ in relu_cons]
+        src, src_desc = relu, "the relu(BN) output"
+        if not targets:
+            return "the relu output is a graph head; nothing to fold into"
+    for c in targets:
+        d = directives.get(id(c))
+        if d is None or d.get("kind") != "conv":
+            reason = fusion.conv_reject_reason(c)
+            return ("%s feeds %s(%s), which is not a fusable convolution: %s"
+                    % (src_desc, c.name, c.op, reason))
+        if not (c.inputs and c.inputs[0][0] is src):
+            return ("%s feeds %s's weight input, not its data input"
+                    % (src_desc, c.name))
+    return "planner declined the fold (unmatched consumer pattern)"
+
+
+@graph_pass("fusion_explain")
+def fusion_explain(ctx: GraphContext):
+    from .. import fusion
+
+    diags = []
+    directives = fusion.plan(ctx.topo)
+    for node in ctx.topo:
+        if node.is_variable:
+            continue
+        if node.op == "Convolution":
+            reason = fusion.conv_reject_reason(node)
+            if reason is not None:
+                diags.append(Diagnostic(
+                    "GL301",
+                    "not eligible for the Pallas conv+BN path: %s" % reason,
+                    node=node.name, op=node.op,
+                    fix_hint="this conv runs on the ordinary XLA lowering; "
+                             "see docs/PERF.md §6 for the supported shapes",
+                ))
+        elif node.op == "BatchNorm":
+            reason = fusion.bn_reject_reason(node)
+            if reason is not None:
+                diags.append(Diagnostic(
+                    "GL302",
+                    "not eligible for fusion: %s" % reason,
+                    node=node.name, op=node.op,
+                ))
+                continue
+            d = directives.get(id(node))
+            if d is not None and d.get("kind") == "bn" and not d.get("fold"):
+                diags.append(Diagnostic(
+                    "GL302",
+                    "eligible but not folded: %s" % _explain_no_fold(ctx, node, directives),
+                    node=node.name, op=node.op,
+                    fix_hint="a fold needs every consumer of the BN(+relu) "
+                             "output to be the data input of a fusable conv",
+                ))
+    return diags

@@ -79,6 +79,21 @@ def _load_lib():
 _OPFN = ctypes.CFUNCTYPE(None, ctypes.c_void_p)
 
 
+def _unknown_var_error(var):
+    """``wait_for_var`` on a var this engine never issued nor saw in a push
+    used to be undefined behavior (return-immediately at best, a native wait
+    on a phantom id at worst — found while speccing the race detector,
+    analysis/engine_race.py GL102). Make it a loud, clear error."""
+    return MXNetError(
+        "wait_for_var: unknown engine variable %r — never created by "
+        "new_variable() nor used by any push on this engine, so waiting on "
+        "it is undefined. Note: vars do not survive set_engine_type(); this "
+        "check is best-effort and a stale id can still alias a var the new "
+        "engine issued, so callers holding vars across a swap must compare "
+        "engine identity themselves (as model.py's checkpoint vars do)."
+        % (var,))
+
+
 class Engine:
     """Engine interface (reference: include/mxnet/engine.h Engine)."""
 
@@ -91,6 +106,9 @@ class Engine:
         raise NotImplementedError
 
     def wait_for_var(self, var):
+        """Block until every pending op touching ``var`` drains. Raises
+        ``MXNetError`` if ``var`` was never created by (or pushed through)
+        this engine."""
         raise NotImplementedError
 
     def wait_for_all(self):
@@ -103,6 +121,10 @@ class NaiveEngine(Engine):
 
     def __init__(self):
         self._next = 1
+        # FOREIGN var ids only (not issued by new_variable) — issued ids are
+        # covered by the 1.._next watermark, so this set stays empty in
+        # normal use and never grows per batch
+        self._pushed = set()
 
     def new_variable(self):
         v = self._next
@@ -110,10 +132,15 @@ class NaiveEngine(Engine):
         return v
 
     def push(self, fn, const_vars=(), mutable_vars=()):
+        for v in (*const_vars, *mutable_vars):
+            if not (isinstance(v, int) and 1 <= v < self._next):
+                self._pushed.add(v)
         fn()
 
     def wait_for_var(self, var):
-        pass
+        if not (isinstance(var, int) and 1 <= var < self._next) \
+                and var not in self._pushed:
+            raise _unknown_var_error(var)
 
     def wait_for_all(self):
         pass
@@ -134,6 +161,11 @@ class ThreadedEngine(Engine):
         self._next_op = 1
         self._errors = []
         self._done = []  # completed op ids whose thunks can be purged
+        # native ids are sequential from 1 (src/engine_native.cc next_var_),
+        # so issued vars are covered by a watermark; only FOREIGN ids seen in
+        # pushes need a set — empty in normal use, never grows per batch
+        self._max_issued = 0
+        self._foreign_vars = set()
         if self._lib is not None:
             self._handle = ctypes.c_void_p(self._lib.mxeng_create(num_workers))
         else:
@@ -146,11 +178,17 @@ class ThreadedEngine(Engine):
     def new_variable(self):
         if self._lib is None:
             return self._py.new_variable()
-        return self._lib.mxeng_new_var(self._handle)
+        v = self._lib.mxeng_new_var(self._handle)
+        if v > self._max_issued:
+            self._max_issued = v
+        return v
 
     def push(self, fn, const_vars=(), mutable_vars=()):
         if self._lib is None:
             return self._py.push(fn, const_vars, mutable_vars)
+        for v in (*const_vars, *mutable_vars):
+            if not (isinstance(v, int) and 1 <= v <= self._max_issued):
+                self._foreign_vars.add(v)
         with self._keep_lock:
             op_id = self._next_op
             self._next_op += 1
@@ -178,6 +216,11 @@ class ThreadedEngine(Engine):
     def wait_for_var(self, var):
         if self._lib is None:
             return self._py.wait_for_var(var)
+        if not (isinstance(var, int) and 1 <= var <= self._max_issued) \
+                and var not in self._foreign_vars:
+            # the native GetVar would silently conjure a fresh idle Var for
+            # any int64 — return-immediately on a typo'd id. Fail loudly.
+            raise _unknown_var_error(var)
         self._lib.mxeng_wait_for_var(self._handle, var)
         self._raise_pending()
 
@@ -296,6 +339,11 @@ class _PythonThreadedEngine(Engine):
 
     def wait_for_var(self, var):
         with self._cond:
+            if var not in self._var_queues:
+                # neither new_variable() nor any push registered this id —
+                # the old behavior (return immediately) silently "succeeded"
+                # on typo'd/stale vars
+                raise _unknown_var_error(var)
             self._cond.wait_for(
                 lambda: not self._var_queues.get(var)
                 and self._running.get(var, [0, False]) == [0, False])

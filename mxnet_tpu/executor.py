@@ -428,6 +428,25 @@ def _normalize_grad_req(grad_req, arg_names):
     raise TypeError("grad_req must be str/list/dict")
 
 
+def _lint_at_bind(symbol, arg_arrays, arg_names, aux_arrays, aux_names):
+    """MXNET_GRAPHLINT=warn|error hook: run the static passes with the
+    concrete bind shapes/dtypes (analysis/: the nnvm-attribute-pass
+    analogue). ``warn`` logs findings; ``error`` raises MXNetError with the
+    structured report instead of letting a broken graph reach jit tracing."""
+    from .analysis import graphlint_mode, lint_bind
+
+    mode = graphlint_mode()
+    if mode is None:
+        return
+    shapes = {n: tuple(a.shape) for n, a in zip(arg_names, arg_arrays)
+              if a is not None}
+    types = {n: np.dtype(a.dtype) for n, a in zip(arg_names, arg_arrays)
+             if a is not None}
+    shapes.update({n: tuple(a.shape) for n, a in zip(aux_names, aux_arrays)})
+    types.update({n: np.dtype(a.dtype) for n, a in zip(aux_names, aux_arrays)})
+    lint_bind(symbol, shapes, types, mode, target="bind")
+
+
 def bind(symbol, ctx, args, args_grad=None, grad_req="write", aux_states=None, shared_exec=None, group2ctx=None):
     """Bind NDArrays to a symbol's arguments (reference: symbol.py:917 bind →
     Executor::Bind, graph_executor.cc:936)."""
@@ -474,6 +493,7 @@ def bind(symbol, ctx, args, args_grad=None, grad_req="write", aux_states=None, s
         if len(aux_arrays) != len(aux_names):
             raise MXNetError("bind: expected %d aux states, got %d" % (len(aux_names), len(aux_arrays)))
 
+    _lint_at_bind(symbol, arg_arrays, arg_names, aux_arrays, aux_names)
     return Executor(symbol, ctx, arg_arrays, grad_arrays, reqs, aux_arrays, program=prog)
 
 
@@ -484,8 +504,23 @@ def simple_bind(symbol, ctx, grad_req="write", type_dict=None, group2ctx=None, s
     type_hints = {k: np_dtype(v) for k, v in (type_dict or {}).items()}
     try:
         res = symbol._infer_impl(shape_hints, type_hints, partial=False)
-    except MXNetError as e:
-        raise MXNetError("simple_bind failed: %s" % e)
+    except Exception as e:
+        from .analysis import graphlint_mode
+
+        if graphlint_mode() is not None:
+            # diagnose the failure with the full pass suite: structured
+            # per-node findings with provenance instead of a jit traceback
+            from .analysis import lint
+
+            report = lint(symbol, shapes=shape_hints, types=type_hints,
+                          strict_shapes=True, target="simple_bind")
+            if report.errors:
+                raise MXNetError(
+                    "simple_bind failed: %s\ngraphlint diagnosis:\n%s"
+                    % (e, report.format(min_severity="warning")))
+        if isinstance(e, MXNetError):
+            raise MXNetError("simple_bind failed: %s" % e)
+        raise
     arg_shapes, out_shapes, aux_shapes, arg_types, out_types, aux_types = res
     ctx = Context(ctx) if not isinstance(ctx, Context) else ctx
 

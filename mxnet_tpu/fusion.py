@@ -40,7 +40,8 @@ import jax.numpy as jnp
 
 from .ops.pallas_conv_bn import _xla_conv, conv_block, supported
 
-__all__ = ["plan", "execute", "resolve", "gate"]
+__all__ = ["plan", "execute", "resolve", "gate",
+           "conv_reject_reason", "bn_reject_reason"]
 
 
 # --------------------------------------------------------------------- values
@@ -139,30 +140,64 @@ def _pair(v, fill):
     return v if len(v) == 2 else (fill, fill)
 
 
-def _conv_cfg(node):
-    """(kernel, stride) if this Convolution can run on the Pallas path
-    (structurally — shape gating happens at trace time), else None."""
-    if node.op != "Convolution" or len(node.inputs) != 2:  # bias present -> no
-        return None
+def conv_reject_reason(node):
+    """The exact predicate that bars this Convolution from the Pallas path,
+    or None when it is structurally eligible (shape gating still happens at
+    trace time). The analysis subsystem (analysis/fusion_explain.py) reports
+    these verbatim, so keep each reason a precise, single predicate."""
+    if node.op != "Convolution":
+        return "not a Convolution"
+    if len(node.inputs) != 2:
+        return "bias input present (no_bias=False): the kernel has no bias epilogue"
     a = node.parsed_attrs()
     kernel = tuple(a.get("kernel") or ())
     stride = _pair(a.get("stride"), 1)
     pad = _pair(a.get("pad"), 0)
     dilate = _pair(a.get("dilate"), 1)
-    if a.get("num_group", 1) != 1 or dilate != (1, 1):
+    if a.get("num_group", 1) != 1:
+        return "grouped convolution (num_group=%s != 1)" % a.get("num_group")
+    if dilate != (1, 1):
+        return "dilated convolution (dilate=%s)" % (dilate,)
+    if kernel == (1, 1):
+        if pad != (0, 0):
+            return "1x1 kernel needs pad=(0, 0), got pad=%s" % (pad,)
+        if stride not in ((1, 1), (2, 2)):
+            return "1x1 kernel needs stride (1, 1) or (2, 2), got %s" % (stride,)
         return None
-    if kernel == (1, 1) and pad == (0, 0) and stride in ((1, 1), (2, 2)):
-        return kernel, stride
-    if kernel == (3, 3) and pad == (1, 1) and stride == (1, 1):
-        return kernel, stride
+    if kernel == (3, 3):
+        if pad != (1, 1):
+            return "3x3 kernel needs pad=(1, 1), got pad=%s" % (pad,)
+        if stride != (1, 1):
+            return "3x3 kernel needs stride=(1, 1), got %s" % (stride,)
+        return None
+    return ("kernel %s has no Pallas variant (supported: 1x1 pad 0 stride "
+            "1 or 2; 3x3 pad 1 stride 1)" % (kernel,))
+
+
+def _conv_cfg(node):
+    """(kernel, stride) if this Convolution can run on the Pallas path
+    (structurally — shape gating happens at trace time), else None."""
+    if conv_reject_reason(node) is not None:
+        return None
+    a = node.parsed_attrs()
+    return tuple(a.get("kernel") or ()), _pair(a.get("stride"), 1)
+
+
+def bn_reject_reason(node):
+    """The exact predicate that bars this BatchNorm from the fusion plan,
+    or None when eligible."""
+    if node.op != "BatchNorm":
+        return "not a BatchNorm"
+    a = node.parsed_attrs()
+    if a.get("use_global_stats"):
+        return "use_global_stats=True: inference-style BN never runs the batch statistics pass the fusion reuses"
+    if a.get("output_mean_var"):
+        return "output_mean_var=True: the mean/var outputs must materialize, so the BN cannot stay folded"
     return None
 
 
 def _bn_ok(node):
-    if node.op != "BatchNorm":
-        return False
-    a = node.parsed_attrs()
-    return not a.get("use_global_stats") and not a.get("output_mean_var")
+    return bn_reject_reason(node) is None
 
 
 def plan(topo):

@@ -31,7 +31,11 @@ __all__ = ["save_checkpoint", "load_checkpoint", "find_last_checkpoint",
 
 
 # per-prefix engine variables: successive epoch writes to one prefix are
-# serialized; readers (load/find_last_checkpoint) wait on the same var
+# serialized; readers (load/find_last_checkpoint) wait on the same var.
+# Each entry is (engine, var): vars do NOT survive set_engine_type, and a
+# stale id may even alias a var the NEW engine issued, so the engine
+# identity stored here is the authoritative staleness check (the swap
+# already drained the old engine, so a stale entry is simply dropped).
 _ckpt_vars = {}
 # a failed async write must not vanish: the error re-raises at the next
 # save/load/find on the same prefix (and is logged when it happens)
@@ -61,9 +65,10 @@ def save_checkpoint(prefix, epoch, symbol, arg_params, aux_params):
     key = os.path.abspath(prefix)
     _raise_pending_ckpt_error(key)
     eng = engine.get()
-    if key not in _ckpt_vars:
-        _ckpt_vars[key] = eng.new_variable()
-    var = _ckpt_vars[key]
+    entry = _ckpt_vars.get(key)
+    if entry is None or entry[0] is not eng:
+        _ckpt_vars[key] = (eng, eng.new_variable())
+    var = _ckpt_vars[key][1]
 
     def write():
         try:
@@ -86,11 +91,17 @@ def _raise_pending_ckpt_error(key):
 
 def _wait_checkpoint_writes(prefix):
     key = os.path.abspath(prefix)
-    var = _ckpt_vars.get(key)
-    if var is not None:
+    entry = _ckpt_vars.get(key)
+    if entry is not None:
         from . import engine
 
-        engine.get().wait_for_var(var)
+        eng, var = entry
+        if eng is engine.get():
+            eng.wait_for_var(var)
+        else:
+            # engine swapped since the write was pushed: set_engine_type
+            # drained the old engine, so the write already landed
+            del _ckpt_vars[key]
     _raise_pending_ckpt_error(key)
 
 

@@ -8,6 +8,7 @@ symbolic Symbol frontend, like the NNVM registry was for the reference.
 """
 from . import registry
 from .registry import AttrSpec, OpDef, get_op, has_op, list_ops, parse_attrs, register
+from . import infer_meta  # per-op shape/dtype metadata for analysis passes
 
 # importing these modules populates the registry
 from . import elemwise  # noqa: F401

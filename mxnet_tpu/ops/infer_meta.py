@@ -1,0 +1,142 @@
+"""Per-op shape/dtype inference metadata.
+
+One registry both the executor-side inference (``symbol._infer_impl`` via
+``shape_rules.RULES``) and the static-analysis passes share, so lint rules
+are never re-derived per pass. The reference kept the same facts scattered
+across per-op ``FInferShape``/``FInferType`` lambdas and dmlc parameter
+structs; here they are declarative:
+
+  * ``input_ranks``  — slot name -> required rank (int) or (min, max) range;
+                       the lint pass turns violations into ``GL006`` with the
+                       provenance chain instead of a ``jax.eval_shape`` crash.
+  * ``dtype_policy`` — how the op treats input dtypes:
+                       ``"promote"`` numpy-promotes its inputs (mixed input
+                       dtypes silently widen — lint warns ``GL004``),
+                       ``"forced"`` output dtype comes from a ``dtype`` attr
+                       (Cast, creation ops), ``"first"`` follows the first
+                       input, ``"bool"`` emits comparison results.
+  * ``param_slots``  — input slots holding *learned parameters* (their shapes
+                       flow backward via ``shape_rules``); everything else is
+                       data-like, which is what the retrace guard (``GL203``)
+                       uses to name the inputs that drive compile-cache
+                       cardinality.
+
+``backward_shape_rule(op)`` re-exports ``shape_rules.RULES`` so callers need
+only this module.
+"""
+from __future__ import annotations
+
+from typing import Dict, Optional, Tuple
+
+from .shape_rules import RULES as _BACKWARD_RULES
+
+__all__ = ["OpMeta", "register_meta", "get_meta", "backward_shape_rule",
+           "rank_range"]
+
+
+def rank_range(v) -> Optional[Tuple[int, int]]:
+    """Normalize a rank constraint to an inclusive (min, max) pair."""
+    if v is None:
+        return None
+    if isinstance(v, int):
+        return (v, v)
+    lo, hi = v
+    return (lo, 10 ** 9 if hi is None else hi)
+
+
+class OpMeta:
+    __slots__ = ("name", "input_ranks", "dtype_policy", "param_slots")
+
+    def __init__(self, name: str, input_ranks=None, dtype_policy: str = "promote",
+                 param_slots: Tuple[str, ...] = ()):
+        self.name = name
+        self.input_ranks: Dict[str, Tuple[int, int]] = {
+            slot: rank_range(r) for slot, r in (input_ranks or {}).items()
+        }
+        self.dtype_policy = dtype_policy
+        self.param_slots = tuple(param_slots)
+
+
+_META: Dict[str, OpMeta] = {}
+
+_DEFAULT = OpMeta("<default>")
+
+
+def register_meta(name, input_ranks=None, dtype_policy="promote",
+                  param_slots=(), aliases=()):
+    meta = OpMeta(name, input_ranks=input_ranks, dtype_policy=dtype_policy,
+                  param_slots=param_slots)
+    for n in (name,) + tuple(aliases):
+        _META[n] = meta
+    return meta
+
+
+def get_meta(op_name: str) -> OpMeta:
+    """Metadata for an op; unregistered ops get a permissive default
+    (no rank constraints, promote dtype policy, no param slots)."""
+    return _META.get(op_name, _DEFAULT)
+
+
+def backward_shape_rule(op_name: str):
+    """The backward-flowing parameter-shape rule for an op, or None —
+    the same table ``symbol._infer_impl`` consumes (shape_rules.RULES)."""
+    return _BACKWARD_RULES.get(op_name)
+
+
+# ---------------------------------------------------------------------------
+# Seed metadata for the bundled operator set. Rank facts mirror what each
+# op's JAX implementation requires (NCHW layouts per SURVEY §2.3); param
+# slots mirror shape_rules.py — the two stay adjacent on purpose.
+# ---------------------------------------------------------------------------
+register_meta("Convolution",
+              input_ranks={"data": 4, "weight": 4, "bias": 1},
+              param_slots=("weight", "bias"))
+register_meta("Deconvolution",
+              input_ranks={"data": 4, "weight": 4, "bias": 1},
+              param_slots=("weight", "bias"))
+register_meta("FullyConnected",
+              input_ranks={"data": (1, None), "weight": 2, "bias": 1},
+              param_slots=("weight", "bias"))
+register_meta("BatchNorm",
+              input_ranks={"data": (2, 5), "gamma": 1, "beta": 1,
+                           "moving_mean": 1, "moving_var": 1},
+              param_slots=("gamma", "beta"))
+register_meta("InstanceNorm",
+              input_ranks={"data": (3, 5), "gamma": 1, "beta": 1},
+              param_slots=("gamma", "beta"))
+register_meta("L2Normalization", input_ranks={"data": (2, None)})
+register_meta("LRN", input_ranks={"data": 4})
+register_meta("Pooling", input_ranks={"data": 4})
+register_meta("Activation", dtype_policy="first")
+register_meta("LeakyReLU", param_slots=("gamma",))
+register_meta("Dropout", dtype_policy="first")
+register_meta("Flatten", input_ranks={"data": (1, None)}, dtype_policy="first")
+register_meta("Reshape", dtype_policy="first")
+register_meta("transpose", dtype_policy="first")
+register_meta("SwapAxis", dtype_policy="first")
+register_meta("expand_dims", dtype_policy="first")
+register_meta("Cast", dtype_policy="forced")
+register_meta("Embedding",
+              input_ranks={"weight": 2},
+              dtype_policy="first",
+              param_slots=("weight",))
+register_meta("RNN",
+              input_ranks={"data": 3, "parameters": 1,
+                           "state": 3, "state_cell": 3},
+              param_slots=("parameters",))
+register_meta("SoftmaxOutput", dtype_policy="first")
+register_meta("SoftmaxActivation", dtype_policy="first")
+register_meta("LinearRegressionOutput", dtype_policy="first")
+register_meta("LogisticRegressionOutput", dtype_policy="first")
+register_meta("MAERegressionOutput", dtype_policy="first")
+register_meta("SVMOutput", dtype_policy="first")
+register_meta("MakeLoss", dtype_policy="first")
+register_meta("BlockGrad", dtype_policy="first")
+register_meta("Concat", dtype_policy="promote")
+register_meta("batch_dot", input_ranks={"lhs": 3, "rhs": 3})
+register_meta("dot", input_ranks={"lhs": (1, 2), "rhs": (1, 2)})
+
+for _cmp in ("_equal", "_not_equal", "_greater", "_greater_equal",
+             "_lesser", "_lesser_equal"):
+    register_meta(_cmp, dtype_policy="bool")
+    register_meta(_cmp + "_scalar", dtype_policy="bool")

@@ -1,0 +1,533 @@
+"""graphlint test suite (analysis/ subsystem).
+
+Every diagnostic code ships with BOTH a trigger (a deliberately-broken
+graph or schedule that fires it) and a clean case (a healthy graph or
+schedule that does not) — parametrized from one table so the completeness
+meta-test can prove no code is untested. Plus: bind-time integration
+(MXNET_GRAPHLINT=warn|error), the engine wait_for_var satellite fix, the
+infer_meta registry, the CLI, and the models/resnet.py lint-clean
+regression.
+"""
+import json
+import threading
+import time
+
+import numpy as np
+import pytest
+
+import mxnet_tpu as mx
+from mxnet_tpu import analysis
+from mxnet_tpu import engine as eng
+from mxnet_tpu.analysis import CODES, RecordingEngine, analyze_trace
+
+
+def _codes(sym, **kw):
+    return set(analysis.lint(sym, **kw).codes())
+
+
+# --------------------------------------------------------------------------
+# graph-code table: code -> (broken_builder, clean_builder), each returning
+# (symbol, lint_kwargs)
+# --------------------------------------------------------------------------
+def _gl001_broken():
+    a = mx.sym.Variable("a", shape=(2, 3))
+    b = mx.sym.Variable("b", shape=(4, 5))
+    return mx.sym.dot(a, b, name="baddot"), {}
+
+
+def _gl001_clean():
+    a = mx.sym.Variable("a", shape=(2, 3))
+    b = mx.sym.Variable("b", shape=(3, 5))
+    return mx.sym.dot(a, b, name="okdot"), {}
+
+
+def _gl002_broken():
+    d = mx.sym.Variable("data")
+    e = mx.sym.Variable("extra")
+    s = mx.sym.FullyConnected(data=d, num_hidden=4, name="fcA") \
+        + mx.sym.FullyConnected(data=e, num_hidden=4, name="fcB")
+    return s, {"shapes": {"data": (2, 8)}}  # 'extra' stays unknown
+
+
+def _gl002_clean():
+    d = mx.sym.Variable("data")
+    s = mx.sym.FullyConnected(data=d, num_hidden=4, name="fcC")
+    return s, {"shapes": {"data": (2, 8)}}
+
+
+def _gl003_broken():
+    d = mx.sym.Variable("data")
+    w = mx.sym.Variable("fc_weight", shape=(7, 99))
+    return (mx.sym.FullyConnected(data=d, weight=w, num_hidden=7, name="fc"),
+            {"shapes": {"data": (2, 10)}})
+
+
+def _gl003_clean():
+    d = mx.sym.Variable("data")
+    w = mx.sym.Variable("fc_weight", shape=(7, 10))
+    return (mx.sym.FullyConnected(data=d, weight=w, num_hidden=7, name="fc"),
+            {"shapes": {"data": (2, 10)}})
+
+
+def _gl004_broken():
+    x = mx.sym.Variable("x", dtype="float16")
+    y = mx.sym.Variable("y", dtype="float32")
+    return x + y, {"shapes": {"x": (2,), "y": (2,)}}
+
+
+def _gl004_clean():
+    x = mx.sym.Variable("x", dtype="float16")
+    y = mx.sym.Variable("y", dtype="float16")
+    return x + y, {"shapes": {"x": (2,), "y": (2,)}}
+
+
+def _gl005_broken():
+    return mx.sym.Variable("dup") + mx.sym.Variable("dup"), \
+        {"shapes": {"dup": (2,)}}
+
+
+def _gl005_clean():
+    return mx.sym.Variable("p") + mx.sym.Variable("q"), \
+        {"shapes": {"p": (2,), "q": (2,)}}
+
+
+def _gl006_broken():
+    d = mx.sym.Variable("data")
+    flat = mx.sym.Flatten(data=d)
+    return (mx.sym.Convolution(data=flat, num_filter=8, kernel=(3, 3),
+                               name="badconv"),
+            {"shapes": {"data": (2, 3, 8, 8)}})
+
+
+def _gl006_clean():
+    d = mx.sym.Variable("data")
+    return (mx.sym.Convolution(data=d, num_filter=8, kernel=(3, 3),
+                               pad=(1, 1), name="okconv"),
+            {"shapes": {"data": (2, 3, 8, 8)}})
+
+
+def _gl201_broken():
+    return mx.sym.Variable("x") * 0.125, {}
+
+
+def _gl201_clean():
+    return mx.sym.Variable("x") + mx.sym.Variable("y"), {}
+
+
+def _gl202_broken():
+    h = mx.sym.Variable("h", dtype="float16")
+    x = mx.sym.Variable("x")  # weak: defaults to f32 at trace time
+    return x + h, {}
+
+
+def _gl202_clean():
+    h = mx.sym.Variable("h", dtype="float16")
+    x = mx.sym.Variable("x", dtype="float16")
+    return x + h, {}
+
+
+def _gl203_broken():
+    # no shape hints at all: data inputs are shape-polymorphic
+    return mx.sym.FullyConnected(data=mx.sym.Variable("data"),
+                                 num_hidden=4, name="fcP"), {}
+
+
+def _gl203_clean():
+    return mx.sym.FullyConnected(data=mx.sym.Variable("data"),
+                                 num_hidden=4, name="fcP"), \
+        {"shapes": {"data": (2, 8)}}
+
+
+def _fusable_chain(kernel=(3, 3), pad=(1, 1), no_bias=True, name="c"):
+    d = mx.sym.Variable("data")
+    bn = mx.sym.BatchNorm(data=d, fix_gamma=False, name=name + "_bn")
+    act = mx.sym.Activation(data=bn, act_type="relu", name=name + "_relu")
+    return mx.sym.Convolution(data=act, num_filter=8, kernel=kernel, pad=pad,
+                              no_bias=no_bias, name=name + "_conv")
+
+
+def _gl301_broken():
+    # bias present -> the planner's first predicate fails
+    return _fusable_chain(no_bias=False, name="biased"), {}
+
+
+def _gl301_clean():
+    return _fusable_chain(name="fusable"), {}
+
+
+def _gl302_broken():
+    # BN feeding a pooling layer: eligible BN, but nothing to fold into
+    d = mx.sym.Variable("data")
+    bn = mx.sym.BatchNorm(data=d, fix_gamma=False, name="pool_bn")
+    return mx.sym.Pooling(data=bn, kernel=(2, 2), pool_type="max",
+                          name="pool"), {}
+
+
+def _gl302_clean():
+    return _fusable_chain(name="folded"), {}
+
+
+GRAPH_CODE_CASES = {
+    "GL001": (_gl001_broken, _gl001_clean),
+    "GL002": (_gl002_broken, _gl002_clean),
+    "GL003": (_gl003_broken, _gl003_clean),
+    "GL004": (_gl004_broken, _gl004_clean),
+    "GL005": (_gl005_broken, _gl005_clean),
+    "GL006": (_gl006_broken, _gl006_clean),
+    "GL201": (_gl201_broken, _gl201_clean),
+    "GL202": (_gl202_broken, _gl202_clean),
+    "GL203": (_gl203_broken, _gl203_clean),
+    "GL301": (_gl301_broken, _gl301_clean),
+    "GL302": (_gl302_broken, _gl302_clean),
+}
+
+
+@pytest.mark.parametrize("code", sorted(GRAPH_CODE_CASES))
+def test_graph_code_triggers_on_broken_graph(code):
+    sym, kw = GRAPH_CODE_CASES[code][0]()
+    assert code in _codes(sym, **kw)
+
+
+@pytest.mark.parametrize("code", sorted(GRAPH_CODE_CASES))
+def test_graph_code_silent_on_clean_graph(code):
+    sym, kw = GRAPH_CODE_CASES[code][1]()
+    assert code not in _codes(sym, **kw)
+
+
+# --------------------------------------------------------------------------
+# engine-schedule codes: trace builders over a RecordingEngine
+# --------------------------------------------------------------------------
+def _trace_gl101_broken(e):
+    v = e.new_variable()
+    e.push(lambda: None, const_vars=[v], mutable_vars=[v])
+
+
+def _trace_gl102_broken(e):
+    v = e.new_variable()
+    e.push(lambda: None, const_vars=[v])
+    e.wait_for_var(v)
+
+
+def _trace_gl103_broken(e):
+    v = e.new_variable()
+    e.push(lambda: None, mutable_vars=[v, v])
+
+
+def _trace_gl104_broken(e):
+    v = e.new_variable()
+    e.push(lambda: None, const_vars=[v])   # read before any write
+    e.push(lambda: None, mutable_vars=[v])
+
+
+def _trace_clean(e):
+    v, w = e.new_variable(), e.new_variable()
+    e.push(lambda: None, mutable_vars=[v])
+    e.push(lambda: None, const_vars=[v], mutable_vars=[w])
+    e.push(lambda: None, const_vars=[v, w])
+    e.wait_for_var(w)
+
+
+ENGINE_CODE_CASES = {
+    "GL101": _trace_gl101_broken,
+    "GL102": _trace_gl102_broken,
+    "GL103": _trace_gl103_broken,
+    "GL104": _trace_gl104_broken,
+}
+
+
+@pytest.mark.parametrize("code", sorted(ENGINE_CODE_CASES))
+def test_engine_code_triggers_on_broken_schedule(code):
+    e = RecordingEngine(eng.NaiveEngine())
+    ENGINE_CODE_CASES[code](e)
+    assert code in analyze_trace(e.trace).codes()
+
+
+@pytest.mark.parametrize("code", sorted(ENGINE_CODE_CASES) + ["GL105"])
+def test_engine_code_silent_on_clean_schedule(code):
+    e = RecordingEngine(eng._PythonThreadedEngine(2), assert_discipline=True)
+    _trace_clean(e)
+    e.wait_for_all()
+    assert code not in analyze_trace(e.trace).codes()
+
+
+class _NoDisciplineEngine(eng.Engine):
+    """Deliberately broken: runs every op on its own thread, ignoring the
+    declared var sets entirely — what the shim exists to catch."""
+
+    def __init__(self):
+        self._n = 0
+        self._threads = []
+
+    def new_variable(self):
+        self._n += 1
+        return self._n
+
+    def push(self, fn, const_vars=(), mutable_vars=()):
+        def quiet():
+            try:
+                fn()
+            except Exception:
+                pass  # the shim raises; the trace records it
+
+        t = threading.Thread(target=quiet)
+        t.start()
+        self._threads.append(t)
+
+    def wait_for_var(self, var):
+        self.wait_for_all()
+
+    def wait_for_all(self):
+        for t in self._threads:
+            t.join()
+
+
+def test_gl105_runtime_shim_catches_broken_engine():
+    e = RecordingEngine(_NoDisciplineEngine(), assert_discipline=True)
+    v = e.new_variable()
+    gate = threading.Event()
+    started = threading.Event()
+
+    def first():
+        started.set()
+        gate.wait(5)
+
+    e.push(first, mutable_vars=[v])
+    assert started.wait(5)
+    e.push(lambda: None, mutable_vars=[v])  # overlapping writer
+    time.sleep(0.05)
+    gate.set()
+    e.wait_for_all()
+    report = analyze_trace(e.trace)
+    assert "GL105" in report.codes()
+    assert any("write-write" in d.message for d in report.by_code("GL105"))
+
+
+def test_shipped_python_engine_passes_discipline_shim():
+    """The pure-Python fallback engine, under a real concurrent workload,
+    never violates the var discipline the shim asserts."""
+    e = RecordingEngine(eng._PythonThreadedEngine(4), assert_discipline=True)
+    vars_ = [e.new_variable() for _ in range(4)]
+    for i in range(80):
+        e.push(lambda: time.sleep(0.0005), mutable_vars=[vars_[i % 4]])
+        e.push(lambda: None, const_vars=[vars_[i % 4]],
+               mutable_vars=[vars_[(i + 1) % 4]])
+    e.wait_for_all()
+    assert not e.trace.violations
+    assert "GL105" not in analyze_trace(e.trace).codes()
+
+
+def test_every_diagnostic_code_is_tested():
+    covered = set(GRAPH_CODE_CASES) | set(ENGINE_CODE_CASES) | {"GL105"}
+    assert covered == set(CODES), (
+        "codes missing a trigger/clean test pair: %s; stale test entries: %s"
+        % (sorted(set(CODES) - covered), sorted(covered - set(CODES))))
+
+
+# --------------------------------------------------------------------------
+# satellite: engine wait_for_var on an unknown var raises (all engine types)
+# --------------------------------------------------------------------------
+@pytest.mark.parametrize("maker", [
+    eng.NaiveEngine,
+    lambda: eng.ThreadedEngine(num_workers=2),
+    lambda: eng._PythonThreadedEngine(2),
+], ids=["naive", "threaded", "python"])
+def test_wait_for_unknown_var_raises(maker):
+    e = maker()
+    with pytest.raises(mx.MXNetError, match="unknown engine variable"):
+        e.wait_for_var(987654321)
+    # known vars still work
+    v = e.new_variable()
+    done = []
+    e.push(lambda: done.append(1), mutable_vars=[v])
+    e.wait_for_var(v)
+    assert done == [1]
+
+
+# --------------------------------------------------------------------------
+# satellite: infer_meta registry is the shared source of truth
+# --------------------------------------------------------------------------
+def test_infer_meta_registry():
+    from mxnet_tpu.ops import infer_meta, shape_rules
+
+    conv = infer_meta.get_meta("Convolution")
+    assert conv.input_ranks["data"] == (4, 4)
+    assert "weight" in conv.param_slots
+    # backward rules are re-exported, not duplicated
+    assert infer_meta.backward_shape_rule("FullyConnected") \
+        is shape_rules.RULES["FullyConnected"]
+    # unregistered ops get the permissive default
+    default = infer_meta.get_meta("no_such_op")
+    assert default.input_ranks == {} and default.param_slots == ()
+
+
+# --------------------------------------------------------------------------
+# bind integration: MXNET_GRAPHLINT=0|warn|error
+# --------------------------------------------------------------------------
+def test_bind_lint_error_mode_raises(monkeypatch):
+    monkeypatch.setenv("MXNET_GRAPHLINT", "error")
+    sym, kw = _gl006_broken()
+    with pytest.raises(mx.MXNetError, match="GL006"):
+        sym.simple_bind(ctx=mx.cpu(), **{k: v for k, v in kw["shapes"].items()})
+
+
+def test_bind_lint_error_mode_passes_clean_graph(monkeypatch):
+    monkeypatch.setenv("MXNET_GRAPHLINT", "error")
+    net = mx.models.get_symbol("mlp", num_classes=10)
+    exe = net.simple_bind(ctx=mx.cpu(), data=(4, 784), softmax_label=(4,))
+    assert exe.forward(is_train=False)[0].shape == (4, 10)
+
+
+def test_bind_lint_warn_mode_logs_but_binds(monkeypatch, caplog):
+    monkeypatch.setenv("MXNET_GRAPHLINT", "warn")
+    x = mx.sym.Variable("x", dtype="float16")
+    y = mx.sym.Variable("y", dtype="float32")
+    s = x + y
+    with caplog.at_level("WARNING", logger="mxnet_tpu.graphlint"):
+        exe = s.simple_bind(ctx=mx.cpu(), x=(2,), y=(2,),
+                            type_dict={"x": "float16", "y": "float32"})
+    assert exe is not None
+    assert any("GL004" in r.message for r in caplog.records)
+
+
+def test_bind_lint_off_by_default(monkeypatch):
+    monkeypatch.delenv("MXNET_GRAPHLINT", raising=False)
+    assert analysis.graphlint_mode() is None
+
+
+def test_graphlint_mode_aliases_and_unknown(monkeypatch, caplog):
+    monkeypatch.setenv("MXNET_GRAPHLINT", "1")
+    assert analysis.graphlint_mode() == "warn"  # boolean idiom honored
+    monkeypatch.setenv("MXNET_GRAPHLINT", "bogus")
+    with caplog.at_level("WARNING", logger="mxnet_tpu.graphlint"):
+        assert analysis.graphlint_mode() is None
+    assert any("not a recognized mode" in r.message for r in caplog.records)
+
+
+# --------------------------------------------------------------------------
+# regression: models/resnet.py lints clean under MXNET_GRAPHLINT=error
+# --------------------------------------------------------------------------
+def test_resnet_lints_clean_under_error_mode(monkeypatch):
+    monkeypatch.setenv("MXNET_GRAPHLINT", "error")
+    net = mx.models.get_symbol("resnet-18", num_classes=10,
+                               image_shape="3,32,32")
+    exe = net.simple_bind(ctx=mx.cpu(), data=(2, 3, 32, 32),
+                          softmax_label=(2,))
+    assert exe is not None
+    report = analysis.lint(net, shapes={"data": (2, 3, 32, 32)},
+                           target="resnet-18")
+    assert report.errors == [] and report.warnings == []
+
+
+# --------------------------------------------------------------------------
+# CLI
+# --------------------------------------------------------------------------
+def test_cli_single_model_clean():
+    from mxnet_tpu.analysis.cli import main
+
+    assert main(["mlp"]) == 0
+
+
+def test_cli_list_codes(capsys):
+    from mxnet_tpu.analysis.cli import main
+
+    assert main(["--list-codes"]) == 0
+    out = capsys.readouterr().out
+    for code in CODES:
+        assert code in out
+
+
+def test_cli_json_format_and_broken_symbol_file(tmp_path, capsys):
+    from mxnet_tpu.analysis.cli import main
+
+    sym, kw = _gl006_broken()
+    path = str(tmp_path / "broken-symbol.json")
+    sym.save(path)
+    rc = main([path, "--shape", "data=2,3,8,8", "--format", "json"])
+    payload = json.loads(capsys.readouterr().out)
+    assert rc == 1
+    assert any(d["code"] == "GL006"
+               for entry in payload for d in entry["diagnostics"])
+
+
+def test_cli_unknown_target_is_usage_error(capsys):
+    from mxnet_tpu.analysis.cli import main
+
+    assert main(["no-such-model"]) == 2
+
+
+def test_cli_default_shapes_are_case_insensitive(capsys):
+    """'MLP' must get the same default shape hints as 'mlp' (get_symbol
+    lowercases the zoo key, so the shape table must too)."""
+    from mxnet_tpu.analysis.cli import main
+
+    assert main(["MLP"]) == 0
+    out = capsys.readouterr().out
+    # with default shapes applied the graph is fully determined: no GL203,
+    # zero findings — a structural-only lint would report 1 finding
+    assert "0 total finding(s)" in out
+
+
+def test_unknown_pass_subset_raises():
+    """A typo'd --passes selection must not lint nothing and exit 'clean'."""
+    sym, _ = _gl001_clean()
+    with pytest.raises(ValueError, match="unknown analysis pass"):
+        analysis.lint(sym, passes=["shapelint"])  # typo of shape_lint
+
+
+def test_cli_strict_fails_on_warnings():
+    from mxnet_tpu.analysis.cli import main
+
+    sym, _ = _gl202_broken()
+    import tempfile, os as _os
+
+    with tempfile.TemporaryDirectory() as td:
+        path = _os.path.join(td, "warn-symbol.json")
+        sym.save(path)
+        assert main([path]) == 0            # warnings alone pass
+        assert main([path, "--strict"]) == 1  # ... unless strict
+
+
+@pytest.mark.slow
+def test_cli_all_models_sweep_exits_zero():
+    """Acceptance: tools/graphlint runs on every bundled model and exits 0."""
+    from mxnet_tpu.analysis.cli import main
+
+    assert main(["--all-models"]) == 0
+
+
+# --------------------------------------------------------------------------
+# CI dogfood: the subsystem lints itself on every PR (tools/ci_check.sh runs
+# the same steps standalone)
+# --------------------------------------------------------------------------
+def test_package_sources_compile():
+    """Every mxnet_tpu source parses/compiles — the dependency-free floor of
+    the ruff/pyflakes step (those run in ci_check.sh when installed)."""
+    import os
+
+    root = os.path.dirname(os.path.dirname(os.path.abspath(mx.__file__)))
+    pkg = os.path.join(root, "mxnet_tpu")
+    bad = []
+    for dirpath, _, files in os.walk(pkg):
+        for f in files:
+            if not f.endswith(".py"):
+                continue
+            path = os.path.join(dirpath, f)
+            with open(path) as fh:
+                try:
+                    compile(fh.read(), path, "exec")
+                except SyntaxError as exc:
+                    bad.append("%s: %s" % (path, exc))
+    assert not bad, "\n".join(bad)
+
+
+def test_pyflakes_clean_when_available():
+    pytest.importorskip("pyflakes")
+    import os
+    import subprocess
+    import sys
+
+    root = os.path.dirname(os.path.dirname(os.path.abspath(mx.__file__)))
+    proc = subprocess.run(
+        [sys.executable, "-m", "pyflakes", os.path.join(root, "mxnet_tpu")],
+        capture_output=True, text=True)
+    assert proc.returncode == 0, proc.stdout + proc.stderr
