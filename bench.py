@@ -146,6 +146,43 @@ def _place(trainer, name, arr):
         trainer.rules.batch_spec(arr.shape)))
 
 
+def _fused_report(batch, image, dtype):
+    """Engage status of the fused conv+BN stack at the bench's shapes,
+    forward AND backward, plus the analytic per-step HBM byte model
+    (docs/PERF.md §6/§6b). Pure gate/policy queries — no device work — so
+    the report always reflects exactly what the timed step could engage
+    under the ambient MXNET_FUSED_CONV_BN[_BWD] env and committed WINS
+    table."""
+    import jax.numpy as jnp
+
+    from mxnet_tpu import fusion
+    from mxnet_tpu.ops.conv_bn_bytes import resnet50_sites, step_byte_model
+
+    dt = jnp.dtype(dtype)
+    rep = {"sites": 0, "fwd_engaged": 0, "bwd_engaged": 0, "bwd_modes": {}}
+    for kernel, stride, K, N, H, count, res_count in resnet50_sites(
+            image=image):
+        x_shape = (batch, K, H, H)
+        w_shape = (N, K) + kernel
+        for res_flag, cnt in ((False, count - res_count),
+                              (True, res_count)):
+            if not cnt:
+                continue
+            rep["sites"] += cnt
+            if not fusion.gate(kernel, stride, x_shape, w_shape, dt, True,
+                               res=res_flag):
+                continue
+            rep["fwd_engaged"] += cnt
+            mode = fusion.bwd_mode(kernel, stride, x_shape, w_shape, dt,
+                                   True, res=res_flag)
+            if mode != "xla":
+                rep["bwd_engaged"] += cnt
+            rep["bwd_modes"][mode] = rep["bwd_modes"].get(mode, 0) + cnt
+    rep["byte_model_gb"] = step_byte_model(batch, image=image,
+                                           itemsize=dt.itemsize)
+    return rep
+
+
 def _bench_resnet50(on_tpu, models, parallel, dev):
     image = 224 if on_tpu else 64
     candidates = [512, 256, 128, 64, 32] if on_tpu else [8]
@@ -190,6 +227,12 @@ def _bench_resnet50(on_tpu, models, parallel, dev):
     res = {"img_s": img_s, "batch": batch, "image": image,
            "step_ms": 1000 * batch / img_s,
            "flops_per_img": _TRAIN_FLOPS_PER_IMG * (image / 224.0) ** 2}
+    try:
+        res["fused_conv_bn"] = _fused_report(
+            batch, image, "bfloat16" if on_tpu else "float32")
+    except Exception as exc:  # the report must never sink the number
+        res["fused_conv_bn"] = {"error": "%s: %s"
+                                % (type(exc).__name__, exc)}
 
     # A/B the fused conv+BN Pallas path (docs/PERF.md §6) on the chip. The
     # WINS table may predate this device (or be empty); forcing the path
@@ -383,6 +426,11 @@ def main():
         "platform": dev.platform,
         "step_ms": round(rn["step_ms"], 2),
     }
+    fc = rn.get("fused_conv_bn") or {}
+    result["fused_conv_bn"] = fc
+    # the headline flag the scoreboard reads: did the BACKWARD fused path
+    # have an engage route this run (docs/PERF.md §6b)
+    result["fused_bwd_engaged"] = bool(fc.get("bwd_engaged"))
     if degraded:
         result["degraded"] = True  # TPU probe failed; this is a CPU number
         try:

@@ -29,6 +29,10 @@ def _explain_no_fold(ctx: GraphContext, node, directives):
     consumer walk in fusion.plan, returning the failed predicate."""
     from .. import fusion
 
+    output_ids = {id(n) for n, _ in ctx.symbol._outputs}
+    if id(node) in output_ids:
+        return ("its output is a program output and must materialize; the "
+                "fold would save nothing")
     cons = ctx.consumers.get(id(node), [])
     if not cons:
         return "its output is a graph head; there is no consumer to fold into"
@@ -45,6 +49,9 @@ def _explain_no_fold(ctx: GraphContext, node, directives):
             return "the relu's secondary outputs are consumed"
         targets = [c for c, _ in relu_cons]
         src, src_desc = relu, "the relu(BN) output"
+        if id(relu) in output_ids:
+            return ("the relu output is a program output and must "
+                    "materialize; the fold would save nothing")
         if not targets:
             return "the relu output is a graph head; nothing to fold into"
     for c in targets:
@@ -64,7 +71,10 @@ def fusion_explain(ctx: GraphContext):
     from .. import fusion
 
     diags = []
-    directives = fusion.plan(ctx.topo)
+    # same output_ids the executor passes: the explained plan must be the
+    # plan that actually runs (graph-output nodes are never folded/deferred)
+    directives = fusion.plan(
+        ctx.topo, output_ids={id(n) for n, _ in ctx.symbol._outputs})
     for node in ctx.topo:
         if node.is_variable:
             continue

@@ -32,6 +32,19 @@ ENV_HEARTBEAT_INTERVAL = "MXNET_TPU_HEARTBEAT_INTERVAL"
 
 _initialized = False
 _heartbeat_thread = None
+_start_time = None  # job-start anchor for num_dead_nodes' startup grace
+
+
+def _job_start_time():
+    """When this job started, as far as this process can tell: pinned at
+    ``init()`` (workers) or lazily at the first liveness query (monitors).
+    Anchors the startup grace below."""
+    global _start_time
+    if _start_time is None:
+        import time
+
+        _start_time = time.time()
+    return _start_time
 
 
 def is_initialized() -> bool:
@@ -73,6 +86,7 @@ def init(coordinator_address=None, num_processes=None, process_id=None):
             "NDArrays or binding modules. Original error: %s" % e
         ) from e
     _initialized = True
+    _job_start_time()
     _start_heartbeat(process_id)
     logging.info("mxnet_tpu.dist: worker %d/%d connected to %s",
                  process_id, num_processes, coordinator_address)
@@ -110,18 +124,38 @@ def _start_heartbeat(process_id):
     _heartbeat_thread.start()
 
 
-def num_dead_nodes(timeout=60.0):
-    """Count workers whose heartbeat is missing or older than ``timeout``
-    seconds (reference: KVStore::get_num_dead_node,
+def num_dead_nodes(timeout=60.0, startup_grace=None):
+    """Count workers whose heartbeat file is older than ``timeout`` seconds
+    (reference: KVStore::get_num_dead_node,
     include/mxnet/kvstore.h:234-244). Returns 0 when heartbeating is not
-    configured (single-process, or launcher without a heartbeat dir)."""
+    configured (single-process, or launcher without a heartbeat dir).
+
+    A MISSING heartbeat file is treated as alive until ``startup_grace``
+    seconds (default: ``timeout``) after the job start — workers come up
+    staggered (backend init, first compile) and a peer that simply has not
+    beaten YET is not dead. This matches the launcher's ``_stale_worker``
+    semantics, where a not-yet-written file is startup, covered by process
+    polling; after the grace a still-missing file counts as dead (it never
+    came up). Job start is the EARLIEST evidence available: this process's
+    anchor (``init()`` in workers, first query in monitors) or the
+    heartbeat directory's mtime (set when the first worker file appeared) —
+    so a monitor process started long after launch does not grant a dead
+    worker a fresh grace window."""
     import time
 
     hb_dir = os.environ.get(ENV_HEARTBEAT_DIR)
     if not hb_dir or not os.path.isdir(hb_dir):
         return 0
+    if startup_grace is None:
+        startup_grace = timeout
     n = int(os.environ.get(ENV_NUM_WORKERS, "1"))
     now = time.time()
+    start = _job_start_time()
+    try:
+        start = min(start, os.path.getmtime(hb_dir))
+    except OSError:
+        pass
+    in_grace = now - start <= startup_grace
     dead = 0
     for r in range(n):
         path = os.path.join(hb_dir, "worker-%d" % r)
@@ -129,7 +163,8 @@ def num_dead_nodes(timeout=60.0):
             if now - os.path.getmtime(path) > timeout:
                 dead += 1
         except OSError:
-            dead += 1  # never heartbeated
+            if not in_grace:
+                dead += 1  # never heartbeated and the grace period is over
     return dead
 
 

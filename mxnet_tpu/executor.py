@@ -50,7 +50,12 @@ class _GraphProgram:
                 os.environ.get("MXNET_FUSED_CONV_BN", "auto") != "0":
             from . import fusion as _fusion
 
-            self._fusion_plan = _fusion.plan(self.topo)
+            # graph-output node ids keep the planner from deferring (or
+            # folding) a node whose value must materialize as a program
+            # output — a deferred conv's PendingConv marker would otherwise
+            # escape interpret() into the jit output pytree (Group symbols)
+            self._fusion_plan = _fusion.plan(
+                self.topo, output_ids={id(n) for n, _ in symbol._outputs})
         # PlaceDevice-pass analogue (reference: graph_executor.cc:242
         # AssignContext → nnvm PlaceDevice inserting _CrossDeviceCopy): map
         # each node carrying a __ctx_group__ attr to its concrete device;

@@ -38,9 +38,10 @@ import os
 import jax
 import jax.numpy as jnp
 
-from .ops.pallas_conv_bn import _xla_conv, conv_block, supported
+from .ops.pallas_conv_bn import (_xla_conv, conv_block, plan_blocks,
+                                 plan_bwd_blocks, strided_dims, supported)
 
-__all__ = ["plan", "execute", "resolve", "gate",
+__all__ = ["plan", "execute", "resolve", "gate", "bwd_mode",
            "conv_reject_reason", "bn_reject_reason"]
 
 
@@ -80,21 +81,24 @@ class WithStats:
 class PendingConv:
     """A conv deferred to its consuming residual add."""
 
-    __slots__ = ("x", "w", "scale", "shift", "relu", "kernel", "stride")
+    __slots__ = ("x", "w", "scale", "shift", "relu", "kernel", "stride",
+                 "bwd")
 
-    def __init__(self, x, w, scale, shift, relu, kernel, stride):
+    def __init__(self, x, w, scale, shift, relu, kernel, stride, bwd="xla"):
         self.x, self.w = x, w
         self.scale, self.shift, self.relu = scale, shift, relu
         self.kernel, self.stride = kernel, stride
+        self.bwd = bwd
 
     def run(self, res):
         kind, mesh, _ = _mesh_kind()
         if kind == _MESH_DP:
             return _conv_block_sharded(
                 mesh, self.x, self.w, self.scale, self.shift, res,
-                self.kernel, self.stride, self.relu)
+                self.kernel, self.stride, self.relu, self.bwd)
         return conv_block(self.x, self.w, self.scale, self.shift, res,
-                          self.kernel, self.stride, self.relu)
+                          self.kernel, self.stride, self.relu, True,
+                          self.bwd)
 
 
 def resolve(v):
@@ -103,6 +107,12 @@ def resolve(v):
         return v.c
     if isinstance(v, Deferred):
         return v.materialize()
+    if isinstance(v, PendingConv):
+        # defensive: plan() keeps graph-output convs out of the defer
+        # rewrite, so a marker should never escape to a consumer that is
+        # not the planned resadd — but if one does, its standalone value
+        # (no residual) is exactly the conv output
+        return v.run(None)[0]
     return v
 
 
@@ -200,8 +210,18 @@ def _bn_ok(node):
     return bn_reject_reason(node) is None
 
 
-def plan(topo):
-    """Build the fusion plan: id(node) -> directive dict. Structural only."""
+def plan(topo, output_ids=()):
+    """Build the fusion plan: id(node) -> directive dict. Structural only.
+
+    ``output_ids`` are the ids of nodes whose outputs are PROGRAM outputs
+    (executor passes them from the bound symbol). A graph-output node has an
+    implicit extra consumer the ``consumers`` map cannot see: its value must
+    materialize, so it is excluded from the prologue-fold rewrite (the fold
+    would save nothing) and from the residual-defer rewrite (a deferred
+    conv's ``PendingConv`` marker would otherwise escape ``interpret()`` as
+    a program output and fail at jit trace time under
+    ``MXNET_FUSED_CONV_BN=1``)."""
+    output_ids = frozenset(output_ids)
     consumers = {}
     for node in topo:
         for inp, oi in node.inputs:
@@ -246,6 +266,8 @@ def plan(topo):
                 if len(targets) != len(consumers.get(id(c0), [])):
                     continue
         src = relu_node if relu_node is not None else node
+        if id(node) in output_ids or id(src) in output_ids:
+            continue  # the BN (or its relu) value materializes regardless
         if targets and all(_is_fusable_conv_data_edge(c, src)
                            for c in targets):
             d["fold"] = True
@@ -261,6 +283,8 @@ def plan(topo):
         for slot, (inp, oi) in enumerate(node.inputs):
             if oi != 0 or id(inp) not in conv_nodes:
                 continue
+            if id(inp) in output_ids:
+                continue  # program output: the conv must materialize
             if len(consumers.get(id(inp), [])) != 1:
                 continue
             if best is None or order[id(inp)] > order[id(best[1])]:
@@ -305,11 +329,75 @@ def gate(kernel, stride, x_shape, w_shape, dtype, prologue, res=False):
         return False
     from .ops.fused_conv_bn_table import WINS
 
-    K = x_shape[1]
-    N = w_shape[0]
-    hw = (x_shape[2] // stride[0]) * (x_shape[3] // stride[1])
-    variant = "pr" if res else "p"
-    return WINS.get((kernel[0], K, N, hw, stride[0], variant), False)
+    return bool(WINS.get(_wins_key(kernel, stride, x_shape, w_shape, res),
+                         False))
+
+
+def _wins_key(kernel, stride, x_shape, w_shape, res):
+    """The per-shape WINS-table key. The spatial term uses the kernel's own
+    post-stride arithmetic (ceil for odd dims) so the key always matches
+    what tools/fused_stats_bench.py measured and emitted."""
+    Ho, Wo = strided_dims(x_shape[2], x_shape[3], stride)
+    return (kernel[0], x_shape[1], w_shape[0], Ho * Wo, stride[0],
+            "pr" if res else "p")
+
+
+_warned_bwd_env = False
+
+
+def bwd_mode(kernel, stride, x_shape, w_shape, dtype, prologue, res=False):
+    """The stash-vs-recompute policy for the fused backward, decided per
+    shape like ``choose_blocks`` (docs/PERF.md §6b):
+
+    - ``MXNET_FUSED_CONV_BN_BWD=0|xla`` pins the jax.vjp-of-XLA backward;
+      ``recompute``/``stash`` force a policy (measurement) where the shape
+      tiles;
+    - ``auto`` (default) consults the committed WINS table's backward
+      entries — key ``(..., variant + ":bwd")``, value the measured winning
+      policy string — device-matched like the forward gate.
+
+    Only meaningful when the forward engages (``gate`` returned True for
+    the same call); the returned mode rides into ``conv_block(bwd=...)``.
+    """
+    env = os.environ.get("MXNET_FUSED_CONV_BN_BWD", "auto")
+    if env in ("0", "xla"):
+        return "xla"
+    if env == "1":
+        env = "recompute"  # mirror MXNET_FUSED_CONV_BN=1 force semantics
+    elif env not in ("auto", "recompute", "stash"):
+        global _warned_bwd_env
+        if not _warned_bwd_env:
+            _warned_bwd_env = True
+            import logging
+
+            logging.getLogger("mxnet_tpu").warning(
+                "MXNET_FUSED_CONV_BN_BWD=%r not recognized "
+                "(0|xla|1|recompute|stash|auto); backward stays on the XLA "
+                "lowering", env)
+        return "xla"
+    itemsize = jnp.dtype(dtype).itemsize
+
+    def _tiles(policy):
+        if policy == "stash" and plan_blocks(
+                x_shape, w_shape, stride, itemsize=itemsize,
+                prologue=prologue, res=res, emit_xn=True) is None:
+            return False  # forward cannot afford the xn output stream
+        return plan_bwd_blocks(x_shape, w_shape, stride, itemsize=itemsize,
+                               prologue=prologue, res=res,
+                               stash=(policy == "stash")) is not None
+
+    if env in ("recompute", "stash"):
+        return env if _tiles(env) else "xla"
+    if not prologue or not _table_device_matches():
+        return "xla"
+    from .ops.fused_conv_bn_table import WINS
+
+    k, K, N, hw, s, variant = _wins_key(kernel, stride, x_shape, w_shape,
+                                        res)
+    policy = WINS.get((k, K, N, hw, s, variant + ":bwd"))
+    if policy in ("recompute", "stash") and _tiles(policy):
+        return policy
+    return "xla"
 
 
 # -------------------------------------------------------------------- execute
@@ -389,7 +477,8 @@ def _mesh_kind():
     return _MESH_OTHER, None, 0
 
 
-def _conv_block_sharded(mesh, x, w, scale, shift, res, kernel, stride, relu):
+def _conv_block_sharded(mesh, x, w, scale, shift, res, kernel, stride, relu,
+                        bwd="xla"):
     """Run the kernel per data-shard (pallas_call has no SPMD partitioning
     rule, so GSPMD would gather its operands); the per-shard statistics
     psum over 'data' so the downstream BN sees GLOBAL-batch moments —
@@ -414,16 +503,15 @@ def _conv_block_sharded(mesh, x, w, scale, shift, res, kernel, stride, relu):
         sc = next(it) if has_p else None
         sh = next(it) if has_p else None
         r_ = next(it) if has_r else None
-        c, s, q = conv_block(x_, w_, sc, sh, r_, kernel, stride, relu)
+        c, s, q = conv_block(x_, w_, sc, sh, r_, kernel, stride, relu,
+                             True, bwd)
         return (c, jax.lax.psum(s, "data"), jax.lax.psum(q, "data"))
 
-    # check_vma=False: pallas_call's out_shape structs carry no vma
-    # annotation, which the checker rejects; the specs here are simple
-    # enough to state outright
-    fn = jax.shard_map(
+    from .parallel.mesh import shard_map_compat
+
+    fn = shard_map_compat(
         local, mesh=mesh, in_specs=tuple(specs),
-        out_specs=(P("data", *([None] * (x.ndim - 1))), P(None), P(None)),
-        check_vma=False)
+        out_specs=(P("data", *([None] * (x.ndim - 1))), P(None), P(None)))
     return fn(*args)
 
 
@@ -440,17 +528,24 @@ def _exec_conv(directive, node, ins):
         if (x.shape[0] % dp == 0
                 and gate(kernel, stride, local_shape, w.shape, x.dtype,
                          scale is not None, res=directive["defer"])):
+            bwd = bwd_mode(kernel, stride, local_shape, w.shape, x.dtype,
+                           scale is not None, res=directive["defer"])
             if directive["defer"]:
-                return PendingConv(x, w, scale, shift, relu, kernel, stride)
+                return PendingConv(x, w, scale, shift, relu, kernel, stride,
+                                   bwd)
             c, s, q = _conv_block_sharded(mesh, x, w, scale, shift, None,
-                                          kernel, stride, relu)
+                                          kernel, stride, relu, bwd)
             return WithStats(c, s, q)
     elif kind == _MESH_NONE and gate(kernel, stride, x.shape, w.shape,
                                      x.dtype, scale is not None,
                                      res=directive["defer"]):
+        bwd = bwd_mode(kernel, stride, x.shape, w.shape, x.dtype,
+                       scale is not None, res=directive["defer"])
         if directive["defer"]:
-            return PendingConv(x, w, scale, shift, relu, kernel, stride)
-        c, s, q = conv_block(x, w, scale, shift, None, kernel, stride, relu)
+            return PendingConv(x, w, scale, shift, relu, kernel, stride,
+                               bwd)
+        c, s, q = conv_block(x, w, scale, shift, None, kernel, stride, relu,
+                             True, bwd)
         return WithStats(c, s, q)
     # kind == _MESH_OTHER (tensor/seq-sharded) always lands here: XLA path
     # fallback: materialize the normalized input (cached on the marker) and

@@ -64,17 +64,20 @@ class KVStore:
             return jax.process_count()
         return 1
 
-    def num_dead_nodes(self, timeout=60.0) -> int:
+    def num_dead_nodes(self, timeout=60.0, startup_grace=None) -> int:
         """Workers whose heartbeat went stale (reference:
         KVStore::get_num_dead_node, include/mxnet/kvstore.h:234-244, over
         ps-lite heartbeats scanned in kvstore_dist.h:158-167). Backed by the
         launcher's heartbeat-file protocol (dist.num_dead_nodes); 0 for
-        single-process stores or when heartbeating is not configured."""
+        single-process stores or when heartbeating is not configured. A
+        worker that has not heartbeated YET counts as alive until
+        ``startup_grace`` (default ``timeout``) seconds after job start."""
         if "dist" not in self._type:
             return 0
         from . import dist
 
-        return dist.num_dead_nodes(timeout=timeout)
+        return dist.num_dead_nodes(timeout=timeout,
+                                   startup_grace=startup_grace)
 
     # ------------------------------------------------------------------- api
     def init(self, key, value):

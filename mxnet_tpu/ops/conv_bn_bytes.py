@@ -1,0 +1,138 @@
+"""Analytic HBM byte accounting for the fused conv+BN kernel stack.
+
+docs/PERF.md pins the ResNet-50 step at the v5e HBM roofline: every path to
+>=0.35 MFU is a bytes-cut. This module is the shared byte model behind the
+§6/§6b accounting tables, ``bench.py``'s per-step byte report, and the
+autotune harness's site list — one place that counts activation crossings so
+the projected cut and the measured engage status talk about the same bytes.
+
+Crossing model (per conv+BN site, activation sizes X = B·K·H·W·itemsize
+input-side, C = B·N·H'·W'·itemsize output-side, Wt = weight bytes):
+
+forward, unfused (BN -> relu -> conv -> stats):
+    xn write (X) + xn read (X) + c write (C) + stats read (C)     = 2X + 2C
+forward, fused (prologue + stats epilogue):
+    x read (X) + c write (C)                                      =  X +  C
+residual adds +3C unfused (read-read-write pass) vs +C fused (the epilogue
+streams the other operand).
+
+backward, unfused (XLA; conv cannot consume or produce a fusion,
+arXiv:2301.13062 — the cotangent fold, dgrad, wgrad and prologue backward
+each cross HBM):
+    dc read + c read + dc_eff write (3C) + dc_eff read x2 (dgrad+wgrad, 2C)
+    + xn read (X, wgrad) + dxn write + dxn read (2X)
+    + x read (X, dscale) + dx write (X)                           = 5C + 5X
+backward, fused (one Pallas dgrad+wgrad kernel, docs/PERF.md §6b):
+    dc read + c read (2C) + x read (X) + dx write (X)             = 2C + 2X
+    (+C dres write when the residual cotangent must materialize;
+     the stash policy adds one X write forward + one X read backward)
+
+Known optimism: every term tied to a revisited block index is a LOWER
+bound by the stripe count (1 for most ResNet shapes, up to 4 for the
+widest). On the write side that is the stashed-xn block (once per n
+stripe) and the dres block (once per k stripe); on the read side the
+forward re-streams the x block once per n stripe and the backward
+re-streams the dc/c blocks once per k stripe — so the fused terms here
+(X read, 2C reads) are the bn=N / bk=K single-stripe ideal. The headline
+totals use the recompute policy (no stash term); read the cut percentages
+as that ideal, not a measurement — the WINS table exists precisely
+because the engage decision must come from timing, not this model.
+
+Weight traffic (Wt read forward, Wt write backward) is identical on both
+paths and small next to the activations; it is included in the totals for
+honesty but never in the per-site deltas.
+"""
+from __future__ import annotations
+
+__all__ = ["resnet50_sites", "site_bytes", "step_byte_model"]
+
+
+def resnet50_sites(image=224):
+    """Every conv+BN site of models/resnet.py resnet-50 as
+    ``(kernel, stride, K, N, H, count, res_count)`` — ``res_count`` of the
+    ``count`` instances are the bottleneck conv3s the fusion plan defers
+    into the block's residual add (the 'pr' contract). 53 convs total; the
+    7x7 stem and the three stride-2 3x3s are structurally out
+    (``supported()`` false). ``image`` scales the spatial dims from the
+    canonical 224 (bench.py runs 64 on CPU); the batch is the caller's
+    axis — sites are shape tuples, batch-independent."""
+    units = [3, 4, 6, 3]
+    filters = [64, 256, 512, 1024, 2048]
+    sites = {}
+
+    def add(kernel, stride, K, N, H, res=False):
+        H = max(1, H * image // 224)
+        key = (kernel, stride, K, N, H)
+        cnt, rcnt = sites.get(key, (0, 0))
+        sites[key] = (cnt + 1, rcnt + (1 if res else 0))
+
+    add((7, 7), (2, 2), 3, 64, 224)  # stem (reported, never supported)
+    H = 56
+    for stage, n_unit in enumerate(units):
+        stride = 1 if stage == 0 else 2
+        nf = filters[stage + 1]
+        K_in = filters[stage]
+        # unit 1 (dim_match=False)
+        add((1, 1), (1, 1), K_in, nf // 4, H)            # conv1
+        add((3, 3), (stride, stride), nf // 4, nf // 4, H)  # conv2 (strided)
+        Ho = H // stride
+        add((1, 1), (1, 1), nf // 4, nf, Ho, res=True)   # conv3 -> skip add
+        add((1, 1), (stride, stride), K_in, nf, H)       # shortcut
+        H = Ho
+        for _ in range(n_unit - 1):
+            add((1, 1), (1, 1), nf, nf // 4, H)
+            add((3, 3), (1, 1), nf // 4, nf // 4, H)
+            add((1, 1), (1, 1), nf // 4, nf, H, res=True)
+    total = sum(c for c, _ in sites.values())
+    assert total == 53, total
+    return [(k, s, K, N, H, c, r)
+            for (k, s, K, N, H), (c, r) in sorted(sites.items())]
+
+
+def site_bytes(kernel, stride, K, N, H, batch, itemsize=2, res=False,
+               stash=False):
+    """Per-site HBM bytes under the crossing model (module docstring):
+    dict with fwd/bwd x unfused/fused byte counts plus the weight bytes."""
+    Ho = (H + stride[0] - 1) // stride[0]
+    Wo = (H + stride[1] - 1) // stride[1]
+    X = batch * K * H * H * itemsize
+    C = batch * N * Ho * Wo * itemsize
+    Wt = N * K * kernel[0] * kernel[1] * itemsize
+    fwd_unfused = 2 * X + 2 * C + Wt + (3 * C if res else 0)
+    fwd_fused = X + C + Wt + (C if res else 0) + (X if stash else 0)
+    bwd_unfused = 5 * C + 5 * X + Wt
+    bwd_fused = 2 * C + 2 * X + Wt + (C if res else 0) + (X if stash else 0)
+    return {"X": X, "C": C, "Wt": Wt,
+            "fwd_unfused": fwd_unfused, "fwd_fused": fwd_fused,
+            "bwd_unfused": bwd_unfused, "bwd_fused": bwd_fused}
+
+
+def step_byte_model(batch, image=224, itemsize=2):
+    """Aggregate the crossing model over every *supported* ResNet-50 site:
+    projected activation bytes per training step for the three engage
+    levels the stack can be in. Unsupported sites (stem, strided 3x3s)
+    contribute their unfused bytes to every total — the model never counts
+    a cut the kernel cannot make."""
+    from .pallas_conv_bn import supported
+
+    tot = {"unfused": 0, "fused_fwd": 0, "fused_fwd_bwd": 0}
+    for kernel, stride, K, N, H, count, res_count in resnet50_sites(
+            image=image):
+        for is_res, cnt in ((False, count - res_count), (True, res_count)):
+            if not cnt:
+                continue
+            b = site_bytes(kernel, stride, K, N, H, batch,
+                           itemsize=itemsize, res=is_res)
+            ok = supported((batch, K, H, H), (N, K) + kernel, stride,
+                           itemsize=itemsize, prologue=True, res=is_res)
+            unf = b["fwd_unfused"] + b["bwd_unfused"]
+            tot["unfused"] += cnt * unf
+            tot["fused_fwd"] += cnt * (
+                (b["fwd_fused"] + b["bwd_unfused"]) if ok else unf)
+            tot["fused_fwd_bwd"] += cnt * (
+                (b["fwd_fused"] + b["bwd_fused"]) if ok else unf)
+    gb = {k: round(v / 1e9, 2) for k, v in tot.items()}
+    gb["cut_fwd_pct"] = round(100 * (1 - tot["fused_fwd"] / tot["unfused"]), 1)
+    gb["cut_fwd_bwd_pct"] = round(
+        100 * (1 - tot["fused_fwd_bwd"] / tot["unfused"]), 1)
+    return gb

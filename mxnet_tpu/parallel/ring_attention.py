@@ -111,6 +111,8 @@ def ring_attention(q, k, v, mesh, seq_axis="seq", causal=False, scale=None,
         return out.astype(qb.dtype)
 
     spec = P(batch_axis, seq_axis, None, None)
-    fn = jax.shard_map(local, mesh=mesh, in_specs=(spec, spec, spec),
-                       out_specs=spec)
+    from .mesh import shard_map_compat
+
+    fn = shard_map_compat(local, mesh=mesh, in_specs=(spec, spec, spec),
+                          out_specs=spec, check=True)
     return fn(q, k, v)

@@ -24,7 +24,13 @@
 // handlers registered BEFORE the library's latest guard re-arm are
 // skipped at exit (the guard _exit()s first). Hosts that need their own
 // atexit work should do it before exit() or register after their last
-// mxtpu call.
+// mxtpu call — or, when their atexit cleanup is essential (flushing a
+// database, releasing cluster locks), export MXTPU_EXIT_GUARD=0 to
+// disable the guard entirely and accept the documented ~15% exit-time
+// SIGSEGV risk instead (quiesce() at the Free entry points still runs
+// and closes most of the window). The variable is read at every re-arm
+// attempt, so setenv("MXTPU_EXIT_GUARD", "0", 1) before the first mxtpu
+// call is equivalent. See docs/ENV_VARS.md.
 #ifndef MXTPU_SRC_EMBED_RUNTIME_H_
 #define MXTPU_SRC_EMBED_RUNTIME_H_
 
@@ -79,7 +85,11 @@ inline int count_dsos() {
 }
 
 // Re-arm the exit guard if new shared objects appeared since last time.
+// MXTPU_EXIT_GUARD=0 opts out for hosts with essential atexit cleanup
+// (see the header comment for the tradeoff).
 inline void ensure_exit_guard() {
+  const char* guard_env = std::getenv("MXTPU_EXIT_GUARD");
+  if (guard_env && guard_env[0] == '0' && guard_env[1] == '\0') return;
   std::lock_guard<std::mutex> lk(guard_mu());
   static int last = -1;
   int n = count_dsos();

@@ -88,6 +88,123 @@ def test_plan_structure():
     assert by_name[add_name]["kind"] == "resadd"
 
 
+def test_group_output_conv_not_deferred(monkeypatch):
+    """Regression (fusion.py residual-defer leak): a fusable conv feeding a
+    residual add is a program output too (Group symbol). The planner used
+    to defer it — consumers never see graph outputs — so interpret()
+    returned the PendingConv marker as a jit output and trace failed under
+    MXNET_FUSED_CONV_BN=1. The conv must run standalone instead, and the
+    Group must produce the same numbers as the unfused lowering."""
+    sym = mx.sym
+
+    def _net():
+        data = sym.Variable("data")
+        bn = sym.BatchNorm(data=data, fix_gamma=False, name="bn")
+        act = sym.Activation(data=bn, act_type="relu", name="relu")
+        conv = sym.Convolution(data=act, num_filter=8, kernel=(1, 1),
+                               stride=(1, 1), pad=(0, 0), no_bias=True,
+                               name="conv")
+        sc = sym.Convolution(data=act, num_filter=8, kernel=(1, 1),
+                             stride=(1, 1), pad=(0, 0), no_bias=True,
+                             name="sc")
+        add = conv + sc
+        return sym.Group([add, conv]), conv, add
+
+    net, conv, add = _net()
+    topo = net._topo()
+    out_ids = {id(n) for n, _ in net._outputs}
+    plan = fusion.plan(topo, output_ids=out_ids)
+    by_name = {n.name: plan.get(id(n)) for n in topo if not n.is_variable}
+    # the graph-output conv must NOT be deferred; the other operand (sc,
+    # not an output) is still eligible
+    assert by_name["conv"]["defer"] is False
+    assert by_name["sc"]["defer"] is True
+
+    outs = {}
+    for env in ("0", "1"):
+        monkeypatch.setenv("MXNET_FUSED_CONV_BN", env)
+        net = _net()[0]
+        ex = net.simple_bind(mx.cpu(), data=(2, 8, 8, 8), grad_req="null")
+        rs = np.random.RandomState(5)
+        for arr in ex.arg_arrays:
+            arr[:] = rs.uniform(-0.5, 0.5, arr.shape).astype("f")
+        outs[env] = [o.asnumpy() for o in ex.forward(is_train=True)]
+    for a, b in zip(outs["1"], outs["0"]):
+        np.testing.assert_allclose(a, b, rtol=1e-4, atol=1e-5)
+
+
+def test_group_output_bn_not_folded():
+    """A BN whose output is also a program output materializes regardless —
+    the planner must not fold it (the fold would save nothing and
+    double-compute the prologue in every consumer)."""
+    sym = mx.sym
+    data = sym.Variable("data")
+    bn = sym.BatchNorm(data=data, fix_gamma=False, name="bn")
+    conv = sym.Convolution(data=bn, num_filter=8, kernel=(1, 1),
+                           stride=(1, 1), pad=(0, 0), no_bias=True,
+                           name="conv")
+    net = sym.Group([conv, bn])
+    topo = net._topo()
+    plan = fusion.plan(topo, output_ids={id(n) for n, _ in net._outputs})
+    by_name = {n.name: plan.get(id(n)) for n in topo if not n.is_variable}
+    assert by_name["bn"]["fold"] is False
+    # without the output edge the same BN folds
+    plan2 = fusion.plan(topo, output_ids={id(conv._outputs[0][0])})
+    assert plan2[id([n for n in topo if n.name == "bn"][0])]["fold"] is True
+
+
+def test_fused_backward_policies_match_unfused(monkeypatch):
+    """End-to-end Pallas backward through the executor: forcing the fused
+    dgrad/wgrad kernels (both policies) must reproduce the unfused
+    gradients and aux updates — the §6b graph-integration contract."""
+    out0, g0, aux0 = _run("0", monkeypatch)
+    for policy in ("recompute", "stash"):
+        monkeypatch.setenv("MXNET_FUSED_CONV_BN_BWD", policy)
+        out1, g1, aux1 = _run("1", monkeypatch)
+        monkeypatch.delenv("MXNET_FUSED_CONV_BN_BWD")
+        np.testing.assert_allclose(out1, out0, rtol=1e-4, atol=1e-5,
+                                   err_msg=policy)
+        assert set(g1) == set(g0)
+        for name in g0:
+            np.testing.assert_allclose(g1[name], g0[name], rtol=2e-3,
+                                       atol=2e-4,
+                                       err_msg="%s/%s" % (policy, name))
+        for name in aux0:
+            np.testing.assert_allclose(aux1[name], aux0[name], rtol=1e-4,
+                                       atol=1e-5, err_msg=name)
+
+
+def test_bwd_mode_env_and_table(monkeypatch):
+    """bwd_mode: env forcing, the auto path against a (monkeypatched)
+    device-matched WINS table with :bwd policy entries, and the ceil-div
+    WINS key for odd strided dims."""
+    import jax
+
+    from mxnet_tpu.ops import fused_conv_bn_table as tbl
+
+    shape, wshape = (4, 8, 9, 9), (16, 8, 1, 1)
+    kern, stride = (1, 1), (2, 2)
+    # env forcing wins over everything
+    monkeypatch.setenv("MXNET_FUSED_CONV_BN_BWD", "recompute")
+    assert fusion.bwd_mode(kern, stride, shape, wshape, "float32",
+                           True) == "recompute"
+    monkeypatch.setenv("MXNET_FUSED_CONV_BN_BWD", "0")
+    assert fusion.bwd_mode(kern, stride, shape, wshape, "float32",
+                           True) == "xla"
+    # auto consults the table; the key's spatial term is ceil(9/2)**2 = 25
+    monkeypatch.setenv("MXNET_FUSED_CONV_BN_BWD", "auto")
+    monkeypatch.setattr(tbl, "DEVICE", jax.devices()[0].device_kind)
+    monkeypatch.setattr(tbl, "WINS", {(1, 8, 16, 25, 2, "p:bwd"): "stash"})
+    assert fusion.bwd_mode(kern, stride, shape, wshape, "float32",
+                           True) == "stash"
+    # the matching forward gate key engages too (same ceil-div arithmetic)
+    monkeypatch.setattr(tbl, "WINS", {(1, 8, 16, 25, 2, "p"): True})
+    assert fusion.gate(kern, stride, shape, wshape, "float32", True)
+    # unmeasured shape -> xla
+    assert fusion.bwd_mode(kern, stride, shape, wshape, "float32",
+                           False) == "xla"
+
+
 def test_fused_matches_unfused(monkeypatch):
     out0, g0, aux0 = _run("0", monkeypatch)
     out1, g1, aux1 = _run("1", monkeypatch)

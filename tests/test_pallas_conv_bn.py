@@ -143,6 +143,56 @@ def test_bf16_stats_precision():
                                rtol=3e-2, atol=1e-2)
 
 
+def test_odd_strided_dims_forward_parity():
+    """Odd spatial dims at stride 2: the forward slices x[:, :, ::2, ::2]
+    (ceil) — parity must hold and supported() must agree."""
+    B, K, H, W, N = 4, 16, 9, 9, 32
+    x = _mk((B, K, H, W), 50)
+    w = _mk((N, K, 1, 1), 51) * 0.1
+    scale, shift = _mk((K,), 52), _mk((K,), 53)
+    assert pcb.supported(x.shape, w.shape, (2, 2))
+    c0, s0, q0 = _ref(x, w, scale, shift, None, (1, 1), (2, 2), True)
+    c1, s1, q1 = pcb.conv_block(x, w, scale, shift, None, (1, 1), (2, 2),
+                                True)
+    assert c1.shape == (B, N, 5, 5)  # ceil(9/2)
+    np.testing.assert_allclose(np.asarray(c1), np.asarray(c0),
+                               rtol=1e-4, atol=1e-4)
+    np.testing.assert_allclose(np.asarray(s1), np.asarray(s0),
+                               rtol=1e-4, atol=1e-3)
+
+
+def test_plan_blocks_ceil_div_strided(monkeypatch):
+    """Regression: plan_blocks floored H//stride while the forward slices
+    ceil — near the VMEM budget an odd-dim strided conv passed the gate but
+    tripped the kernel's internal assert. The planner must now size the
+    working set with the SAME ceil dims the forward uses, so the tight
+    shape takes the XLA fallback instead."""
+    B, K, N = 4, 16, 32
+    # budget between est(HW=ceil(7/2)^2=16) and est(HW=floor=9): the floor
+    # arithmetic would claim a tile fits that the forward cannot allocate
+    est = lambda hw: (2 * K * hw * 4 + 2 * 8 * hw * 4 + 8 * hw * 4
+                      + 8 * K * 4 + K * hw * 4)
+    assert est(9) < est(16)
+    monkeypatch.setattr(pcb, "_VMEM_BUDGET", (est(9) + est(16)) // 2)
+    assert pcb.plan_blocks((B, K, 7, 7), (N, K, 1, 1), (2, 2),
+                           itemsize=4) is None
+    assert not pcb.supported((B, K, 7, 7), (N, K, 1, 1), (2, 2), itemsize=4)
+    # and the fallback actually runs (no in-jit assert)
+    x = _mk((B, K, 7, 7), 60)
+    w = _mk((N, K, 1, 1), 61) * 0.1
+    scale, shift = _mk((K,), 62), _mk((K,), 63)
+    c, s, q = pcb.conv_block(x, w, scale, shift, None, (1, 1), (2, 2), True)
+    c0, s0, q0 = _ref(x, w, scale, shift, None, (1, 1), (2, 2), True)
+    np.testing.assert_allclose(np.asarray(c), np.asarray(c0), rtol=1e-5,
+                               atol=1e-5)
+
+
+def test_strided_dims_helper():
+    assert pcb.strided_dims(7, 7, (2, 2)) == (4, 4)
+    assert pcb.strided_dims(8, 8, (2, 2)) == (4, 4)
+    assert pcb.strided_dims(9, 7, (1, 1)) == (9, 7)
+
+
 def test_tight_vmem_falls_back_not_asserts():
     """A shape whose f32+prologue working set exceeds the VMEM budget (but
     would fit at bf16 without prologue) must take the XLA fallback, never an

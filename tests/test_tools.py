@@ -159,8 +159,10 @@ def test_launcher_detects_hung_worker(tmp_path):
 
 
 def test_num_dead_nodes_counts_stale_heartbeats(tmp_path, monkeypatch):
-    """kv.num_dead_nodes analog (reference kvstore.h:234-244): stale or
-    missing heartbeat files count as dead."""
+    """kv.num_dead_nodes analog (reference kvstore.h:234-244): stale files
+    count as dead; a MISSING file counts as alive during the startup grace
+    (workers come up staggered — matching the launcher's _stale_worker
+    treatment of not-yet-written files) and as dead after it."""
     import time
 
     from mxnet_tpu import dist
@@ -174,8 +176,21 @@ def test_num_dead_nodes_counts_stale_heartbeats(tmp_path, monkeypatch):
     (hb / "worker-1").touch()
     os.utime(hb / "worker-1", (now - 400, now - 400))  # stale
     # worker-2 never heartbeated
+
+    # job just started (anchor pinned now): the missing worker is in its
+    # startup grace — only the stale one is dead
+    monkeypatch.setattr(dist, "_start_time", now)
+    assert dist.num_dead_nodes(timeout=60) == 1
+    assert dist.num_dead_nodes(timeout=1000) == 0
+
+    # grace expired: a still-missing heartbeat means the worker never came
+    # up — dead (the pre-fix behavior, now only after the grace)
+    monkeypatch.setattr(dist, "_start_time", now - 400)
     assert dist.num_dead_nodes(timeout=60) == 2
-    assert dist.num_dead_nodes(timeout=1000) == 1  # only the missing one
+    # only the missing one (grace defaults to timeout, so pin it short)
+    assert dist.num_dead_nodes(timeout=1000, startup_grace=60) == 1
+    # a custom grace longer than the elapsed time keeps it alive
+    assert dist.num_dead_nodes(timeout=60, startup_grace=1000) == 1
 
 
 @pytest.mark.slow

@@ -1,5 +1,6 @@
 #!/usr/bin/env bash
-# CI entrypoint: static analysis first, then the tier-1 test suite.
+# CI entrypoint: static analysis first, then the fused conv+BN machinery
+# smoke, then the tier-1 test suite.
 #
 # Step 1 dogfoods the graphlint subsystem on every bundled model (the
 # acceptance gate: every model must lint with zero error-severity
@@ -7,15 +8,18 @@
 # one is installed (the container image may ship neither; the dependency-free
 # floor — every source compiles — is enforced by
 # tests/test_graphlint.py::test_package_sources_compile either way).
-# Step 3 is the repo's tier-1 pytest command (ROADMAP.md).
+# Step 3 exercises the fused conv+BN autotune harness end-to-end in Pallas
+# interpret mode (timing scaffolding, fwd+bwd parity, WINS-table emission +
+# loadability — docs/PERF.md §6b) plus the backward gradient-parity sweep's
+# non-slow subset. Step 4 is the repo's tier-1 pytest command (ROADMAP.md).
 set -uo pipefail
 cd "$(dirname "$0")/.."
 
-echo "== [1/3] graphlint: all bundled models =="
+echo "== [1/4] graphlint: all bundled models =="
 JAX_PLATFORMS=cpu python tools/graphlint --all-models --min-severity warning \
     || { echo "graphlint FAILED"; exit 1; }
 
-echo "== [2/3] source lint (ruff/pyflakes if available) =="
+echo "== [2/4] source lint (ruff/pyflakes if available) =="
 if command -v ruff >/dev/null 2>&1; then
     ruff check mxnet_tpu/ || { echo "ruff FAILED"; exit 1; }
 elif python -c 'import pyflakes' >/dev/null 2>&1; then
@@ -24,7 +28,28 @@ else
     echo "(neither ruff nor pyflakes installed; compile-check runs in pytest)"
 fi
 
-echo "== [3/3] tier-1 tests =="
+echo "== [3/4] fused conv+BN: interpret-mode autotune smoke + bwd parity subset =="
+FUSED_TABLE="$(mktemp /tmp/fused_conv_bn_table_ci.XXXXXX.py)"
+JAX_PLATFORMS=cpu python tools/fused_stats_bench.py --interpret --emit-table \
+    --table-out "$FUSED_TABLE" \
+    || { echo "fused_stats_bench smoke FAILED"; rm -f "$FUSED_TABLE"; exit 1; }
+python - "$FUSED_TABLE" <<'PYEOF' || { echo "emitted WINS table invalid"; rm -f "$FUSED_TABLE"; exit 1; }
+import importlib.util, sys
+spec = importlib.util.spec_from_file_location("t", sys.argv[1])
+m = importlib.util.module_from_spec(spec); spec.loader.exec_module(m)
+assert m.DEVICE, "DEVICE not stamped"
+assert m.WINS, "WINS table empty on the interpret backend"
+assert any(k[-1].endswith(":bwd") for k in m.WINS), "no backward entries"
+print("emitted table OK: DEVICE=%r, %d entries" % (m.DEVICE, len(m.WINS)))
+PYEOF
+rm -f "$FUSED_TABLE"
+# the subset also runs inside step 4's full sweep (~18 s overlap) — kept
+# here deliberately as a fail-fast signal before the 6-minute tier-1
+JAX_PLATFORMS=cpu python -m pytest tests/test_pallas_conv_bn_bwd.py -q \
+    -m 'not slow' -p no:cacheprovider \
+    || { echo "bwd parity subset FAILED"; exit 1; }
+
+echo "== [4/4] tier-1 tests =="
 rm -f /tmp/_t1.log
 timeout -k 10 870 env JAX_PLATFORMS=cpu python -m pytest tests/ -q -m 'not slow' \
     --continue-on-collection-errors -p no:cacheprovider -p no:xdist -p no:randomly \
