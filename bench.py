@@ -217,9 +217,14 @@ def _bench_resnet50(on_tpu, models, parallel, dev):
     n_steps = 10 if on_tpu else 3
 
     def timed(tr):
+        from mxnet_tpu import telemetry
+
+        mark = telemetry.enabled()  # off by default: zero touch on the clock
         t0 = time.perf_counter()
         for _ in range(n_steps):
             outs = tr.step({"data": x}, {"softmax_label": y})
+            if mark:
+                telemetry.mark_step()
         _sync(outs)
         return batch * n_steps / (time.perf_counter() - t0)
 
@@ -431,6 +436,17 @@ def main():
     # the headline flag the scoreboard reads: did the BACKWARD fused path
     # have an engage route this run (docs/PERF.md §6b)
     result["fused_bwd_engaged"] = bool(fc.get("bwd_engaged"))
+    # MXNET_TELEMETRY=counters|trace: the registry's view of the same run —
+    # retraces, fused engage counts, kv bytes/step — next to the wall time
+    # (docs/OBSERVABILITY.md). Off by default; the report must never sink
+    # the measured number.
+    try:
+        from mxnet_tpu import telemetry
+
+        if telemetry.enabled():
+            result["telemetry"] = telemetry.summarize()
+    except Exception as exc:
+        result["telemetry_error"] = "%s: %s" % (type(exc).__name__, exc)
     if degraded:
         result["degraded"] = True  # TPU probe failed; this is a CPU number
         try:

@@ -87,6 +87,7 @@ def __getattr__(name):
         "visualization": ".visualization",
         "viz": ".visualization",
         "profiler": ".profiler",
+        "telemetry": ".telemetry",
         "recordio": ".recordio",
         "image": ".image",
         "img": ".image",

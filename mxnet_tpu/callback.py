@@ -56,7 +56,12 @@ class Speedometer:
     the throughput number the benchmarks track — plus step time, and MFU when
     ``flops_per_sample`` is given and the device's bf16 peak is known
     (device_info.py). Training logs then carry the BASELINE scoreboard
-    numbers directly."""
+    numbers directly.
+
+    When telemetry is enabled the window duration comes from the registry's
+    per-step rows (``Module.fit`` marks one per batch) — ONE wall-clock
+    source of truth shared with ``mxtrace``/``bench.py`` instead of a
+    second ``time.time()`` path that can disagree with the trace."""
 
     def __init__(self, batch_size, frequent=50, flops_per_sample=None):
         self.batch_size = batch_size
@@ -66,6 +71,40 @@ class Speedometer:
         self.tic = 0
         self.last_count = 0
         self._peak = None  # resolved lazily from the default device
+        self._tic_step = None  # newest telemetry step id when tic was set
+
+    @staticmethod
+    def _newest_step():
+        from . import telemetry
+
+        if not telemetry.enabled():
+            return None
+        rows = telemetry.step_rows(last=1)
+        return rows[-1]["step"] if rows else None
+
+    def _set_tic(self):
+        self.tic = time.time()
+        self._tic_step = self._newest_step()
+
+    def _window(self):
+        """``(seconds, batches)`` since the last report. Telemetry step rows
+        are used only when they are FRESH — marked after this window's tic
+        (a loop that never calls ``mark_step``, e.g. eval/score after a fit,
+        must not recycle the fit's stale rows as its own speed) — else the
+        local wall clock."""
+        from . import telemetry
+
+        if telemetry.enabled() and self._tic_step is not None:
+            rows = telemetry.step_rows(last=self.frequent + 1)
+            fresh = [r for r in rows if r["step"] > self._tic_step
+                     and r["wall_ms"] is not None]
+            newest = rows[-1]["step"] if rows else self._tic_step
+            delta = newest - self._tic_step
+            # contiguity: every step of the window is present and timed
+            if fresh and len(fresh) == delta and delta <= self.frequent:
+                return (max(sum(r["wall_ms"] for r in fresh) / 1000.0, 1e-9),
+                        delta)
+        return max(time.time() - self.tic, 1e-9), self.frequent
 
     def _mfu(self, speed):
         if not self.flops_per_sample:
@@ -89,9 +128,9 @@ class Speedometer:
 
         if self.init:
             if count % self.frequent == 0:
-                dt = time.time() - self.tic
-                speed = self.frequent * self.batch_size / dt
-                step_ms = 1000.0 * dt / self.frequent
+                dt, nbatches = self._window()
+                speed = nbatches * self.batch_size / dt
+                step_ms = 1000.0 * dt / nbatches
                 mfu = self._mfu(speed)
                 perf = "Speed: %.2f samples/sec\tStep: %.1f ms" % (speed, step_ms)
                 if mfu is not None:
@@ -105,10 +144,10 @@ class Speedometer:
                 else:
                     logging.info("Iter[%d] Batch [%d]\t%s",
                                  param.epoch, count, perf)
-                self.tic = time.time()
+                self._set_tic()
         else:
             self.init = True
-            self.tic = time.time()
+            self._set_tic()
 
 
 class ProgressBar:

@@ -157,15 +157,44 @@ def num_dead_nodes(timeout=60.0, startup_grace=None):
         pass
     in_grace = now - start <= startup_grace
     dead = 0
+    max_age = 0.0
     for r in range(n):
         path = os.path.join(hb_dir, "worker-%d" % r)
         try:
-            if now - os.path.getmtime(path) > timeout:
+            age = now - os.path.getmtime(path)
+            max_age = max(max_age, age)
+            if age > timeout:
                 dead += 1
         except OSError:
             if not in_grace:
                 dead += 1  # never heartbeated and the grace period is over
+                # its effective staleness is the whole job lifetime — the
+                # age gauge must not read 0 when every worker is missing
+                max_age = max(max_age, now - start)
+    _note_liveness(dead, max_age)
     return dead
+
+
+_last_dead = 0  # previous num_dead_nodes result, for transition counting
+
+
+def _note_liveness(dead, max_age):
+    """Telemetry: current dead-worker count and oldest heartbeat age as
+    gauges, plus a counter that ticks on every dead-count CHANGE — the
+    'node died / node came back' transitions a dashboard alerts on."""
+    global _last_dead
+    from . import telemetry as _tm
+
+    if not _tm.enabled():
+        _last_dead = dead
+        return
+    _tm.gauge("dist.dead_nodes").set(dead)
+    _tm.gauge("dist.heartbeat_age_s").set(round(max_age, 3))
+    if dead != _last_dead:
+        _tm.counter("dist.dead_node_transitions").inc()
+        _tm.event("dist.dead_node_transition", dead=dead,
+                  previous=_last_dead)
+        _last_dead = dead
 
 
 def rank() -> int:

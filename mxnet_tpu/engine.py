@@ -32,8 +32,23 @@ import os
 import threading
 
 from .base import MXNetError
+from . import telemetry as _tm
 
 __all__ = ["Engine", "NaiveEngine", "ThreadedEngine", "get", "set_engine_type"]
+
+
+def _traced_op(fn, backend):
+    """Wrap a pushed op so its execution shows up as an ``engine.op`` span
+    (the reference profiler's per-op start/end stamps, profiler.cc). Only
+    called when telemetry tracing is on — the off path pushes ``fn``
+    untouched."""
+    name = getattr(fn, "__name__", "op")
+
+    def run():
+        with _tm.span("engine.op", op=name, backend=backend):
+            fn()
+
+    return run
 
 _ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
 _SRC = os.path.join(_ROOT, "src", "engine_native.cc")
@@ -132,6 +147,10 @@ class NaiveEngine(Engine):
         return v
 
     def push(self, fn, const_vars=(), mutable_vars=()):
+        if _tm.enabled():
+            _tm.counter("engine.push").inc()
+            if _tm.tracing():
+                fn = _traced_op(fn, "naive")
         for v in (*const_vars, *mutable_vars):
             if not (isinstance(v, int) and 1 <= v < self._next):
                 self._pushed.add(v)
@@ -141,9 +160,10 @@ class NaiveEngine(Engine):
         if not (isinstance(var, int) and 1 <= var < self._next) \
                 and var not in self._pushed:
             raise _unknown_var_error(var)
+        _tm.event("engine.wait_for_var", backend="naive")
 
     def wait_for_all(self):
-        pass
+        _tm.event("engine.wait_for_all", backend="naive")
 
 
 class ThreadedEngine(Engine):
@@ -184,6 +204,11 @@ class ThreadedEngine(Engine):
         return v
 
     def push(self, fn, const_vars=(), mutable_vars=()):
+        if _tm.enabled():
+            _tm.counter("engine.push").inc()
+            if _tm.tracing():
+                fn = _traced_op(fn, "native" if self._lib is not None
+                                else "python")
         if self._lib is None:
             return self._py.push(fn, const_vars, mutable_vars)
         for v in (*const_vars, *mutable_vars):
@@ -221,13 +246,15 @@ class ThreadedEngine(Engine):
             # the native GetVar would silently conjure a fresh idle Var for
             # any int64 — return-immediately on a typo'd id. Fail loudly.
             raise _unknown_var_error(var)
-        self._lib.mxeng_wait_for_var(self._handle, var)
+        with _tm.span("engine.wait_for_var", backend="native"):
+            self._lib.mxeng_wait_for_var(self._handle, var)
         self._raise_pending()
 
     def wait_for_all(self):
         if self._lib is None:
             return self._py.wait_for_all()
-        self._lib.mxeng_wait_for_all(self._handle)
+        with _tm.span("engine.wait_for_all", backend="native"):
+            self._lib.mxeng_wait_for_all(self._handle)
         with self._keep_lock:
             # every op drained and its callback fully returned — purge all
             while self._done:
@@ -338,7 +365,7 @@ class _PythonThreadedEngine(Engine):
             self._cond.notify_all()
 
     def wait_for_var(self, var):
-        with self._cond:
+        with _tm.span("engine.wait_for_var", backend="python"), self._cond:
             if var not in self._var_queues:
                 # neither new_variable() nor any push registered this id —
                 # the old behavior (return immediately) silently "succeeded"
@@ -350,7 +377,7 @@ class _PythonThreadedEngine(Engine):
             self._raise_pending()
 
     def wait_for_all(self):
-        with self._cond:
+        with _tm.span("engine.wait_for_all", backend="python"), self._cond:
             self._cond.wait_for(lambda: self._pending == 0)
             self._raise_pending()
 

@@ -30,6 +30,7 @@ from .base import MXNetError, np_dtype
 from .context import Context, current_context
 from .ndarray import NDArray, _Chunk, zeros
 from .ops.registry import get_op
+from . import telemetry as _tm
 
 __all__ = ["Executor", "bind", "simple_bind"]
 
@@ -87,6 +88,49 @@ class _GraphProgram:
         # per-instance jit cache (an lru_cache on the methods would key a
         # class-level cache on self and leak every program + XLA executable)
         self._jit_cache = {}
+        # telemetry: abstract-value signatures seen per jit entry, mirroring
+        # jax.jit's own cache key so compile/cache-hit/retrace is observable
+        # without reaching into jax internals (maintained only when
+        # MXNET_TELEMETRY is on)
+        self._seen_sigs = {}
+        self._retrace_reason = None  # lazy GL201-203 diagnosis, cached
+
+    # -------------------------------------------------------------- telemetry
+    def _note_call(self, key, args, aux, extra=()):
+        """Classify one compiled-entry call: ``compile`` (first signature
+        for this jit key), ``cache_hit`` (signature seen before), or
+        ``retrace`` (a NEW signature after the first — jax.jit compiles a
+        fresh XLA program). Returns ``(kind, reason)``; ``reason`` is the
+        cached GL201-203 retrace-guard diagnosis on retraces."""
+        sig = (tuple((tuple(a.shape), str(a.dtype)) for a in args),
+               tuple((tuple(a.shape), str(a.dtype)) for a in aux),
+               extra)
+        seen = self._seen_sigs.setdefault(key, set())
+        if sig in seen:
+            return "cache_hit", None
+        first = not seen
+        seen.add(sig)
+        if first:
+            return "compile", None
+        return "retrace", self._retrace_reasons()
+
+    def _retrace_reasons(self):
+        """Why this program retraces, per the static retrace guard
+        (analysis/retrace_guard.py GL201-203) — run once per program, on
+        the first observed retrace, and cached."""
+        if self._retrace_reason is None:
+            try:
+                from .analysis import lint
+
+                rep = lint(self.symbol, passes=["retrace_guard"])
+                self._retrace_reason = "; ".join(
+                    "%s: %s" % (d.code, d.message) for d in rep) \
+                    or "no GL201-203 pattern found (shape/dtype change " \
+                       "came from the caller)"
+            except Exception as exc:  # diagnosis must never sink a step
+                self._retrace_reason = "retrace-guard diagnosis failed: %s" \
+                    % exc
+        return self._retrace_reason
 
     # ---------------------------------------------------------------- tracing
     def interpret(self, arg_vals, aux_vals, is_train, rng):
@@ -275,24 +319,40 @@ class Executor:
             self.arg_dict[k][:] = v
         args, aux = self._collect()
         rng = self._next_rng()
+        sp = _tm.NULL_SPAN
+        if _tm.enabled():
+            sp = _tm.span("executor.forward", train=bool(is_train))
+            self._note_telemetry(sp, ("fwd", bool(is_train)), args, aux)
         # release the previous step's residuals BEFORE tracing the new vjp —
         # otherwise two full activation sets coexist on device
         self._cached_vjp = None
-        if is_train and any(r != "null" for r in self._grad_req):
-            import jax
+        with sp:
+            if is_train and any(r != "null" for r in self._grad_req):
+                import jax
 
-            fn = self._prog._fwd(True)
+                fn = self._prog._fwd(True)
 
-            def f(a):
-                return fn(a, aux, rng)
+                def f(a):
+                    return fn(a, aux, rng)
 
-            outs, vjp_fn, new_aux = jax.vjp(f, args, has_aux=True)
-            self._cached_vjp = (vjp_fn, tuple(o.dtype for o in outs))
-        else:
-            outs, new_aux = self._prog._fwd(bool(is_train))(args, aux, rng)
+                outs, vjp_fn, new_aux = jax.vjp(f, args, has_aux=True)
+                self._cached_vjp = (vjp_fn, tuple(o.dtype for o in outs))
+            else:
+                outs, new_aux = self._prog._fwd(bool(is_train))(args, aux, rng)
         if is_train:
             self._write_aux(new_aux)
         return self._set_outputs(outs)
+
+    def _note_telemetry(self, sp, key, args, aux, extra=()):
+        """Count compile/cache_hit/retrace for this call and attach the
+        classification (plus the GL201-203 diagnosis on retraces) to the
+        span. Caller guards with ``_tm.enabled()``."""
+        kind, reason = self._prog._note_call(key, args, aux, extra)
+        _tm.counter("executor." + kind).inc()
+        sp.set(cache=kind)
+        if reason is not None:
+            sp.set(retrace_reason=reason)
+            _tm.gauge("executor.last_retrace_reason").set(reason)
 
     def backward(self, out_grads=None):
         """Run backward, accumulating into grad arrays per grad_req.
@@ -322,18 +382,23 @@ class Executor:
             else:
                 cot = tuple(g._jax().astype(dt)
                             for g, dt in zip(out_grads, out_dtypes))
-            (grads,) = vjp_fn(cot)
+            with _tm.span("executor.backward", path="cached_vjp"):
+                (grads,) = vjp_fn(cot)
             self._cached_vjp = None  # residuals consumed — free the activations
             self._apply_grads(grads)
             return
         args, aux = self._collect()
         rng = self._last_rng if self._last_rng is not None else self._next_rng()
-        if out_grads is None:
-            fn = self._prog._fwd_bwd_cached(False)
-            outs, grads, _ = fn(args, aux, (), rng)
-        else:
-            head = tuple(g._jax() for g in out_grads)
-            fn = self._prog._fwd_bwd_cached(True)
+        with_head = out_grads is not None
+        head = tuple(g._jax() for g in out_grads) if with_head else ()
+        sp = _tm.NULL_SPAN
+        if _tm.enabled():
+            sp = _tm.span("executor.backward", path="fused_fwd_bwd")
+            self._note_telemetry(
+                sp, ("fwd_bwd", with_head), args, aux,
+                extra=tuple((tuple(h.shape), str(h.dtype)) for h in head))
+        with sp:
+            fn = self._prog._fwd_bwd_cached(with_head)
             outs, grads, _ = fn(args, aux, head, rng)
         self._apply_grads(grads)
 
@@ -344,12 +409,16 @@ class Executor:
         args, aux = self._collect()
         rng = self._next_rng()
         self._cached_vjp = None  # this step supersedes any cached forward
-        if out_grads is None:
-            fn = self._prog._fwd_bwd_cached(False)
-            outs, grads, new_aux = fn(args, aux, (), rng)
-        else:
-            head = tuple(g._jax() for g in out_grads)
-            fn = self._prog._fwd_bwd_cached(True)
+        with_head = out_grads is not None
+        head = tuple(g._jax() for g in out_grads) if with_head else ()
+        sp = _tm.NULL_SPAN
+        if _tm.enabled():
+            sp = _tm.span("executor.forward_backward", train=bool(is_train))
+            self._note_telemetry(
+                sp, ("fwd_bwd", with_head), args, aux,
+                extra=tuple((tuple(h.shape), str(h.dtype)) for h in head))
+        with sp:
+            fn = self._prog._fwd_bwd_cached(with_head)
             outs, grads, new_aux = fn(args, aux, head, rng)
         self._write_aux(new_aux)
         self._apply_grads(grads)
@@ -455,11 +524,15 @@ def _lint_at_bind(symbol, arg_arrays, arg_names, aux_arrays, aux_names):
 def bind(symbol, ctx, args, args_grad=None, grad_req="write", aux_states=None, shared_exec=None, group2ctx=None):
     """Bind NDArrays to a symbol's arguments (reference: symbol.py:917 bind →
     Executor::Bind, graph_executor.cc:936)."""
-    if shared_exec is not None and shared_exec._symbol is symbol \
-            and shared_exec._prog.group2ctx == dict(group2ctx or {}):
-        prog = shared_exec._prog
-    else:
-        prog = _GraphProgram(symbol, group2ctx=group2ctx)
+    if _tm.enabled():
+        _tm.counter("executor.bind").inc()
+    with _tm.span("executor.bind", symbol=symbol.name,
+                  shared=shared_exec is not None):
+        if shared_exec is not None and shared_exec._symbol is symbol \
+                and shared_exec._prog.group2ctx == dict(group2ctx or {}):
+            prog = shared_exec._prog
+        else:
+            prog = _GraphProgram(symbol, group2ctx=group2ctx)
     arg_names = prog.arg_names
     aux_names = prog.aux_names
     ctx = Context(ctx) if not isinstance(ctx, Context) else ctx

@@ -40,8 +40,9 @@ import jax.numpy as jnp
 
 from .ops.pallas_conv_bn import (_xla_conv, conv_block, plan_blocks,
                                  plan_bwd_blocks, strided_dims, supported)
+from . import telemetry as _tm
 
-__all__ = ["plan", "execute", "resolve", "gate", "bwd_mode",
+__all__ = ["plan", "execute", "resolve", "gate", "gate_explain", "bwd_mode",
            "conv_reject_reason", "bn_reject_reason"]
 
 
@@ -313,24 +314,48 @@ def _table_device_matches():
         return False
 
 
+def gate_explain(kernel, stride, x_shape, w_shape, dtype, prologue,
+                 res=False):
+    """The per-shape engage decision WITH the predicate that made it:
+    ``(engaged, reason)``. Same predicate order as the reference planner's
+    gate; ``gate`` is this plus telemetry counting. Keep each reason a
+    single precise predicate — telemetry spans and fusion_explain (GL301)
+    report them verbatim."""
+    env = os.environ.get("MXNET_FUSED_CONV_BN", "auto")
+    if env == "0":
+        return False, "MXNET_FUSED_CONV_BN=0 (fusion disabled)"
+    if not supported(x_shape, w_shape, stride,
+                     itemsize=jnp.dtype(dtype).itemsize,
+                     prologue=prologue, res=res):
+        return False, ("shape %sx%s does not tile within the VMEM budget "
+                       "(supported() declined)" % (x_shape, w_shape))
+    if env == "1":
+        return True, "forced (MXNET_FUSED_CONV_BN=1)"
+    if not prologue:
+        return False, ("bare conv (no folded BN prologue): no measured "
+                       "WINS contract, never engages in auto mode")
+    if not _table_device_matches():
+        return False, ("WINS table absent or measured on a different "
+                       "device generation")
+    from .ops.fused_conv_bn_table import WINS
+
+    if bool(WINS.get(_wins_key(kernel, stride, x_shape, w_shape, res),
+                     False)):
+        return True, "WINS-table win for this shape"
+    return False, "no WINS-table win for this shape"
+
+
 def gate(kernel, stride, x_shape, w_shape, dtype, prologue, res=False):
     """Per-shape engage decision: env override, else the committed on-chip
     WINS table (device-matched, per measured VARIANT — 'p' prologue-only,
     'pr' prologue+residual; bare convs have no measured contract and never
     engage in auto mode), else off. Untileable calls never engage."""
-    env = os.environ.get("MXNET_FUSED_CONV_BN", "auto")
-    if env == "0" or not supported(x_shape, w_shape, stride,
-                                   itemsize=jnp.dtype(dtype).itemsize,
-                                   prologue=prologue, res=res):
-        return False
-    if env == "1":
-        return True
-    if not prologue or not _table_device_matches():
-        return False
-    from .ops.fused_conv_bn_table import WINS
-
-    return bool(WINS.get(_wins_key(kernel, stride, x_shape, w_shape, res),
-                         False))
+    engaged, _ = gate_explain(kernel, stride, x_shape, w_shape, dtype,
+                              prologue, res=res)
+    if _tm.enabled():
+        _tm.counter("fusion.fwd_engaged" if engaged
+                    else "fusion.fwd_fallback").inc()
+    return engaged
 
 
 def _wins_key(kernel, stride, x_shape, w_shape, res):
@@ -346,6 +371,19 @@ _warned_bwd_env = False
 
 
 def bwd_mode(kernel, stride, x_shape, w_shape, dtype, prologue, res=False):
+    """The stash-vs-recompute policy for the fused backward, decided per
+    shape (see ``_bwd_mode_impl``); counts ``fusion.bwd_engaged`` /
+    ``fusion.bwd_xla`` into the telemetry registry when enabled."""
+    mode = _bwd_mode_impl(kernel, stride, x_shape, w_shape, dtype, prologue,
+                          res=res)
+    if _tm.enabled():
+        _tm.counter("fusion.bwd_xla" if mode == "xla"
+                    else "fusion.bwd_engaged").inc()
+    return mode
+
+
+def _bwd_mode_impl(kernel, stride, x_shape, w_shape, dtype, prologue,
+                   res=False):
     """The stash-vs-recompute policy for the fused backward, decided per
     shape like ``choose_blocks`` (docs/PERF.md §6b):
 
@@ -515,6 +553,18 @@ def _conv_block_sharded(mesh, x, w, scale, shift, res, kernel, stride, relu,
     return fn(*args)
 
 
+def _note_conv(node, x_shape, engaged, reason, bwd=None):
+    """Trace-time telemetry: one event per planned conv recording the
+    per-shape engage-or-fallback decision with its predicate. Fires during
+    jit tracing (once per compile, not per step) — the observable record of
+    whether the Pallas path actually ran in this program."""
+    if not _tm.tracing():
+        return
+    _tm.event("fusion.conv", op=node.name, shape=tuple(x_shape),
+              engaged=engaged, reason=reason,
+              **({} if bwd is None else {"bwd": bwd}))
+
+
 def _exec_conv(directive, node, ins):
     v, w = ins
     kernel, stride = directive["kernel"], directive["stride"]
@@ -530,6 +580,7 @@ def _exec_conv(directive, node, ins):
                          scale is not None, res=directive["defer"])):
             bwd = bwd_mode(kernel, stride, local_shape, w.shape, x.dtype,
                            scale is not None, res=directive["defer"])
+            _note_conv(node, local_shape, True, "engaged (dp mesh)", bwd)
             if directive["defer"]:
                 return PendingConv(x, w, scale, shift, relu, kernel, stride,
                                    bwd)
@@ -541,6 +592,7 @@ def _exec_conv(directive, node, ins):
                                      res=directive["defer"]):
         bwd = bwd_mode(kernel, stride, x.shape, w.shape, x.dtype,
                        scale is not None, res=directive["defer"])
+        _note_conv(node, x.shape, True, "engaged", bwd)
         if directive["defer"]:
             return PendingConv(x, w, scale, shift, relu, kernel, stride,
                                bwd)
@@ -550,6 +602,29 @@ def _exec_conv(directive, node, ins):
     # kind == _MESH_OTHER (tensor/seq-sharded) always lands here: XLA path
     # fallback: materialize the normalized input (cached on the marker) and
     # run the ordinary XLA conv (shared lowering from pallas_conv_bn)
+    if _tm.enabled():
+        # the mesh-shape branches above never reach gate(), so their
+        # fallback must be counted here or these configs would read as
+        # "zero fallbacks" in exactly the runs where fusion disengaged
+        mesh_barred = (kind == _MESH_OTHER
+                       or (kind == _MESH_DP and x.shape[0] % dp != 0))
+        if mesh_barred:
+            _tm.counter("fusion.fwd_fallback").inc()
+        if _tm.tracing():
+            if kind == _MESH_OTHER:
+                reason = ("multi-device mesh without a pure 'data' axis: a "
+                          "raw pallas_call would make GSPMD gather its "
+                          "operands")
+            elif mesh_barred:
+                reason = ("batch %d not divisible by data-parallel degree %d"
+                          % (x.shape[0], dp))
+            else:
+                shape = ((x.shape[0] // dp,) + x.shape[1:]
+                         if kind == _MESH_DP else x.shape)
+                _, reason = gate_explain(kernel, stride, shape, w.shape,
+                                         x.dtype, scale is not None,
+                                         res=directive["defer"])
+            _note_conv(node, x.shape, False, reason)
     xn = v.materialize() if isinstance(v, Deferred) else x
     return _xla_conv(xn, w, None, None, None, kernel, stride, False)
 

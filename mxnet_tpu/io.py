@@ -19,6 +19,7 @@ import numpy as np
 
 from .base import MXNetError
 from . import ndarray as nd
+from . import telemetry as _tm
 from .ndarray import NDArray, array
 
 __all__ = [
@@ -182,12 +183,26 @@ class NDArrayIter(DataIter):
         self.cursor += self.batch_size
         return self.cursor < self.num_data
 
-    def next(self):
+    def _next_batch(self):
         if self.iter_next():
-            return DataBatch(
-                data=self.getdata(), label=self.getlabel(), pad=self.getpad(), index=None
-            )
+            return DataBatch(data=self.getdata(), label=self.getlabel(),
+                             pad=self.getpad(), index=None)
         raise StopIteration
+
+    def next(self):
+        if not _tm.enabled():
+            return self._next_batch()
+        # batch-fetch latency: host slicing + NDArray materialization — the
+        # time the accelerator would wait on input without a prefetcher.
+        # The timer serves `counters` mode; the span serves `trace` mode.
+        import time as _time
+
+        t0 = _time.perf_counter()
+        with _tm.span("io.next", iter=type(self).__name__):
+            batch = self._next_batch()
+        _tm.counter("io.batches").inc()
+        _tm.timer("io.batch_fetch").add(_time.perf_counter() - t0)
+        return batch
 
     def _getdata(self, data_source):
         assert self.cursor < self.num_data, "DataIter needs reset."
@@ -378,7 +393,17 @@ class PrefetchingIter(DataIter):
     def iter_next(self):
         if self._ended:
             return False  # pumps are gone; blocking on the queues would hang
-        got = [q.get() for q in self._queues]
+        if _tm.enabled():
+            # consumer-side stall: >0 here means the pumps can't keep up and
+            # the accelerator is input-bound for this batch
+            import time as _time
+
+            t0 = _time.perf_counter()
+            with _tm.span("io.prefetch_wait"):
+                got = [q.get() for q in self._queues]
+            _tm.timer("io.prefetch_wait").add(_time.perf_counter() - t0)
+        else:
+            got = [q.get() for q in self._queues]
         for g in got:
             if isinstance(g, BaseException):
                 self._ended = True

@@ -27,9 +27,17 @@ from typing import Dict, List, Optional
 from .base import MXNetError
 from . import ndarray as nd
 from . import optimizer as opt
+from . import telemetry as _tm
 from .ndarray import NDArray
 
 __all__ = ["KVStore", "create"]
+
+
+def _nbytes(arrs) -> int:
+    """Host-side byte count of one value list (telemetry only)."""
+    import numpy as np
+
+    return sum(int(a.size) * np.dtype(a.dtype).itemsize for a in arrs)
 
 
 class KVStore:
@@ -103,28 +111,46 @@ class KVStore:
         kvstore_dist.h:275-313) — every worker must push the same keys in
         the same order, which SPMD training does by construction."""
         keys, grouped = _group_kv(key, value)
-        merged_list = [self._reduce_local(vals) for vals in grouped]
         for k in keys:
             if k not in self._store:
                 raise MXNetError("key %s has not been inited" % k)
-        if "dist" in self._type:
-            merged_list = self._allreduce_batch(merged_list)
-        for k, merged in zip(keys, merged_list):
-            if self._updater is not None:
-                self._updater(k, merged, self._store[k])
-            else:
-                self._store[k] = merged
+        sp = _tm.NULL_SPAN
+        if _tm.enabled():
+            # counted after validation: a rejected push must not inflate
+            # the traffic counters the byte-model comparisons read
+            pushed = _nbytes(m for vals in grouped for m in vals)
+            _tm.counter("kvstore.push_calls").inc()
+            _tm.counter("kvstore.push_bytes").inc(pushed)
+            sp = _tm.span("kvstore.push", nkeys=len(keys), bytes=pushed,
+                          dist="dist" in self._type)
+        with sp:
+            merged_list = [self._reduce_local(vals) for vals in grouped]
+            if "dist" in self._type:
+                merged_list = self._allreduce_batch(merged_list)
+            for k, merged in zip(keys, merged_list):
+                if self._updater is not None:
+                    self._updater(k, merged, self._store[k])
+                else:
+                    self._store[k] = merged
 
     def pull(self, key, out=None, priority=0):
         """Broadcast stored weight to outputs (reference: kvstore_local.h:75)."""
         assert out is not None
         keys, grouped = _group_kv(key, out)
-        for k, outs in zip(keys, grouped):
+        for k in keys:
             if k not in self._store:
                 raise MXNetError("key %s has not been inited" % k)
-            local = self._store[k]
-            for o in outs:
-                o[:] = local
+        sp = _tm.NULL_SPAN
+        if _tm.enabled():
+            pulled = _nbytes(o for outs in grouped for o in outs)
+            _tm.counter("kvstore.pull_calls").inc()
+            _tm.counter("kvstore.pull_bytes").inc(pulled)
+            sp = _tm.span("kvstore.pull", nkeys=len(keys), bytes=pulled)
+        with sp:
+            for k, outs in zip(keys, grouped):
+                local = self._store[k]
+                for o in outs:
+                    o[:] = local
 
     def _reduce_local(self, vals: List[NDArray]) -> NDArray:
         """Reduce this process's device copies of one key."""

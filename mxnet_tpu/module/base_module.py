@@ -15,6 +15,7 @@ import numpy as np
 
 from .. import metric as metric_mod
 from .. import ndarray as nd
+from .. import telemetry as _tm
 from ..base import MXNetError
 
 BatchEndParam = namedtuple("BatchEndParams", ["epoch", "nbatch", "eval_metric", "locals"])
@@ -240,6 +241,10 @@ class BaseModule:
                 self.forward_backward(data_batch)
                 self.update()
                 self.update_metric(eval_metric, data_batch.label)
+                if _tm.enabled():
+                    # close the step BEFORE the observers run: Monitor.toc
+                    # and Speedometer read this step's registry row
+                    _tm.mark_step()
                 if monitor is not None:
                     monitor.toc_print()
                 if batch_end_callback is not None:

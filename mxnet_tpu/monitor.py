@@ -35,7 +35,14 @@ class Monitor:
     def stat_helper(self, name, arr):
         if not self.activated or not self.re_prog.match(name):
             return
-        self.queue.append((self.step, name, self.stat_func(arr)))
+        try:
+            stat = self.stat_func(arr)
+        except Exception as exc:
+            # a non-numeric/odd-dtype output (int tokens, bool masks, a
+            # custom stat_func choking on bf16) must not abort fit mid-epoch
+            # — record the failure as the stat instead of raising
+            stat = "<stat failed: %s: %s>" % (type(exc).__name__, exc)
+        self.queue.append((self.step, name, stat))
 
     def install(self, exe):
         """(reference: monitor.py install — executor.set_monitor_callback)"""
@@ -58,7 +65,34 @@ class Monitor:
         for n, k, v in self.queue:
             res.append((n, k, str(v)))
         self.queue = []
+        res.extend(self._telemetry_stats())
         return res
+
+    def _telemetry_stats(self):
+        """Per-batch framework stats from the telemetry registry (single
+        source of truth with the trace/Speedometer): the latest step row's
+        counter/timer deltas, rendered like output stats. Empty when
+        telemetry is off or no step has been marked yet."""
+        from . import telemetry
+
+        if not telemetry.enabled():
+            return []
+        rows = telemetry.step_rows(last=1)
+        if not rows:
+            return []
+        row = rows[-1]
+        # label with THIS monitor's batch counter, not the registry's
+        # process-global step id — a prior fit/bench in the process would
+        # otherwise make the two row families disagree in the Batch column
+        n = self.step - 1
+        out = []
+        if row["wall_ms"] is not None:
+            out.append((n, "telemetry.step_wall_ms", str(row["wall_ms"])))
+        for name, delta in sorted(row["counters"].items()):
+            out.append((n, "telemetry." + name, str(delta)))
+        for name, t in sorted(row["timers"].items()):
+            out.append((n, "telemetry.%s_ms" % name, str(t["ms"])))
+        return out
 
     def toc_print(self):
         res = self.toc()

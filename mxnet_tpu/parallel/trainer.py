@@ -304,13 +304,21 @@ class SPMDTrainer:
             raise MXNetError("call init_params first")
         if self._step_fn is None:
             self._step_fn = self._build_step()
-        placed = self._place_batch(data, label)
-        if lr is None:
-            lr = self._opt_static_lr  # may stay None → apply() uses its own lr
-        self._step_count += 1
-        self.params, self.aux, self.opt_state, outs = self._step_fn(
-            self.params, self.aux, self.opt_state, placed, self._base_key,
-            None if lr is None else jnp.asarray(lr, "float32"))
+        from .. import telemetry as _tm
+
+        sp = _tm.NULL_SPAN
+        if _tm.enabled():
+            _tm.counter("trainer.step").inc()
+            # host-side dispatch time only: the XLA step itself is async
+            sp = _tm.span("trainer.step", n=self._step_count)
+        with sp:
+            placed = self._place_batch(data, label)
+            if lr is None:
+                lr = self._opt_static_lr  # may stay None → apply() uses its own lr
+            self._step_count += 1
+            self.params, self.aux, self.opt_state, outs = self._step_fn(
+                self.params, self.aux, self.opt_state, placed, self._base_key,
+                None if lr is None else jnp.asarray(lr, "float32"))
         return outs
 
     def _place_batch(self, data, label=None):

@@ -1,0 +1,38 @@
+"""mxnet_tpu.telemetry: low-overhead runtime observability.
+
+The framework-level counterpart of the reference engine profiler
+(src/engine/profiler.cc hand-stamped per-op start/end times and dumped
+chrome-trace JSON): a process-wide registry of named counters/gauges/timers
+with per-step snapshots, structured spans at the hot seams (engine push,
+executor compile-vs-cache-hit, fusion engage/fallback, kvstore push/pull,
+io batch fetch), and a chrome-trace exporter that merges with the XLA
+capture directory. Gated by ``MXNET_TELEMETRY=0|counters|trace``
+(docs/ENV_VARS.md); off is the default and costs one mode check per
+instrumented seam. Taxonomy and usage: docs/OBSERVABILITY.md.
+
+    MXNET_TELEMETRY=trace python train.py
+    python tools/mxtrace profile.json          # per-step table + top spans
+"""
+from __future__ import annotations
+
+from .registry import (Counter, Gauge, StepStats, Timer, counter, counters,
+                       gauge, mark_step, reset, snapshot, step_rows, timer)
+from .spans import (MODE_COUNTERS, MODE_OFF, MODE_TRACE, NULL_SPAN,
+                    clear_events, current_override, drain_events, enabled,
+                    event, mode, set_mode, span, tracing)
+from .trace import (SCHEMA_VERSION, build_trace, export_chrome_trace,
+                    span_summary, summarize)
+
+__all__ = [
+    # registry
+    "Counter", "Gauge", "Timer", "StepStats",
+    "counter", "gauge", "timer", "counters", "snapshot",
+    "mark_step", "step_rows", "reset",
+    # spans / gating
+    "MODE_OFF", "MODE_COUNTERS", "MODE_TRACE", "NULL_SPAN",
+    "mode", "enabled", "tracing", "set_mode", "current_override",
+    "span", "event", "drain_events", "clear_events",
+    # export
+    "SCHEMA_VERSION", "build_trace", "export_chrome_trace",
+    "span_summary", "summarize",
+]

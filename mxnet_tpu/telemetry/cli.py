@@ -1,0 +1,186 @@
+"""mxtrace — inspect/validate a telemetry chrome-trace dump.
+
+    python tools/mxtrace profile.json              # per-step table + top spans
+    python tools/mxtrace profile.json --top 40
+    python tools/mxtrace profile.json --check      # schema gate (CI), exit 0/1
+    python tools/mxtrace profile.json --json       # machine-readable summary
+
+The dump is what ``profiler.dump_profile()`` (or
+``telemetry.export_chrome_trace``) wrote: chrome-trace ``traceEvents`` plus
+an ``otherData`` block with the counter snapshot and per-step rows
+(docs/OBSERVABILITY.md). ``--check`` validates the schema every consumer
+of the dump relies on — the CI smoke gate after a telemetry-on fit.
+"""
+from __future__ import annotations
+
+import argparse
+import json
+import sys
+
+from .trace import SCHEMA_VERSION, span_summary
+
+# per-step table columns: (header, counter name in the step row)
+_STEP_COLS = [
+    ("compile", "executor.compile"),
+    ("hit", "executor.cache_hit"),
+    ("retrace", "executor.retrace"),
+    ("fused", "fusion.fwd_engaged"),
+    ("fallbk", "fusion.fwd_fallback"),
+    ("kv_B", "kvstore.push_bytes"),
+    ("io", "io.batches"),
+    ("push", "engine.push"),
+]
+
+
+def load(path):
+    with open(path) as f:
+        return json.load(f)
+
+
+def check(trace):
+    """Validate the dump schema. Returns a list of problems (empty = ok)."""
+    bad = []
+    if not isinstance(trace, dict):
+        return ["top level is %s, expected object" % type(trace).__name__]
+    events = trace.get("traceEvents")
+    if not isinstance(events, list):
+        return ["traceEvents missing or not a list"]
+    other = trace.get("otherData")
+    if not isinstance(other, dict):
+        bad.append("otherData missing or not an object")
+        other = {}
+    ver = other.get("mxnet_telemetry")
+    if ver != SCHEMA_VERSION:
+        bad.append("otherData.mxnet_telemetry is %r, expected %d"
+                   % (ver, SCHEMA_VERSION))
+    if not isinstance(other.get("counters", {}), dict):
+        bad.append("otherData.counters is not an object")
+    steps = other.get("steps", [])
+    if not isinstance(steps, list):
+        bad.append("otherData.steps is not a list")
+        steps = []
+    for i, row in enumerate(steps):
+        if not (isinstance(row, dict) and "step" in row
+                and isinstance(row.get("counters", None), dict)):
+            bad.append("steps[%d] malformed (need step + counters)" % i)
+            break
+    saw_process_meta = False
+    for i, ev in enumerate(events):
+        if not isinstance(ev, dict) or "ph" not in ev:
+            bad.append("traceEvents[%d] has no ph" % i)
+            break
+        if ev["ph"] == "M" and ev.get("name") == "process_name":
+            saw_process_meta = True
+        if ev["ph"] == "X":
+            if not isinstance(ev.get("name"), str):
+                bad.append("traceEvents[%d]: X event without a name" % i)
+                break
+            if not isinstance(ev.get("ts"), (int, float)) \
+                    or not isinstance(ev.get("dur"), (int, float)):
+                bad.append("traceEvents[%d] (%s): non-numeric ts/dur"
+                           % (i, ev["name"]))
+                break
+            if "pid" not in ev or "tid" not in ev:
+                bad.append("traceEvents[%d] (%s): missing pid/tid"
+                           % (i, ev["name"]))
+                break
+    if events and not saw_process_meta:
+        bad.append("no process_name metadata event")
+    return bad
+
+
+def _fmt_table(headers, rows):
+    widths = [max(len(h), *(len(r[i]) for r in rows)) if rows else len(h)
+              for i, h in enumerate(headers)]
+    out = ["  ".join(h.rjust(w) for h, w in zip(headers, widths))]
+    for r in rows:
+        out.append("  ".join(c.rjust(w) for c, w in zip(r, widths)))
+    return "\n".join(out)
+
+
+def step_table(trace):
+    steps = (trace.get("otherData") or {}).get("steps") or []
+    if not steps:
+        return "(no per-step rows — no step marks ran during the capture)"
+    headers = ["step", "wall_ms"] + [h for h, _ in _STEP_COLS]
+    rows = []
+    for row in steps:
+        c = row.get("counters", {})
+        wall = row.get("wall_ms")
+        rows.append([str(row.get("step", "?")),
+                     "-" if wall is None else "%.1f" % wall]
+                    + [str(c.get(key, 0)) for _, key in _STEP_COLS])
+    return _fmt_table(headers, rows)
+
+
+def spans_table(trace, top):
+    rows = span_summary(trace=trace, top=top)
+    if not rows:
+        return "(no spans recorded — was MXNET_TELEMETRY=trace set?)"
+    return _fmt_table(
+        ["span", "ms", "count", "ms/call"],
+        [[r["name"], "%.3f" % r["ms"], str(r["count"]),
+          "%.3f" % (r["ms"] / r["count"])] for r in rows])
+
+
+def main(argv=None):
+    ap = argparse.ArgumentParser(
+        prog="mxtrace", description="inspect/validate a mxnet_tpu telemetry "
+        "chrome-trace dump (docs/OBSERVABILITY.md)")
+    ap.add_argument("dump", help="chrome-trace JSON from "
+                    "profiler.dump_profile()")
+    ap.add_argument("--top", type=int, default=25,
+                    help="span summary length (default 25)")
+    ap.add_argument("--check", action="store_true",
+                    help="validate the dump schema; exit 0 iff valid")
+    ap.add_argument("--json", action="store_true",
+                    help="print a machine-readable summary")
+    args = ap.parse_args(argv)
+
+    try:
+        trace = load(args.dump)
+    except (OSError, ValueError) as exc:
+        print("mxtrace: cannot load %s: %s" % (args.dump, exc),
+              file=sys.stderr)
+        return 1
+
+    if args.check:
+        problems = check(trace)
+        if problems:
+            for p in problems:
+                print("mxtrace: SCHEMA: %s" % p, file=sys.stderr)
+            return 1
+        n_x = sum(1 for e in trace["traceEvents"] if e.get("ph") == "X")
+        cats = sorted({e.get("cat") for e in trace["traceEvents"]
+                       if e.get("ph") == "X" and e.get("cat")})
+        print("mxtrace: OK — %d span(s), categories: %s, %d step row(s)"
+              % (n_x, ",".join(cats) or "(none)",
+                 len((trace.get("otherData") or {}).get("steps") or [])))
+        return 0
+
+    other = trace.get("otherData") or {}
+    if args.json:
+        print(json.dumps({
+            "counters": other.get("counters", {}),
+            "num_steps": len(other.get("steps") or []),
+            "spans": span_summary(trace=trace, top=args.top),
+            "xla_trace_dir": other.get("xla_trace_dir"),
+        }))
+        return 0
+
+    print("== per-step table ==")
+    print(step_table(trace))
+    print()
+    print("== top %d spans ==" % args.top)
+    print(spans_table(trace, args.top))
+    counters = other.get("counters") or {}
+    if counters:
+        print()
+        print("== final counters ==")
+        for name, v in sorted(counters.items()):
+            print("  %-40s %s" % (name, v))
+    if other.get("xla_trace_dir"):
+        print()
+        print("XLA trace dir: %s (TensorBoard/Perfetto)"
+              % other["xla_trace_dir"])
+    return 0

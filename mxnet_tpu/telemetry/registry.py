@@ -1,0 +1,231 @@
+"""Process-wide instrument registry: counters, gauges, timers, StepStats.
+
+The reference engine's profiler kept per-op stat tables inside the engine
+(src/engine/profiler.cc); here the registry is the framework-wide single
+source of truth every layer reports into — executor compiles/cache hits,
+fusion engage decisions, kvstore bytes, io fetch latency — and every
+consumer reads out of (Speedometer, Monitor.toc, bench.py, mxtrace).
+
+Thread-safety: one process-wide lock guards instrument *creation*; each
+instrument carries its own lock for mutation, so concurrent engine workers
+incrementing different counters never contend on a global. All instruments
+are monotonically named — ``counter("engine.push")`` get-or-creates — and
+live for the process unless ``reset()`` is called (tests).
+"""
+from __future__ import annotations
+
+import threading
+import time
+
+__all__ = ["Counter", "Gauge", "Timer", "StepStats",
+           "counter", "gauge", "timer", "counters", "snapshot",
+           "mark_step", "step_rows", "reset"]
+
+
+class Counter:
+    """Monotonic integer counter (exact under threads)."""
+
+    __slots__ = ("name", "_v", "_lock")
+
+    def __init__(self, name):
+        self.name = name
+        self._v = 0
+        self._lock = threading.Lock()
+
+    def inc(self, n=1):
+        with self._lock:
+            self._v += n
+
+    @property
+    def value(self):
+        return self._v
+
+
+class Gauge:
+    """Last-written value (e.g. heartbeat age, dead-node count)."""
+
+    __slots__ = ("name", "_v", "_lock")
+
+    def __init__(self, name):
+        self.name = name
+        self._v = None
+        self._lock = threading.Lock()
+
+    def set(self, v):
+        with self._lock:
+            self._v = v
+
+    @property
+    def value(self):
+        return self._v
+
+
+class Timer:
+    """Accumulated duration + call count. ``add`` takes SECONDS (what
+    ``time.perf_counter`` deltas produce); readers get milliseconds."""
+
+    __slots__ = ("name", "_total", "_count", "_lock")
+
+    def __init__(self, name):
+        self.name = name
+        self._total = 0.0
+        self._count = 0
+        self._lock = threading.Lock()
+
+    def add(self, seconds):
+        with self._lock:
+            self._total += seconds
+            self._count += 1
+
+    @property
+    def total_ms(self):
+        return self._total * 1000.0
+
+    @property
+    def count(self):
+        return self._count
+
+
+_lock = threading.Lock()
+_instruments = {}  # name -> instrument
+
+
+def _get(name, cls):
+    inst = _instruments.get(name)
+    if inst is None:
+        with _lock:
+            inst = _instruments.get(name)
+            if inst is None:
+                inst = cls(name)
+                _instruments[name] = inst
+    if not isinstance(inst, cls):
+        raise TypeError("instrument %r already exists as %s"
+                        % (name, type(inst).__name__))
+    return inst
+
+
+def counter(name) -> Counter:
+    return _get(name, Counter)
+
+
+def gauge(name) -> Gauge:
+    return _get(name, Gauge)
+
+
+def timer(name) -> Timer:
+    return _get(name, Timer)
+
+
+def _items():
+    """Stable view for iteration: another thread creating its first
+    instrument mid-iteration (a pump thread's lazy ``timer()``) must not
+    blow up a reader with 'dict changed size during iteration'."""
+    with _lock:
+        return sorted(_instruments.items())
+
+
+def counters():
+    """Flat name->value view of every counter (bench/tests convenience)."""
+    return {n: i.value for n, i in _items() if isinstance(i, Counter)}
+
+
+def snapshot():
+    """Point-in-time view of EVERY instrument, JSON-safe."""
+    out = {}
+    for name, inst in _items():
+        if isinstance(inst, Counter):
+            out[name] = inst.value
+        elif isinstance(inst, Gauge):
+            out[name] = inst.value
+        else:
+            out[name] = {"total_ms": round(inst.total_ms, 3),
+                         "count": inst.count}
+    return out
+
+
+class StepStats:
+    """Per-step counter/timer deltas, ring-buffered.
+
+    ``mark()`` closes the current step: it diffs every counter/timer against
+    the previous mark and appends one row ``{"step", "wall_ms",
+    "counters": {name: delta}, "timers": {name: {ms, count}}}``. Rows
+    are bounded (``maxlen``) so a long fit cannot grow host memory without
+    bound. The registry-global instance backs ``mark_step``/``step_rows``.
+    """
+
+    def __init__(self, maxlen=4096):
+        self._lock = threading.Lock()
+        self._maxlen = maxlen
+        self._rows = []
+        self._step = 0
+        self._last_t = None
+        self._last_counters = {}
+        self._last_timers = {}
+
+    def mark(self, wall_ms=None):
+        now = time.perf_counter()
+        with self._lock:
+            cur_c, cur_t = {}, {}
+            for name, inst in _items():
+                if isinstance(inst, Counter):
+                    cur_c[name] = inst.value
+                elif isinstance(inst, Timer):
+                    cur_t[name] = (inst.total_ms, inst.count)
+            if wall_ms is None:
+                wall_ms = ((now - self._last_t) * 1000.0
+                           if self._last_t is not None else None)
+            dc = {n: v - self._last_counters.get(n, 0)
+                  for n, v in cur_c.items()
+                  if v - self._last_counters.get(n, 0)}
+            dt = {}
+            for n, (ms, cnt) in cur_t.items():
+                pms, pcnt = self._last_timers.get(n, (0.0, 0))
+                if cnt - pcnt:
+                    dt[n] = {"ms": round(ms - pms, 3), "count": cnt - pcnt}
+            row = {"step": self._step,
+                   "wall_ms": None if wall_ms is None else round(wall_ms, 3),
+                   "counters": dc, "timers": dt}
+            self._rows.append(row)
+            if len(self._rows) > self._maxlen:
+                del self._rows[: len(self._rows) - self._maxlen]
+            self._step += 1
+            self._last_t = now
+            self._last_counters = cur_c
+            self._last_timers = cur_t
+            return row
+
+    def rows(self, last=None):
+        with self._lock:
+            rows = list(self._rows)
+        return rows if last is None else rows[-last:]
+
+    def clear(self):
+        with self._lock:
+            self._rows = []
+            self._step = 0
+            self._last_t = None
+            self._last_counters = {}
+            self._last_timers = {}
+
+
+_steps = StepStats()
+
+
+def mark_step(wall_ms=None):
+    """Close the current training step (Module.fit / SPMDTrainer call this
+    once per batch when telemetry is enabled)."""
+    return _steps.mark(wall_ms=wall_ms)
+
+
+def step_rows(last=None):
+    """The recorded per-step rows, oldest first (``last`` = only the most
+    recent N)."""
+    return _steps.rows(last=last)
+
+
+def reset():
+    """Drop every instrument and step row (tests / capture restart)."""
+    global _instruments
+    with _lock:
+        _instruments = {}
+    _steps.clear()

@@ -1,0 +1,136 @@
+"""Chrome-trace export + live summary for the telemetry subsystem.
+
+The exporter honors the reference ``MXDumpProfile`` contract
+(src/engine/profiler.cc wrote ``traceEvents`` JSON the chrome://tracing
+viewer loads directly): complete ``"ph": "X"`` events with microsecond
+``ts``/``dur``, process/thread metadata events, plus an ``otherData``
+block carrying the counter snapshot and per-step rows — the part the
+reference never had and ``tools/mxtrace`` tables are built from. When a
+JAX/XLA capture ran alongside (profiler.py), the dump records the XLA
+trace directory so viewers and ``profiler.trace_files()`` can merge both.
+"""
+from __future__ import annotations
+
+import json
+import os
+
+from . import registry, spans
+
+__all__ = ["export_chrome_trace", "summarize", "span_summary",
+           "SCHEMA_VERSION"]
+
+SCHEMA_VERSION = 1
+
+_PID = 1  # single framework process lane
+
+
+def _category(name):
+    """Span taxonomy: the dotted prefix is the category lane
+    (``engine.push`` → ``engine``; docs/OBSERVABILITY.md)."""
+    return name.split(".", 1)[0] if "." in name else name
+
+
+def build_trace(xla_trace_dir=None, extra=None):
+    """The chrome-trace dict for the events recorded so far."""
+    perf0, wall0 = spans.epoch()
+    raw = spans.drain_events()
+    tids = {}
+    events = [{"ph": "M", "pid": _PID, "name": "process_name",
+               "args": {"name": "mxnet_tpu framework"}}]
+    for name, t0, dur, ident, attrs in raw:
+        tid = tids.get(ident)
+        if tid is None:
+            tid = tids[ident] = len(tids) + 1
+            events.append({"ph": "M", "pid": _PID, "tid": tid,
+                           "name": "thread_name",
+                           "args": {"name": "py-thread-%d" % tid}})
+        ev = {"ph": "X", "pid": _PID, "tid": tid,
+              "cat": _category(name), "name": name,
+              "ts": round((wall0 + (t0 - perf0)) * 1e6, 1),
+              "dur": round(dur * 1e6, 1)}
+        if attrs:
+            ev["args"] = {k: _jsonable(v) for k, v in attrs.items()}
+        events.append(ev)
+    other = {"mxnet_telemetry": SCHEMA_VERSION,
+             "counters": registry.snapshot(),
+             "steps": registry.step_rows()}
+    if xla_trace_dir:
+        other["xla_trace_dir"] = os.path.abspath(xla_trace_dir)
+    if extra:
+        other.update(extra)
+    return {"traceEvents": events, "displayTimeUnit": "ms",
+            "otherData": other}
+
+
+def _jsonable(v):
+    if isinstance(v, (str, int, float, bool)) or v is None:
+        return v
+    if isinstance(v, (tuple, list)):
+        return [_jsonable(x) for x in v]
+    return str(v)
+
+
+def export_chrome_trace(path, xla_trace_dir=None, extra=None):
+    """Write the chrome-trace JSON to ``path``; returns the trace dict."""
+    trace = build_trace(xla_trace_dir=xla_trace_dir, extra=extra)
+    d = os.path.dirname(os.path.abspath(path))
+    if d:
+        os.makedirs(d, exist_ok=True)
+    with open(path, "w") as f:
+        json.dump(trace, f)
+    return trace
+
+
+def span_summary(trace=None, top=25):
+    """Aggregate span wall time by name, heaviest first — the per-op stat
+    table of the reference engine profiler, over framework spans. Accepts a
+    loaded trace dict (mxtrace) or None for the live buffer."""
+    acc = {}
+    if trace is None:
+        for name, _t0, dur, _ident, _attrs in spans.drain_events():
+            ms, cnt = acc.get(name, (0.0, 0))
+            acc[name] = (ms + dur * 1000.0, cnt + 1)
+    else:
+        for ev in trace.get("traceEvents", []):
+            if ev.get("ph") != "X":
+                continue
+            name = ev.get("name", "?")
+            ms, cnt = acc.get(name, (0.0, 0))
+            acc[name] = (ms + ev.get("dur", 0) / 1000.0, cnt + 1)
+    rows = [{"name": n, "ms": round(ms, 3), "count": cnt}
+            for n, (ms, cnt) in acc.items()]
+    rows.sort(key=lambda r: -r["ms"])
+    return rows[:top]
+
+
+# counters the scoreboard cares about, reported per step when steps exist
+_KEY_COUNTERS = ("executor.retrace", "executor.compile", "executor.cache_hit",
+                 "fusion.fwd_engaged", "fusion.fwd_fallback",
+                 "fusion.bwd_engaged",
+                 "kvstore.push_bytes", "kvstore.pull_bytes",
+                 "engine.push")
+
+
+def summarize():
+    """Live summary for bench.py: the full counter snapshot, per-step rates
+    of the scoreboard counters, and the heaviest spans (trace mode only).
+
+    ``{"mode", "counters", "num_steps", "per_step", "spans"}`` — all
+    JSON-safe, cheap to build (no device work)."""
+    snap = registry.snapshot()
+    rows = registry.step_rows()
+    out = {"mode": {0: "off", 1: "counters", 2: "trace"}[spans.mode()],
+           "counters": snap, "num_steps": len(rows)}
+    if rows:
+        per_step = {}
+        for key in _KEY_COUNTERS:
+            total = sum(r["counters"].get(key, 0) for r in rows)
+            if total:
+                per_step[key] = round(total / float(len(rows)), 3)
+        timed = [r["wall_ms"] for r in rows if r["wall_ms"] is not None]
+        if timed:
+            per_step["wall_ms"] = round(sum(timed) / len(timed), 3)
+        out["per_step"] = per_step
+    if spans.tracing():
+        out["spans"] = span_summary(top=10)
+    return out

@@ -1,0 +1,361 @@
+"""Telemetry subsystem (mxnet_tpu/telemetry/, docs/OBSERVABILITY.md):
+registry correctness under threads, zero-overhead off path, chrome-trace
+schema, executor retrace counting, fusion-counter parity with bench.py's
+fused report, profiler state idempotency, and the end-to-end fit trace."""
+import json
+import os
+import subprocess
+import sys
+import threading
+
+import numpy as np
+import pytest
+
+import mxnet_tpu as mx
+from mxnet_tpu import telemetry
+
+ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+@pytest.fixture
+def tm():
+    """Fresh registry + explicit mode control, restored afterwards."""
+    telemetry.reset()
+    telemetry.clear_events()
+    saved = telemetry.current_override()
+    yield telemetry
+    telemetry.set_mode(saved)
+    telemetry.reset()
+    telemetry.clear_events()
+
+
+def _conv_bn_net():
+    sym = mx.sym.Variable("data")
+    sym = mx.sym.Convolution(sym, kernel=(3, 3), pad=(1, 1), num_filter=8,
+                             no_bias=True, name="conv1")
+    sym = mx.sym.BatchNorm(sym, name="bn1")
+    sym = mx.sym.Activation(sym, act_type="relu")
+    sym = mx.sym.Flatten(sym)
+    sym = mx.sym.FullyConnected(sym, num_hidden=4, name="fc")
+    return mx.sym.SoftmaxOutput(sym, name="softmax")
+
+
+# --------------------------------------------------------------- registry
+def test_counters_exact_under_threads(tm):
+    tm.set_mode("counters")
+    c = tm.counter("t.threads")
+    timer = tm.timer("t.timer")
+    N, T = 2000, 8
+
+    def work():
+        for _ in range(N):
+            c.inc()
+            timer.add(0.001)
+
+    threads = [threading.Thread(target=work) for _ in range(T)]
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join()
+    assert c.value == N * T
+    assert timer.count == N * T
+    assert abs(timer.total_ms - N * T) < 1e-6 * N * T + 1e-3
+
+
+def test_span_buffer_under_threads(tm):
+    tm.set_mode("trace")
+    N, T = 200, 6
+
+    def work(k):
+        for i in range(N):
+            with tm.span("t.span", worker=k):
+                pass
+
+    threads = [threading.Thread(target=work, args=(k,)) for k in range(T)]
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join()
+    events = tm.drain_events()
+    mine = [e for e in events if e[0] == "t.span"]
+    assert len(mine) == N * T
+    # every worker's spans landed, attrs intact (thread IDENTS can be
+    # reused once a thread exits, so count workers, not idents)
+    assert {e[4]["worker"] for e in mine} == set(range(T))
+
+
+def test_step_stats_deltas(tm):
+    tm.set_mode("counters")
+    c = tm.counter("t.step")
+    c.inc(3)
+    tm.mark_step()
+    c.inc(2)
+    row = tm.mark_step()
+    assert row["counters"] == {"t.step": 2}
+    rows = tm.step_rows()
+    assert [r["step"] for r in rows] == [0, 1]
+    assert rows[0]["counters"] == {"t.step": 3}
+    assert rows[1]["wall_ms"] is not None  # second mark has a delta
+
+
+# ------------------------------------------------------------- off = free
+def test_off_by_default_allocates_no_spans(tm):
+    tm.set_mode(None)
+    env = os.environ.get("MXNET_TELEMETRY")
+    try:
+        os.environ.pop("MXNET_TELEMETRY", None)
+        assert not telemetry.enabled() and not telemetry.tracing()
+        # the off path returns ONE shared no-op object — no allocation
+        s1 = telemetry.span("engine.push")
+        s2 = telemetry.span("kvstore.pull", nkeys=3)
+        assert s1 is s2 is telemetry.NULL_SPAN
+        with s1 as s:
+            s.set(anything=1)  # all methods are no-ops
+        telemetry.event("x")  # swallowed
+        assert telemetry.drain_events() == []
+    finally:
+        if env is not None:
+            os.environ["MXNET_TELEMETRY"] = env
+
+
+def test_env_gating_modes(tm):
+    tm.set_mode(None)
+    env = os.environ.get("MXNET_TELEMETRY")
+    try:
+        os.environ["MXNET_TELEMETRY"] = "counters"
+        assert telemetry.enabled() and not telemetry.tracing()
+        os.environ["MXNET_TELEMETRY"] = "trace"
+        assert telemetry.enabled() and telemetry.tracing()
+        os.environ["MXNET_TELEMETRY"] = "bogus"  # warns once, stays off
+        assert not telemetry.enabled()
+    finally:
+        if env is None:
+            os.environ.pop("MXNET_TELEMETRY", None)
+        else:
+            os.environ["MXNET_TELEMETRY"] = env
+
+
+# ----------------------------------------------------------- chrome trace
+def test_chrome_trace_schema(tm, tmp_path):
+    from mxnet_tpu.telemetry import cli
+
+    tm.set_mode("trace")
+    tm.counter("executor.compile").inc()
+    with tm.span("executor.forward", cache="compile"):
+        with tm.span("engine.wait_for_all"):
+            pass
+    tm.mark_step()
+    path = str(tmp_path / "trace.json")
+    tm.export_chrome_trace(path, xla_trace_dir=str(tmp_path / "jax_trace"))
+    trace = json.load(open(path))
+    assert cli.check(trace) == []
+    xs = [e for e in trace["traceEvents"] if e["ph"] == "X"]
+    assert {e["cat"] for e in xs} == {"executor", "engine"}
+    assert all(e["dur"] >= 0 and e["ts"] > 0 for e in xs)
+    other = trace["otherData"]
+    assert other["mxnet_telemetry"] == telemetry.SCHEMA_VERSION
+    assert other["counters"]["executor.compile"] == 1
+    assert len(other["steps"]) == 1
+    # the CLI agrees, end to end
+    out = subprocess.run(
+        [sys.executable, os.path.join(ROOT, "tools", "mxtrace"), path,
+         "--check"], capture_output=True, text=True)
+    assert out.returncode == 0, out.stderr
+    # and a corrupted dump fails the gate
+    bad = dict(trace)
+    bad["traceEvents"] = [{"no_ph": True}]
+    assert cli.check(bad)
+
+
+# ------------------------------------------------------ executor counters
+def test_retrace_counter_on_cache_busting_rebind(tm):
+    tm.set_mode("counters")
+    sym = _conv_bn_net()
+    exe = mx.executor.simple_bind(sym, mx.cpu(), data=(2, 3, 8, 8),
+                                  softmax_label=(2,))
+    exe.forward_backward()
+    assert tm.counter("executor.compile").value == 1
+    assert tm.counter("executor.retrace").value == 0
+    exe.forward_backward()
+    assert tm.counter("executor.cache_hit").value == 1
+    # deliberate cache bust: reshape shares the program, so the new batch
+    # size is a NEW abstract signature on the same jit entry — a retrace
+    exe2 = exe.reshape(allow_up_sizing=True, data=(4, 3, 8, 8),
+                       softmax_label=(4,))
+    exe2.forward_backward()
+    assert tm.counter("executor.retrace").value == 1
+    reason = tm.gauge("executor.last_retrace_reason").value
+    assert reason  # GL201-203 diagnosis (or the explicit none-found text)
+    exe2.forward_backward()
+    assert tm.counter("executor.cache_hit").value == 2
+
+
+# ------------------------------------------------- fusion counter parity
+def test_fused_counter_parity_with_bench_report(tm):
+    import importlib.util
+
+    spec = importlib.util.spec_from_file_location(
+        "bench", os.path.join(ROOT, "bench.py"))
+    bench = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(bench)
+
+    tm.set_mode("counters")
+    rep = bench._fused_report(8, 64, "float32")
+    assert "error" not in rep
+    snap = tm.counters()
+    engaged = snap.get("fusion.fwd_engaged", 0)
+    fallback = snap.get("fusion.fwd_fallback", 0)
+    # every site config the report gated went through the counted gate
+    assert engaged + fallback > 0
+    # parity with the scoreboard flags bench.py derives from the same calls
+    assert bool(engaged) == bool(rep["fwd_engaged"])
+    assert bool(snap.get("fusion.bwd_engaged", 0)) == bool(rep["bwd_engaged"])
+    # bwd_mode is consulted exactly once per engaged forward config
+    assert (snap.get("fusion.bwd_engaged", 0)
+            + snap.get("fusion.bwd_xla", 0)) == engaged
+
+
+# ------------------------------------------------------------- profiler
+def test_profiler_state_idempotent(tm, tmp_path):
+    from mxnet_tpu import profiler
+
+    profiler.profiler_set_config(filename=str(tmp_path / "p.json"))
+    profiler.profiler_set_state("run")
+    st = profiler._state
+    td = profiler._trace_dir
+    profiler.profiler_set_state("run")  # no-op, no torn state
+    assert profiler._state == st and profiler._trace_dir == td
+    # the capture window forces span recording even though MXNET_TELEMETRY
+    # is unset in this process
+    assert telemetry.tracing()
+    with telemetry.span("test.captured"):
+        x = mx.nd.ones((8, 8))
+        (x + 1).wait_to_read()
+    profiler.profiler_set_state("stop")
+    profiler.profiler_set_state("stop")  # no-op
+    assert profiler._state == "stop"
+    path = profiler.dump_profile()
+    assert path and os.path.exists(path)
+    trace = json.load(open(path))
+    assert trace["otherData"]["mxnet_telemetry"] == telemetry.SCHEMA_VERSION
+    # merged artifact listing: the framework dump + the XLA capture files
+    files = profiler.trace_files()
+    assert path in files
+    assert any(f.endswith((".trace.json.gz", ".xplane.pb")) for f in files)
+    # merged summary carries both process lanes
+    rows = profiler.summarize(device_only=False, top=100)
+    assert any(r["process"] == "mxnet_tpu framework" for r in rows)
+
+
+def test_dump_profile_without_capture_is_clean(tmp_path):
+    # fresh subprocess: no capture must ever have run in-process
+    code = (
+        "import os; os.environ['MXNET_DEFAULT_CONTEXT']='cpu'\n"
+        "from mxnet_tpu import profiler\n"
+        "assert profiler.dump_profile() is None\n"
+        "assert profiler.trace_files() == []\n"
+        "profiler.profiler_set_state('stop')\n"  # stop-while-stopped: no-op
+        "assert profiler.dump_profile() is None\n"
+        "print('CLEAN')\n")
+    out = subprocess.run([sys.executable, "-c", code], capture_output=True,
+                         text=True, cwd=ROOT,
+                         env=dict(os.environ, JAX_PLATFORMS="cpu"))
+    assert out.returncode == 0, out.stderr
+    assert "CLEAN" in out.stdout
+
+
+# ------------------------------------------------- observers (satellites)
+def test_monitor_stat_helper_guards_non_numeric(tm):
+    mon = mx.monitor.Monitor(1)
+    mon.tic()
+    mon.stat_helper("ok", mx.nd.ones((2, 2)))
+
+    class Boom:
+        def asnumpy(self):
+            raise TypeError("not numeric")
+
+    mon.stat_helper("bad", Boom())  # must not raise mid-fit
+    res = mon.toc()
+    stats = {k: v for _, k, v in res}
+    assert stats["ok"] == "1.0"
+    assert "stat failed" in stats["bad"]
+
+
+def test_monitor_toc_reads_telemetry_registry(tm):
+    tm.set_mode("counters")
+    mon = mx.monitor.Monitor(1)
+    mon.tic()
+    tm.counter("kvstore.push_bytes").inc(128)
+    tm.mark_step()
+    res = mon.toc()
+    stats = {k: v for _, k, v in res}
+    assert stats["telemetry.kvstore.push_bytes"] == "128"
+
+
+def test_speedometer_reads_step_registry(tm, caplog):
+    import logging
+
+    tm.set_mode("counters")
+    sp = mx.callback.Speedometer(batch_size=10, frequent=2)
+
+    class P:
+        epoch, eval_metric = 0, None
+
+    # steps of known duration via explicit wall_ms
+    for n in range(1, 5):
+        telemetry.mark_step(wall_ms=100.0)
+        P.nbatch = n
+        with caplog.at_level(logging.INFO):
+            sp(P)
+    msgs = [r.message for r in caplog.records if "samples/sec" in r.message]
+    assert msgs, "Speedometer never logged"
+    # 2 batches x 10 samples over 2 x 100ms = 100 samples/sec
+    assert any("Speed: 100.00 samples/sec" in m for m in msgs), msgs
+
+    # staleness guard: a loop that does NOT mark steps (score/predict after
+    # a fit) must not recycle the fit's rows as its own speed — it falls
+    # back to the local wall clock (fast here, so >> 100 samples/sec)
+    caplog.clear()
+    for n in range(5, 9):
+        P.nbatch = n
+        with caplog.at_level(logging.INFO):
+            sp(P)
+    stale = [r.message for r in caplog.records if "samples/sec" in r.message]
+    assert stale and not any("Speed: 100.00 samples/sec" in m
+                             for m in stale), stale
+
+
+# ------------------------------------------------------------ end to end
+@pytest.mark.slow
+def test_fit_trace_end_to_end(tm, tmp_path):
+    """The acceptance path: a 3-step fit with MXNET_TELEMETRY=trace dumps a
+    chrome trace holding engine/executor/fusion/kvstore/io spans, >=1
+    compile and >=1 cache-hit step, and mxtrace --check passes."""
+    tm.set_mode("trace")
+    from mxnet_tpu import profiler
+
+    sym = _conv_bn_net()
+    rs = np.random.RandomState(0)
+    it = mx.io.NDArrayIter(rs.rand(12, 3, 8, 8).astype("float32"),
+                           rs.randint(0, 4, (12,)).astype("float32"),
+                           batch_size=4)
+    mod = mx.mod.Module(sym, context=mx.cpu())
+    profiler.profiler_set_config(filename=str(tmp_path / "profile.json"))
+    profiler.profiler_set_state("run")
+    mod.fit(it, num_epoch=1, kvstore=mx.kv.create("local"),
+            epoch_end_callback=mx.callback.do_checkpoint(
+                str(tmp_path / "ck")))
+    mx.nd.waitall()
+    path = profiler.dump_profile()
+    trace = json.load(open(path))
+    cats = {e.get("cat") for e in trace["traceEvents"] if e["ph"] == "X"}
+    assert {"engine", "executor", "fusion", "kvstore", "io"} <= cats, cats
+    counters = trace["otherData"]["counters"]
+    assert counters.get("executor.compile", 0) >= 1
+    assert counters.get("executor.cache_hit", 0) >= 1
+    assert counters.get("kvstore.push_bytes", 0) > 0
+    assert len(trace["otherData"]["steps"]) == 3
+    out = subprocess.run(
+        [sys.executable, os.path.join(ROOT, "tools", "mxtrace"), path,
+         "--check"], capture_output=True, text=True)
+    assert out.returncode == 0, out.stderr

@@ -1,6 +1,6 @@
 #!/usr/bin/env bash
 # CI entrypoint: static analysis first, then the fused conv+BN machinery
-# smoke, then the tier-1 test suite.
+# smoke, then the telemetry trace smoke, then the tier-1 test suite.
 #
 # Step 1 dogfoods the graphlint subsystem on every bundled model (the
 # acceptance gate: every model must lint with zero error-severity
@@ -11,15 +11,19 @@
 # Step 3 exercises the fused conv+BN autotune harness end-to-end in Pallas
 # interpret mode (timing scaffolding, fwd+bwd parity, WINS-table emission +
 # loadability — docs/PERF.md §6b) plus the backward gradient-parity sweep's
-# non-slow subset. Step 4 is the repo's tier-1 pytest command (ROADMAP.md).
+# non-slow subset. Step 4 runs a tiny fit loop under MXNET_TELEMETRY=trace,
+# dumps the chrome trace, and gates it with tools/mxtrace --check
+# (docs/OBSERVABILITY.md — the telemetry dump is a machine contract, so CI
+# smokes it end to end). Step 5 is the repo's tier-1 pytest command
+# (ROADMAP.md).
 set -uo pipefail
 cd "$(dirname "$0")/.."
 
-echo "== [1/4] graphlint: all bundled models =="
+echo "== [1/5] graphlint: all bundled models =="
 JAX_PLATFORMS=cpu python tools/graphlint --all-models --min-severity warning \
     || { echo "graphlint FAILED"; exit 1; }
 
-echo "== [2/4] source lint (ruff/pyflakes if available) =="
+echo "== [2/5] source lint (ruff/pyflakes if available) =="
 if command -v ruff >/dev/null 2>&1; then
     ruff check mxnet_tpu/ || { echo "ruff FAILED"; exit 1; }
 elif python -c 'import pyflakes' >/dev/null 2>&1; then
@@ -28,7 +32,7 @@ else
     echo "(neither ruff nor pyflakes installed; compile-check runs in pytest)"
 fi
 
-echo "== [3/4] fused conv+BN: interpret-mode autotune smoke + bwd parity subset =="
+echo "== [3/5] fused conv+BN: interpret-mode autotune smoke + bwd parity subset =="
 FUSED_TABLE="$(mktemp /tmp/fused_conv_bn_table_ci.XXXXXX.py)"
 JAX_PLATFORMS=cpu python tools/fused_stats_bench.py --interpret --emit-table \
     --table-out "$FUSED_TABLE" \
@@ -49,7 +53,48 @@ JAX_PLATFORMS=cpu python -m pytest tests/test_pallas_conv_bn_bwd.py -q \
     -m 'not slow' -p no:cacheprovider \
     || { echo "bwd parity subset FAILED"; exit 1; }
 
-echo "== [4/4] tier-1 tests =="
+echo "== [4/5] telemetry: trace-on fit smoke + mxtrace schema gate =="
+TRACE_DIR="$(mktemp -d /tmp/mxtrace_ci.XXXXXX)"
+JAX_PLATFORMS=cpu MXNET_DEFAULT_CONTEXT=cpu MXNET_TELEMETRY=trace \
+python - "$TRACE_DIR" <<'PYEOF' || { echo "telemetry fit smoke FAILED"; rm -rf "$TRACE_DIR"; exit 1; }
+import json, sys, os
+import numpy as np
+import mxnet_tpu as mx
+
+tmp = sys.argv[1]
+sym = mx.sym.Variable("data")
+sym = mx.sym.Convolution(sym, kernel=(3, 3), pad=(1, 1), num_filter=8,
+                         no_bias=True, name="conv1")
+sym = mx.sym.BatchNorm(sym, name="bn1")
+sym = mx.sym.Activation(sym, act_type="relu")
+sym = mx.sym.Flatten(sym)
+sym = mx.sym.FullyConnected(sym, num_hidden=4, name="fc")
+sym = mx.sym.SoftmaxOutput(sym, name="softmax")
+rs = np.random.RandomState(0)
+it = mx.io.NDArrayIter(rs.rand(12, 3, 8, 8).astype("float32"),
+                       rs.randint(0, 4, (12,)).astype("float32"),
+                       batch_size=4)
+mx.profiler.profiler_set_config(filename=os.path.join(tmp, "profile.json"))
+mx.profiler.profiler_set_state("run")
+mod = mx.mod.Module(sym, context=mx.cpu())
+mod.fit(it, num_epoch=1, kvstore=mx.kv.create("local"),
+        epoch_end_callback=mx.callback.do_checkpoint(os.path.join(tmp, "ck")))
+mx.nd.waitall()
+path = mx.profiler.dump_profile()
+trace = json.load(open(path))
+cats = {e.get("cat") for e in trace["traceEvents"] if e.get("ph") == "X"}
+need = {"engine", "executor", "fusion", "kvstore", "io"}
+assert need <= cats, "missing span families: %s" % (need - cats)
+c = trace["otherData"]["counters"]
+assert c.get("executor.compile", 0) >= 1 and c.get("executor.cache_hit", 0) >= 1, c
+assert len(trace["otherData"]["steps"]) == 3
+print("telemetry fit smoke OK: %s (%d events)" % (path, len(trace["traceEvents"])))
+PYEOF
+python tools/mxtrace "$TRACE_DIR/profile.json" --check \
+    || { echo "mxtrace --check FAILED"; rm -rf "$TRACE_DIR"; exit 1; }
+rm -rf "$TRACE_DIR"
+
+echo "== [5/5] tier-1 tests =="
 rm -f /tmp/_t1.log
 timeout -k 10 870 env JAX_PLATFORMS=cpu python -m pytest tests/ -q -m 'not slow' \
     --continue-on-collection-errors -p no:cacheprovider -p no:xdist -p no:randomly \
