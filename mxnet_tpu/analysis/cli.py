@@ -11,6 +11,8 @@ Examples::
     python tools/graphlint model-symbol.json --format json
     python tools/graphlint --all-models
     python tools/graphlint --list-codes
+    python tools/graphlint resnet-50 --shape data=32,3,224,224 \
+        --mesh dp=8,model=2 --budget-gb 16   # sharding-plan + HBM planner
 """
 from __future__ import annotations
 
@@ -42,10 +44,15 @@ DEFAULT_SHAPES = {
     "resnet-152": {"data": (1, 3, 224, 224)},
     "lstm": {"data": (32, 32), "softmax_label": (32, 32)},
     "transformer": {"data": (2, 64), "softmax_label": (2, 64)},
+    "transformer_mt": {"data": (2, 64), "dec_data": (2, 64),
+                       "softmax_label": (2, 64)},
+    "vgg16-ssd-300": {"data": (1, 3, 300, 300)},
+    "vgg16-ssd-300-train": {"data": (1, 3, 300, 300), "label": (1, 3, 5)},
 }
 DEFAULT_TYPES = {
     "lstm": {"data": "int32"},
     "transformer": {"data": "int32"},
+    "transformer_mt": {"data": "int32", "dec_data": "int32"},
 }
 
 
@@ -96,6 +103,52 @@ def _load_target(name, shapes, types, use_defaults):
     return name, sym, sh, ty
 
 
+def _format_plan(plan) -> str:
+    """Human block for one target's memory plan: the per-device byte table
+    plus the peak owner and its live set."""
+    from .shard_lint import fmt_bytes
+
+    pd = plan["per_device"]
+    mesh = plan["mesh"]
+    head = "-- predicted peak HBM per device: %s (%s, %s%s) --" % (
+        fmt_bytes(pd["peak"]),
+        "train/" + plan["policy"] if plan["train"] else "inference",
+        "mesh " + ",".join("%s=%d" % kv for kv in mesh.items())
+        if mesh else "single device",
+        ", budget %s" % fmt_bytes(plan["budget_bytes"])
+        if plan["budget_bytes"] else "")
+    lines = [head]
+    lines.append("   params %s | grads %s | opt %s | inputs %s | "
+                 "activations %s"
+                 % (fmt_bytes(pd["params"]), fmt_bytes(pd["grads"]),
+                    fmt_bytes(pd["opt_state"]), fmt_bytes(pd["inputs"]),
+                    fmt_bytes(pd["act_peak"])))
+    lines.append("   peak at %s (%s); largest live: %s"
+                 % (plan["peak_node"], plan["peak_phase"],
+                    ", ".join("%s=%s" % (n, fmt_bytes(b))
+                              for n, b in plan["peak_live"][:4]) or "-"))
+    return "\n".join(lines)
+
+
+def _format_peak_table(peaks) -> str:
+    """The --all-models summary: one peak-HBM row per target."""
+    from .shard_lint import fmt_bytes
+
+    rows = [("model", "peak/device", "params", "activations", "peak node")]
+    for label, plan in peaks:
+        if plan is None:
+            rows.append((label, "n/a (shapes underdetermined)", "-", "-", "-"))
+            continue
+        pd = plan["per_device"]
+        rows.append((label, fmt_bytes(pd["peak"]), fmt_bytes(pd["params"]),
+                     fmt_bytes(pd["act_peak"]), str(plan["peak_node"])))
+    widths = [max(len(r[i]) for r in rows) for i in range(len(rows[0]))]
+    out = ["== peak-HBM summary =="]
+    for r in rows:
+        out.append("  ".join(c.ljust(w) for c, w in zip(r, widths)).rstrip())
+    return "\n".join(out)
+
+
 def main(argv=None) -> int:
     ap = argparse.ArgumentParser(
         prog="graphlint",
@@ -115,6 +168,22 @@ def main(argv=None) -> int:
     ap.add_argument("--no-default-shapes", action="store_true",
                     help="lint structurally; skip the built-in per-model "
                          "default shape table")
+    ap.add_argument("--mesh", default=None, metavar="AXIS=N[,AXIS=N...]",
+                    help="abstract device mesh for the sharding-plan lint "
+                         "(GL4xx) and per-device memory planning, e.g. "
+                         "dp=8,model=2 — first axis is the batch axis, "
+                         "'model' (or the second axis) the tensor axis")
+    ap.add_argument("--budget-gb", type=float, default=None,
+                    help="peak-HBM budget per device in GiB, the unit the "
+                         "peak tables print (GL501); default: the "
+                         "MXNET_MEMLINT_BUDGET_GB env var")
+    ap.add_argument("--bwd", choices=("stash", "recompute"), default="stash",
+                    help="memory planner backward policy: stash every "
+                         "activation (default, the no-remat executor) or "
+                         "keep only MXU-op outputs (remat='dots')")
+    ap.add_argument("--inference", action="store_true",
+                    help="plan memory without grads/optimizer state "
+                         "(forward-only liveness)")
     ap.add_argument("--format", choices=("text", "json"), default="text")
     ap.add_argument("--min-severity", choices=("info", "warning", "error"),
                     default="info", help="suppress findings below this level "
@@ -147,6 +216,15 @@ def main(argv=None) -> int:
     except ValueError as exc:
         print("graphlint: %s" % exc, file=sys.stderr)
         return 2
+    mesh = None
+    if args.mesh:
+        from ..parallel.mesh import parse_mesh_spec
+
+        try:
+            mesh = parse_mesh_spec(args.mesh)
+        except ValueError as exc:
+            print("graphlint: %s" % exc, file=sys.stderr)
+            return 2
 
     from . import lint
 
@@ -154,6 +232,7 @@ def main(argv=None) -> int:
     failed = False
     load_failed = False
     json_out = []
+    peaks = []  # (target, plan) rows for the --all-models summary table
     for target in targets:
         try:
             label, sym, sh, ty = _load_target(
@@ -172,19 +251,26 @@ def main(argv=None) -> int:
             continue
         try:
             report = lint(sym, shapes=sh, types=ty, passes=passes,
-                          target=label)
+                          target=label, mesh=mesh,
+                          budget_gb=args.budget_gb, bwd=args.bwd,
+                          train=not args.inference)
         except ValueError as exc:  # unknown --passes selection
             print("graphlint: %s" % exc, file=sys.stderr)
             return 2
         if not report.ok(strict=args.strict):
             failed = True
+        peaks.append((label, report.memory_plan))
         if args.format == "json":
             json_out.append(json.loads(report.to_json()))
         else:
             print(report.format(min_severity=args.min_severity))
+            if report.memory_plan is not None:
+                print(_format_plan(report.memory_plan))
             print()
     if args.format == "json":
         print(json.dumps(json_out, indent=2))
+    elif len(peaks) > 1:
+        print(_format_peak_table(peaks))
     if load_failed:
         return 2
     return 1 if failed else 0

@@ -15,6 +15,8 @@ Codes are grouped by pass family:
   * ``GL1xx`` — engine race analysis (``engine_race.py``)
   * ``GL2xx`` — pjit retrace guard (``retrace_guard.py``)
   * ``GL3xx`` — fusion eligibility explainer (``fusion_explain.py``)
+  * ``GL4xx`` — sharding-plan lint (``shard_lint.py``)
+  * ``GL5xx`` — static memory-liveness / peak-HBM planner (``memory_plan.py``)
 """
 from __future__ import annotations
 
@@ -79,6 +81,22 @@ CODES = {
               "convolution rejected by the conv+BN fusion planner"),
     "GL302": (Severity.INFO,
               "BatchNorm not folded into its consumers by the fusion planner"),
+    # --- sharding-plan lint ------------------------------------------------
+    "GL401": (Severity.WARNING,
+              "parameter silently replicated: no dim divides the model axis"),
+    "GL402": (Severity.WARNING,
+              "implicit reshard edge: producer/consumer layouts disagree"),
+    "GL403": (Severity.WARNING,
+              "batch-axis loss: op collapses the data-sharded dim mid-graph"),
+    "GL404": (Severity.WARNING,
+              "uneven per-device shards: a sharded dim needs padding"),
+    "GL405": (Severity.INFO,
+              "large replicated parameter a sharding rule could shard"),
+    # --- memory planner ----------------------------------------------------
+    "GL501": (Severity.WARNING,
+              "predicted peak HBM per device exceeds the configured budget"),
+    "GL502": (Severity.WARNING,
+              "a single activation dominates the predicted memory peak"),
 }
 
 
@@ -140,11 +158,17 @@ class Diagnostic:
 
 
 class Report:
-    """An ordered collection of diagnostics from one lint run."""
+    """An ordered collection of diagnostics from one lint run.
+
+    ``memory_plan`` carries the GL5xx planner's non-diagnostic output (the
+    per-device byte table and peak ownership, ``memory_plan.MemoryPlan
+    .to_dict()``) when that pass ran with enough shape information — a clean
+    graph still has a peak worth printing."""
 
     def __init__(self, target: str = ""):
         self.target = target
         self.diagnostics: List[Diagnostic] = []
+        self.memory_plan: Optional[dict] = None
 
     def add(self, diag: Diagnostic):
         self.diagnostics.append(diag)
@@ -197,7 +221,10 @@ class Report:
         return "\n".join(lines)
 
     def to_json(self) -> str:
-        return json.dumps({
+        payload = {
             "target": self.target,
             "diagnostics": [d.to_dict() for d in self.diagnostics],
-        }, indent=2)
+        }
+        if self.memory_plan is not None:
+            payload["memory_plan"] = self.memory_plan
+        return json.dumps(payload, indent=2)

@@ -13,6 +13,7 @@ a recorded push trace, not a Symbol) — see ``engine_race.py``.
 """
 from __future__ import annotations
 
+import logging
 from typing import Callable, Dict, List, Optional, Tuple
 
 from .diagnostics import Diagnostic, Report
@@ -20,6 +21,7 @@ from .diagnostics import Diagnostic, Report
 __all__ = ["GraphContext", "graph_pass", "run_graph_passes", "list_passes"]
 
 _PASSES: List[Tuple[str, Callable]] = []
+_warned_budgets: set = set()
 
 
 def graph_pass(name: str):
@@ -53,10 +55,29 @@ class GraphContext:
       var_shape / var_dtype — variable name -> inferred shape/dtype
       blocked       — id(node) -> reason string for nodes whose inference
                       could not run (unknown inputs / upstream failure)
+
+    Distributed-plan state (sharding lint + memory planner):
+      mesh          — parallel.mesh.MeshSpec (or a real jax Mesh) or None;
+                      None skips the GL4xx pass and plans memory replicated
+      rules         — parallel.sharding.ShardingRules over that mesh (built
+                      via ShardingRules.infer_axes when not given)
+      budget_bytes  — peak-HBM budget (from MXNET_MEMLINT_BUDGET_GB or the
+                      caller); None disables GL501
+      bwd_policy    — 'stash' (save every activation for backward, the
+                      no-remat executor default) or 'recompute' (only
+                      MXU-op outputs survive the fwd→bwd transition — the
+                      remat='dots' accounting)
+      train         — account grads + optimizer state + backward liveness
+      entry_spec    — (id(node), out_idx) -> per-dim axis-name tuples,
+                      filled by shard_lint, read by memory_plan
+      memory_plan   — the planner's dict output (copied onto the Report)
     """
 
     def __init__(self, symbol, shape_hints=None, type_hints=None,
-                 strict_shapes: Optional[bool] = None):
+                 strict_shapes: Optional[bool] = None, mesh=None, rules=None,
+                 budget_bytes=None, bwd_policy="stash", train=True):
+        import os
+
         self.symbol = symbol
         self.topo = symbol._topo()
         self.shape_hints = dict(shape_hints or {})
@@ -77,6 +98,37 @@ class GraphContext:
         self.var_dtype: Dict[str, object] = {}
         self.blocked: Dict[int, str] = {}
         self.blocked_vars: Dict[int, set] = {}
+        # distributed-plan state (shard_lint / memory_plan)
+        if mesh is None and rules is not None:
+            # rules carry their mesh — passing only rules must not silently
+            # skip the GL4xx pass and plan memory replicated
+            mesh = getattr(rules, "mesh", None)
+        self.mesh = mesh
+        if rules is None and mesh is not None:
+            from ..parallel.sharding import ShardingRules
+
+            rules = ShardingRules.infer_axes(mesh)
+        self.rules = rules
+        if budget_bytes is None:
+            raw = os.environ.get("MXNET_MEMLINT_BUDGET_GB", "").strip()
+            if raw:
+                try:
+                    # binary GiB: the same unit every report line prints
+                    budget_bytes = float(raw) * 2 ** 30
+                except ValueError:
+                    if raw not in _warned_budgets:
+                        _warned_budgets.add(raw)
+                        logging.getLogger("mxnet_tpu.graphlint").warning(
+                            "MXNET_MEMLINT_BUDGET_GB=%r is not a number; "
+                            "no memory budget is enforced", raw)
+        self.budget_bytes = budget_bytes
+        if bwd_policy not in ("stash", "recompute"):
+            raise ValueError("bwd_policy must be 'stash' or 'recompute', "
+                             "got %r" % (bwd_policy,))
+        self.bwd_policy = bwd_policy
+        self.train = bool(train)
+        self.entry_spec: Dict[Tuple[int, int], tuple] = {}
+        self.memory_plan = None
 
     # ---------------------------------------------------------------- helpers
     def node_label(self, node) -> str:
@@ -111,7 +163,9 @@ class GraphContext:
 
 
 def run_graph_passes(symbol, shape_hints=None, type_hints=None,
-                     strict_shapes=None, passes=None, target="") -> Report:
+                     strict_shapes=None, passes=None, target="", mesh=None,
+                     rules=None, budget_bytes=None, bwd_policy="stash",
+                     train=True) -> Report:
     """Run every registered graph pass (or the named subset) over ``symbol``.
 
     A pass that itself crashes is reported as a GL001 on the pass, never
@@ -119,10 +173,13 @@ def run_graph_passes(symbol, shape_hints=None, type_hints=None,
     flakier than the thing it lints.
     """
     # passes live in sibling modules registered at import time
-    from . import shape_lint, retrace_guard, fusion_explain  # noqa: F401
+    from . import (shape_lint, retrace_guard, fusion_explain,  # noqa: F401
+                   shard_lint, memory_plan)  # noqa: F401
 
     ctx = GraphContext(symbol, shape_hints=shape_hints, type_hints=type_hints,
-                       strict_shapes=strict_shapes)
+                       strict_shapes=strict_shapes, mesh=mesh, rules=rules,
+                       budget_bytes=budget_bytes, bwd_policy=bwd_policy,
+                       train=train)
     report = Report(target=target)
     selected = set(passes) if passes is not None else None
     if selected is not None:
@@ -149,4 +206,5 @@ def run_graph_passes(symbol, shape_hints=None, type_hints=None,
                 pass_name=name,
                 fix_hint="report this as a graphlint bug; other passes ran",
             ))
+    report.memory_plan = ctx.memory_plan
     return report

@@ -502,11 +502,14 @@ def _normalize_grad_req(grad_req, arg_names):
     raise TypeError("grad_req must be str/list/dict")
 
 
-def _lint_at_bind(symbol, arg_arrays, arg_names, aux_arrays, aux_names):
+def _lint_at_bind(symbol, arg_arrays, arg_names, aux_arrays, aux_names,
+                  train=True):
     """MXNET_GRAPHLINT=warn|error hook: run the static passes with the
     concrete bind shapes/dtypes (analysis/: the nnvm-attribute-pass
     analogue). ``warn`` logs findings; ``error`` raises MXNetError with the
-    structured report instead of letting a broken graph reach jit tracing."""
+    structured report instead of letting a broken graph reach jit tracing.
+    ``train`` steers the GL5xx memory planner: a grad-less bind plans
+    forward-only liveness, a training bind adds grads + optimizer state."""
     from .analysis import graphlint_mode, lint_bind
 
     mode = graphlint_mode()
@@ -518,7 +521,7 @@ def _lint_at_bind(symbol, arg_arrays, arg_names, aux_arrays, aux_names):
              if a is not None}
     shapes.update({n: tuple(a.shape) for n, a in zip(aux_names, aux_arrays)})
     types.update({n: np.dtype(a.dtype) for n, a in zip(aux_names, aux_arrays)})
-    lint_bind(symbol, shapes, types, mode, target="bind")
+    lint_bind(symbol, shapes, types, mode, target="bind", train=train)
 
 
 def bind(symbol, ctx, args, args_grad=None, grad_req="write", aux_states=None, shared_exec=None, group2ctx=None):
@@ -571,7 +574,9 @@ def bind(symbol, ctx, args, args_grad=None, grad_req="write", aux_states=None, s
         if len(aux_arrays) != len(aux_names):
             raise MXNetError("bind: expected %d aux states, got %d" % (len(aux_names), len(aux_arrays)))
 
-    _lint_at_bind(symbol, arg_arrays, arg_names, aux_arrays, aux_names)
+    _lint_at_bind(symbol, arg_arrays, arg_names, aux_arrays, aux_names,
+                  train=any(r != "null" and g is not None
+                            for r, g in zip(reqs, grad_arrays)))
     return Executor(symbol, ctx, arg_arrays, grad_arrays, reqs, aux_arrays, program=prog)
 
 

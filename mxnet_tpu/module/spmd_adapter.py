@@ -53,6 +53,30 @@ class SPMDStepAdapter:
             self.trainer.adopt_state(shared.trainer)
         else:
             self.adopt_params(module._arg_params, module._aux_params)
+        self._lint_plan(module)
+
+    def _lint_plan(self, module):
+        """MXNET_GRAPHLINT hook on the fused-step bind path. Unlike the
+        single-device ``executor.bind`` lint, this one hands the passes the
+        REAL mesh and sharding rules, so the GL4xx sharding-plan lint and
+        the per-device GL5xx memory planner criticise the plan the trainer
+        is about to compile."""
+        from ..analysis import graphlint_mode, lint_bind
+
+        mode = graphlint_mode()
+        if mode is None:
+            return
+        shapes, types = {}, {}
+        for desc in list(module._data_shapes or []) + list(
+                module._label_shapes or []):
+            name, shape = desc[0], desc[1]
+            shapes[name] = tuple(shape)
+            dt = getattr(desc, "dtype", None)
+            if dt is not None:
+                types[name] = np.dtype(dt)
+        lint_bind(self.trainer.symbol, shapes, types, mode,
+                  target="spmd_bind", mesh=self.trainer.mesh,
+                  rules=self.trainer.rules, train=True)
 
     @property
     def params_dirty(self):

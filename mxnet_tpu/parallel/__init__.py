@@ -23,16 +23,19 @@ processes (after ``jax.distributed.initialize``) IS the dist_tpu_sync design —
 collectives ride ICI within a slice and DCN across slices; there is no
 server/scheduler role to run.
 """
-from .mesh import make_mesh, local_mesh
-from .sharding import ShardingRules, param_pspec
+from .mesh import make_mesh, local_mesh, MeshSpec, parse_mesh_spec
+from .sharding import ShardingRules, param_pspec, shardable_dims
 from .optim import make_functional_optimizer
 from .trainer import SPMDTrainer
 
 __all__ = [
     "make_mesh",
     "local_mesh",
+    "MeshSpec",
+    "parse_mesh_spec",
     "ShardingRules",
     "param_pspec",
+    "shardable_dims",
     "make_functional_optimizer",
     "SPMDTrainer",
 ]

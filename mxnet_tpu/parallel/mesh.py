@@ -13,7 +13,72 @@ import contextvars
 import numpy as np
 
 __all__ = ["make_mesh", "local_mesh", "trace_mesh", "current_trace_mesh",
-           "shard_map_compat"]
+           "shard_map_compat", "MeshSpec", "parse_mesh_spec"]
+
+
+class MeshSpec:
+    """Device-free mesh description: axis names and sizes, nothing else.
+
+    The static-analysis passes (analysis/shard_lint.py, memory_plan.py)
+    reason about a *planned* mesh — ``dp=8,model=2`` on a CPU dev box that
+    has no 16 devices to build a real ``jax.sharding.Mesh`` from. A
+    ``MeshSpec`` carries exactly the two attributes ``ShardingRules`` and
+    the lint passes read (``axis_names``, ``shape``), so the same rules
+    object drives both the real trainer mesh and the abstract plan."""
+
+    __slots__ = ("shape", "axis_names")
+
+    def __init__(self, axes):
+        """``axes``: dict name -> size (ordering is axis order), or an
+        iterable of (name, size) pairs."""
+        self.shape = {str(k): int(v) for k, v in dict(axes).items()}
+        if not self.shape:
+            raise ValueError("MeshSpec needs at least one axis")
+        for name, size in self.shape.items():
+            if size < 1:
+                raise ValueError("mesh axis %r has size %d" % (name, size))
+        self.axis_names = tuple(self.shape)
+
+    @property
+    def size(self):
+        return int(np.prod(list(self.shape.values())))
+
+    @classmethod
+    def of(cls, mesh):
+        """Coerce a real ``jax.sharding.Mesh`` (or another MeshSpec) to a
+        MeshSpec — the lint passes' common currency."""
+        if isinstance(mesh, cls):
+            return mesh
+        return cls({name: mesh.shape[name] for name in mesh.axis_names})
+
+    def __repr__(self):
+        return "MeshSpec(%s)" % ",".join(
+            "%s=%d" % (n, s) for n, s in self.shape.items())
+
+
+def parse_mesh_spec(spec):
+    """Parse ``"dp=8,model=2"`` (the graphlint ``--mesh`` syntax) into a
+    ``MeshSpec``. Also accepts a dict or an existing MeshSpec/Mesh."""
+    if isinstance(spec, str):
+        axes = {}
+        for part in spec.split(","):
+            part = part.strip()
+            if not part:
+                continue
+            if "=" not in part:
+                raise ValueError(
+                    "--mesh expects AXIS=SIZE[,AXIS=SIZE...], got %r" % spec)
+            name, size = part.split("=", 1)
+            name = name.strip()
+            if name in axes:
+                # a typo'd 'dp=2,dp=8' must not silently lint a wrong mesh
+                raise ValueError("mesh axis %r given twice in %r"
+                                 % (name, spec))
+            axes[name] = int(size)
+        return MeshSpec(axes)
+    if isinstance(spec, dict):
+        return MeshSpec(spec)
+    return MeshSpec.of(spec)
 
 
 def shard_map_compat(f, mesh, in_specs, out_specs, check=False):

@@ -4,32 +4,68 @@ The reference distributes work by *where tensors live* (ctx lists, group2ctx
 device placement, kvstore reduce targets). On TPU the equivalent decision is
 *how arrays are laid out over the mesh*; XLA then materialises the collectives.
 These rules are that translation table.
+
+The same rules drive two consumers: the SPMD trainer (which lays real arrays
+out on a real ``jax.sharding.Mesh``) and the static sharding-plan lint
+(``analysis/shard_lint.py``), which feeds an abstract ``MeshSpec`` through
+the identical code path so the plan it criticises is the plan the trainer
+would execute.
 """
 from __future__ import annotations
 
 from typing import Callable, Optional
 
-__all__ = ["ShardingRules", "param_pspec"]
+__all__ = ["ShardingRules", "param_pspec", "shardable_dims",
+           "MIN_SHARD_ELEMS"]
+
+# the shard-or-replicate boundary (inclusive: prod(shape) >= this shards).
+# One constant shared with analysis/shard_lint.py's GL401 threshold so the
+# lint and the rule can never drift apart.
+MIN_SHARD_ELEMS = 2 ** 16
 
 
-def param_pspec(name, shape, model_axis="model", model_size=1, min_shard_elems=2 ** 16):
+def shardable_dims(shape, model_size):
+    """Dims of a rank-2 parameter that divide evenly over ``model_size``,
+    largest first — the candidate order ``param_pspec`` tries. Conv filters
+    and other rank>2 params return () (replicated by policy: their FLOPs are
+    already parallel over the sharded batch)."""
+    if model_size <= 1 or len(shape) != 2:
+        return ()
+    # out-dim first (the classic Megatron column split); the remaining dims,
+    # largest first, are the divisibility fallback — "the second-largest
+    # shardable dim before giving up to full replication"
+    order = [0] + sorted(range(1, len(shape)), key=lambda d: -shape[d])
+    return tuple(d for d in order if shape[d] % model_size == 0)
+
+
+def param_pspec(name, shape, model_axis="model", model_size=1,
+                min_shard_elems=MIN_SHARD_ELEMS):
     """Default tensor-parallel rule for a parameter.
 
-    Shards the output dimension of large FC weights (``(out, in)``) and the
-    vocab dimension of large embeddings over the ``model`` axis when the dim
-    divides evenly; everything else (conv filters, biases, BN stats) is
-    replicated — conv FLOPs are already parallel over the sharded batch, and
-    small arrays cost more to shard than to replicate."""
+    Shards large rank-2 weights — FC ``(out, in)``, embedding ``(vocab,
+    dim)`` — over the ``model`` axis: the out/vocab dim when it divides
+    evenly, else (divisibility fallback) the other dim; only when neither
+    divides does it give up to full replication. Everything else — conv
+    filters (rank 4), biases, BN stats (rank 1) — is replicated: conv FLOPs
+    are already parallel over the sharded batch, and small arrays cost more
+    to shard than to replicate.
+
+    Boundary: arrays with ``prod(shape) >= min_shard_elems`` are shardable
+    (equality shards); strictly smaller arrays replicate.
+    """
     import numpy as np
     from jax.sharding import PartitionSpec as P
 
-    if model_size <= 1 or len(shape) < 2:
+    if model_size <= 1 or len(shape) != 2:
         return P()
     if int(np.prod(shape)) < min_shard_elems:
         return P()
-    if shape[0] % model_size == 0:
-        return P(model_axis, *([None] * (len(shape) - 1)))
-    return P()
+    dims = shardable_dims(shape, model_size)
+    if not dims:
+        return P()
+    spec = [None] * len(shape)
+    spec[dims[0]] = model_axis  # best candidate wins; the rest are fallback
+    return P(*spec)
 
 
 class ShardingRules:
@@ -37,7 +73,11 @@ class ShardingRules:
 
     ``data_axis``/``model_axis`` name mesh axes. ``param_rule(name, shape) ->
     PartitionSpec`` decides parameter layout (default: ``param_pspec``).
-    Data/label batches are sharded on dim 0 over the data axis."""
+    Data/label batches are sharded on dim 0 over the data axis.
+
+    ``mesh`` may be a real ``jax.sharding.Mesh`` or an abstract
+    ``parallel.mesh.MeshSpec`` — only ``axis_names``/``shape`` are read
+    until ``named()`` (which needs real devices)."""
 
     def __init__(self, mesh, data_axis="data", model_axis="model",
                  param_rule: Optional[Callable] = None, seq_axis=None):
@@ -49,6 +89,27 @@ class ShardingRules:
         # network seq-sharded and ring attention never gathers the sequence
         self.seq_axis = seq_axis if seq_axis in (mesh.axis_names or ()) else None
         self._param_rule = param_rule
+
+    @classmethod
+    def infer_axes(cls, mesh, param_rule=None):
+        """Rules for a mesh whose axes are not named data/model: the first
+        axis NOT literally named 'model' is the data (batch) axis, and the
+        model axis is the one named 'model' if present, else the second
+        remaining axis. This is the graphlint ``--mesh dp=8,model=2``
+        convention; a pure ``model=4`` mesh gets no data axis rather than a
+        silently inverted plan."""
+        names = tuple(mesh.axis_names)
+        if "data" in names:
+            data_axis = "data"
+        else:
+            data_axis = next((n for n in names if n != "model"), None)
+        if "model" in names and "model" != data_axis:
+            model_axis = "model"
+        else:
+            rest = [n for n in names if n != data_axis]
+            model_axis = rest[0] if rest else "__none__"
+        return cls(mesh, data_axis=data_axis or "__none__",
+                   model_axis=model_axis, param_rule=param_rule)
 
     @property
     def data_parallel_size(self):

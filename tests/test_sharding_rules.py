@@ -1,0 +1,141 @@
+"""ShardingRules / param_pspec edge cases (the sharding-plan lint's ground
+truth) and the MeshSpec abstraction the GL4xx passes lint against.
+
+param_pspec's contract (parallel/sharding.py): shard large rank-2 weights
+over the model axis — out dim first, the other dim as the divisibility
+fallback — and replicate everything else. The boundary is explicit:
+``prod(shape) >= min_shard_elems`` is shardable (equality shards).
+"""
+import numpy as np
+import pytest
+
+from mxnet_tpu.parallel import (MeshSpec, ShardingRules, param_pspec,
+                                parse_mesh_spec, shardable_dims)
+
+
+def _P(*args):
+    from jax.sharding import PartitionSpec as P
+
+    return P(*args)
+
+
+# --------------------------------------------------------------- param_pspec
+def test_shards_out_dim_when_divisible():
+    assert param_pspec("w", (1024, 784), model_size=2) == _P("model", None)
+
+
+def test_fallback_to_second_dim_when_out_dim_indivisible():
+    """The divisibility fallback: out dim 999 does not divide 2, but the
+    in dim 784 does — shard that instead of giving up to replication."""
+    assert param_pspec("w", (999, 784), model_size=2) == _P(None, "model")
+
+
+def test_full_replication_when_no_dim_divides():
+    assert param_pspec("w", (999, 783), model_size=2) == _P()
+
+
+def test_rank1_params_replicated():
+    # biases/BN stats: never sharded no matter how large
+    assert param_pspec("bias", (10 ** 7,), model_size=2) == _P()
+
+
+def test_conv_filters_stay_replicated():
+    """Rank-4 conv filters replicate by policy (their FLOPs are already
+    parallel over the sharded batch) even when dims divide evenly."""
+    assert param_pspec("conv_w", (2048, 512, 1, 1), model_size=2) == _P()
+    assert param_pspec("conv_w", (64, 64, 3, 3), model_size=2) == _P()
+
+
+def test_min_shard_elems_boundary_is_inclusive():
+    """prod == min_shard_elems SHARDS (>= semantics, stated and tested);
+    one element less replicates."""
+    assert int(np.prod((256, 256))) == 2 ** 16
+    assert param_pspec("w", (256, 256), model_size=2) == _P("model", None)
+    # (255, 256) = 65280 < 2**16: under the boundary -> replicated, even
+    # though dim 1 divides evenly
+    assert param_pspec("w", (255, 256), model_size=2) == _P()
+    # custom boundary: equality still shards
+    assert param_pspec("w", (16, 16), model_size=2,
+                       min_shard_elems=256) == _P("model", None)
+    assert param_pspec("w", (16, 16), model_size=2,
+                       min_shard_elems=257) == _P()
+
+
+def test_model_size_one_replicates():
+    assert param_pspec("w", (1024, 784), model_size=1) == _P()
+
+
+def test_shardable_dims_order():
+    # out dim first, then the fallback; indivisible dims drop out
+    assert shardable_dims((1024, 784), 2) == (0, 1)
+    assert shardable_dims((999, 784), 2) == (1,)
+    assert shardable_dims((999, 783), 2) == ()
+    assert shardable_dims((1024,), 2) == ()          # rank 1
+    assert shardable_dims((64, 64, 3, 3), 2) == ()   # conv filters
+    assert shardable_dims((1024, 784), 1) == ()      # no model axis
+
+
+# ------------------------------------------------------- MeshSpec + rules
+def test_parse_mesh_spec():
+    m = parse_mesh_spec("dp=8,model=2")
+    assert m.axis_names == ("dp", "model")
+    assert m.shape == {"dp": 8, "model": 2}
+    assert m.size == 16
+    assert parse_mesh_spec({"data": 4}).axis_names == ("data",)
+    with pytest.raises(ValueError):
+        parse_mesh_spec("dp8")
+    with pytest.raises(ValueError):
+        parse_mesh_spec("dp=0")
+    with pytest.raises(ValueError):
+        parse_mesh_spec("dp=2,dp=8")  # a typo must not silently dedupe
+    with pytest.raises(ValueError):
+        MeshSpec({})
+
+
+def test_meshspec_of_real_mesh():
+    import jax
+
+    from mxnet_tpu.parallel import make_mesh
+
+    n = min(2, len(jax.devices()))
+    mesh = make_mesh((n,), ("data",), jax.devices()[:n])
+    spec = MeshSpec.of(mesh)
+    assert spec.shape == {"data": n}
+    assert MeshSpec.of(spec) is spec
+
+
+def test_infer_axes_convention():
+    """graphlint --mesh convention: first axis = batch, 'model' (or the
+    second axis) = tensor axis."""
+    r = ShardingRules.infer_axes(parse_mesh_spec("dp=8,model=2"))
+    assert r.data_axis == "dp" and r.model_axis == "model"
+    assert r.data_parallel_size == 8 and r.model_parallel_size == 2
+    r2 = ShardingRules.infer_axes(parse_mesh_spec("x=4,y=2"))
+    assert r2.data_axis == "x" and r2.model_axis == "y"
+    r3 = ShardingRules.infer_axes(parse_mesh_spec("dp=8"))
+    assert r3.data_axis == "dp" and r3.model_axis is None
+    assert r3.model_parallel_size == 1
+    # an axis literally named 'model' is NEVER the batch axis, regardless
+    # of position — a model-first mesh must not invert the plan
+    r4 = ShardingRules.infer_axes(parse_mesh_spec("model=2,dp=8"))
+    assert r4.data_axis == "dp" and r4.model_axis == "model"
+    r5 = ShardingRules.infer_axes(parse_mesh_spec("model=4"))
+    assert r5.data_axis is None and r5.model_axis == "model"
+    assert r5.data_parallel_size == 1 and r5.model_parallel_size == 4
+
+
+def test_rules_on_meshspec_drive_specs_without_devices():
+    """ShardingRules over an abstract MeshSpec produce the same specs the
+    trainer would use on a real mesh — the lint's core premise."""
+    r = ShardingRules.infer_axes(parse_mesh_spec("dp=8,model=2"))
+    assert r.batch_spec((32, 3, 224, 224)) == _P("dp", None, None, None)
+    assert r.param_spec("fc_w", (1024, 784)) == _P("model", None)
+    assert r.param_spec("conv_w", (64, 3, 7, 7)) == _P()
+
+
+def test_default_rules_named_axes_unchanged():
+    """Regression: a real trainer mesh with data/model axes keeps the
+    historical defaults through the plain constructor."""
+    r = ShardingRules(parse_mesh_spec("data=4,model=2"))
+    assert r.data_axis == "data" and r.model_axis == "model"
+    assert r.param_spec("w", (1024, 784)) == _P("model", None)

@@ -19,9 +19,32 @@
 set -uo pipefail
 cd "$(dirname "$0")/.."
 
-echo "== [1/5] graphlint: all bundled models =="
+echo "== [1/5] graphlint: all bundled models (plain + sharding-plan sweep) =="
 JAX_PLATFORMS=cpu python tools/graphlint --all-models --min-severity warning \
     || { echo "graphlint FAILED"; exit 1; }
+# the same zoo under an abstract dp=8,model=2 mesh: the GL4xx sharding-plan
+# lint and GL5xx memory planner must run the whole sweep clean of errors AND
+# produce a finite peak-HBM estimate for every model (docs/static_analysis.md)
+MESH_SWEEP="$(mktemp /tmp/graphlint_mesh_ci.XXXXXX.json)"
+JAX_PLATFORMS=cpu python tools/graphlint --all-models --mesh dp=8,model=2 \
+    --format json > "$MESH_SWEEP" \
+    || { echo "graphlint mesh sweep FAILED"; rm -f "$MESH_SWEEP"; exit 1; }
+python - "$MESH_SWEEP" <<'PYEOF' || { echo "mesh sweep peak-HBM gate FAILED"; rm -f "$MESH_SWEEP"; exit 1; }
+import json, math, sys
+payload = json.load(open(sys.argv[1]))
+assert payload, "empty mesh sweep"
+bad = []
+for entry in payload:
+    plan = entry.get("memory_plan")
+    peak = plan and plan["per_device"]["peak"]
+    if not peak or not math.isfinite(peak) or peak <= 0:
+        bad.append(entry["target"])
+assert not bad, "models without a finite peak-HBM estimate: %s" % bad
+peaks = [e["memory_plan"]["per_device"]["peak"] / 2**30 for e in payload]
+print("mesh sweep OK: %d models, peak-HBM %.3f..%.3f GiB/device"
+      % (len(payload), min(peaks), max(peaks)))
+PYEOF
+rm -f "$MESH_SWEEP"
 
 echo "== [2/5] source lint (ruff/pyflakes if available) =="
 if command -v ruff >/dev/null 2>&1; then
