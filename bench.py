@@ -316,22 +316,28 @@ def _bench_lstm(on_tpu, models, parallel, dev):
 
 def _bench_allreduce():
     """KVStore allreduce bandwidth (the BASELINE.md metric): push+pull
-    round-trip through the dist KVStore's compiled collective, 8 worker
-    processes under tools/launch.py (measure.py --kvstore). With only one
-    local chip the workers run on CPU; on a multi-host slice the same
-    command measures ICI/DCN."""
+    round-trip through the dist KVStore's bucketed collective path
+    (docs/PERF.md §11), 8 worker processes under tools/launch.py
+    (measure.py --kvstore). The payload rides 16 keys pushed per-key with
+    priorities — the schedule a real training round emits — swept over
+    MXNET_KVSTORE_BUCKET_MB values; the headline is the best point and the
+    report carries the whole sweep plus the engine's overlap gauge. With
+    only one local chip the workers run on CPU; on a multi-host slice the
+    same command measures ICI/DCN."""
     root = os.path.dirname(os.path.abspath(__file__))
     import jax
 
     fabric = ("%s-8proc" % jax.devices()[0].platform
               if len(jax.devices()) > 1 else "cpu-8proc")
     env = dict(os.environ)
-    env.update({"JAX_PLATFORMS": "cpu", "MXNET_DEFAULT_CONTEXT": "cpu"})
+    env.update({"JAX_PLATFORMS": "cpu", "MXNET_DEFAULT_CONTEXT": "cpu",
+                "MXNET_TELEMETRY": "counters"})
     out = subprocess.run(
         [sys.executable, os.path.join(root, "tools", "launch.py"), "-n", "8",
          "--launcher", "local", sys.executable,
          os.path.join(root, "tools", "bandwidth", "measure.py"),
-         "--kvstore", "--sizes", "64", "--json"],
+         "--kvstore", "--sizes", "64", "--keys", "16", "--iters", "5",
+         "--bucket-mb-sweep", "4,16,25", "--json"],
         capture_output=True, text=True, timeout=600, env=env, cwd=root)
     recs = []
     dec = json.JSONDecoder()
@@ -353,6 +359,14 @@ def _bench_allreduce():
     rec = max(recs, key=lambda r: r["busbw_gbps"])
     res = {"gbps": rec["busbw_gbps"], "devices": rec["devices"],
            "fabric": fabric}
+    if "bucket_mb" in rec:
+        res["bucket_mb"] = rec["bucket_mb"]
+    if rec.get("overlap_ratio") is not None:
+        res["overlap_ratio"] = rec["overlap_ratio"]
+    sweep = {str(r["bucket_mb"]): r["busbw_gbps"] for r in recs
+             if "bucket_mb" in r}
+    if sweep:
+        res["bucket_sweep"] = sweep
     # second datapoint: the XLA device-mesh allreduce (shard_map psum over a
     # single-process mesh). On a real multi-chip slice this rides ICI; with
     # only one local device it runs on an 8-device virtual CPU mesh and is
@@ -468,6 +482,12 @@ def main():
     if "error" not in ar:
         result["allreduce_gbps"] = round(ar["gbps"], 3)
         result["allreduce_fabric"] = ar["fabric"]
+        if "bucket_mb" in ar:
+            result["allreduce_bucket_mb"] = ar["bucket_mb"]
+        if "bucket_sweep" in ar:
+            result["allreduce_bucket_sweep"] = ar["bucket_sweep"]
+        if "overlap_ratio" in ar:
+            result["allreduce_overlap_ratio"] = ar["overlap_ratio"]
         if ar["fabric"].startswith("cpu"):
             # interpretive guard: this number is host shared-memory loopback
             # through 8 local processes — it measures the kvstore code path,

@@ -70,6 +70,7 @@ def init(coordinator_address=None, num_processes=None, process_id=None):
                      else os.environ.get(ENV_WORKER_ID, "0"))
     import jax
 
+    _enable_cpu_collectives()
     try:
         jax.distributed.initialize(
             coordinator_address=coordinator_address,
@@ -90,6 +91,27 @@ def init(coordinator_address=None, num_processes=None, process_id=None):
     _start_heartbeat(process_id)
     logging.info("mxnet_tpu.dist: worker %d/%d connected to %s",
                  process_id, num_processes, coordinator_address)
+
+
+def _enable_cpu_collectives():
+    """Multi-process collectives on the CPU backend need jax's gloo
+    cross-process collectives implementation; without it every dist
+    collective dies with "Multiprocess computations aren't implemented on
+    the CPU backend". Selected here — before ``jax.distributed.initialize``
+    — when the job is pinned to CPU (tests, CI, tools/launch.py
+    --cpu-devices). No-op on TPU/GPU platforms and on jax builds without
+    the option."""
+    if not (os.environ.get("JAX_PLATFORMS", "") == "cpu"
+            or os.environ.get("MXNET_DEFAULT_CONTEXT", "") == "cpu"):
+        return
+    import jax
+
+    try:
+        if getattr(jax.config, "jax_cpu_collectives_implementation", None):
+            return  # the operator already chose an implementation
+        jax.config.update("jax_cpu_collectives_implementation", "gloo")
+    except Exception as e:  # old jax / no gloo build
+        logging.debug("mxnet_tpu.dist: cpu collectives unavailable: %s", e)
 
 
 def _start_heartbeat(process_id):

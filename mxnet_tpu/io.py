@@ -284,7 +284,7 @@ class PrefetchingIter(DataIter):
     _END = object()  # epoch-end sentinel
 
     def __init__(self, iters, rename_data=None, rename_label=None,
-                 prefetch_depth=2):
+                 prefetch_depth=2, shutdown_timeout=5.0):
         super().__init__()
         self.iters = iters if isinstance(iters, list) else [iters]
         assert self.iters
@@ -294,10 +294,12 @@ class PrefetchingIter(DataIter):
         self.batch_size = self.provide_data[0].shape[0]
         self.current_batch = None
         self._depth = max(1, int(prefetch_depth))
+        self._shutdown_timeout = float(shutdown_timeout)
         self._queues = None
         self._threads = []
         self._stop = None
         self._ended = False  # epoch exhausted; queues carry no more batches
+        self._wedged = None  # MXNetError once a pump failed to shut down
         self._start_epoch()
 
     # ------------------------------------------------------------ pump plumbing
@@ -335,6 +337,17 @@ class PrefetchingIter(DataIter):
             t.start()
 
     def _shutdown(self, strict=True):
+        """Stop the epoch's pumps with a BOUNDED join: one shared deadline
+        (``shutdown_timeout`` seconds total, not per thread) covers every
+        pump. A pump still alive past the deadline means its child iterator
+        is wedged in user code — resetting the child underneath it would be
+        a two-thread data race on the iterator's cursor, and silently
+        carrying the thread into the next epoch leaks it forever. So the
+        iterator latches a hard MXNetError: this reset raises it, and every
+        later next()/reset() re-raises until the owner rebuilds the
+        pipeline."""
+        import time as _time
+
         if self._stop is None:
             return
         self._stop.set()
@@ -346,18 +359,27 @@ class PrefetchingIter(DataIter):
                         break
                 except queue.Empty:
                     break
+        deadline = _time.monotonic() + self._shutdown_timeout
         stuck = []
         for t in self._threads:
-            t.join(timeout=5.0)
+            t.join(timeout=max(0.0, deadline - _time.monotonic()))
             if t.is_alive():
                 stuck.append(t)
         self._threads = []
-        if stuck and strict:
-            # resetting the child while a stale pump still holds it would be
-            # a two-thread data race on the iterator's cursor
-            raise MXNetError(
-                "PrefetchingIter: %d pump thread(s) still running after "
-                "shutdown — child iterator blocked >5s" % len(stuck))
+        if stuck:
+            self._wedged = MXNetError(
+                "PrefetchingIter: %d pump thread(s) [%s] still running %gs "
+                "after shutdown — a child iterator is blocked in user code; "
+                "this prefetcher is wedged and cannot be reused (rebuild the "
+                "data pipeline)" % (len(stuck),
+                                    ", ".join(t.name for t in stuck),
+                                    self._shutdown_timeout))
+            if strict:
+                raise self._wedged
+
+    def _check_wedged(self):
+        if self._wedged is not None:
+            raise self._wedged
 
     def __del__(self):
         try:
@@ -385,12 +407,14 @@ class PrefetchingIter(DataIter):
         return descs
 
     def reset(self):
+        self._check_wedged()
         self._shutdown()
         for it in self.iters:
             it.reset()
         self._start_epoch()
 
     def iter_next(self):
+        self._check_wedged()
         if self._ended:
             return False  # pumps are gone; blocking on the queues would hang
         if _tm.enabled():

@@ -48,6 +48,7 @@ class KVStore:
         self._store: Dict = {}
         self._updater: Optional[opt.Updater] = None
         self._optimizer = None
+        self._bucket_engine = None  # dist comm engine (kvstore_bucket)
 
     # ------------------------------------------------------------------ meta
     @property
@@ -101,15 +102,22 @@ class KVStore:
 
     def push(self, key, value, priority=0):
         """Reduce values per key; apply updater or replace
-        (reference: kvstore_local.h:50 Push). priority is accepted for API
-        parity — XLA's async dispatch orders work by data dependency, the job
-        the reference's priority queues did by hand.
+        (reference: kvstore_local.h:50 Push).
 
-        In dist mode, all keys of one call are batched into a single
-        compiled all-reduce (flatten-concat, the in-spirit analogue of the
-        reference's big-array sharding across servers,
-        kvstore_dist.h:275-313) — every worker must push the same keys in
-        the same order, which SPMD training does by construction."""
+        ``priority`` is REAL on the dist path (reference: kvstore.h Push's
+        priority queues): pushes land in their static bucket slot
+        (kvstore_bucket.BucketPlan, built from the first push round) and a
+        bucket's compiled collective dispatches — non-blocking, JAX async —
+        the moment its last slot fills, higher-priority buckets first when
+        several are ready. ``update_params_on_kvstore`` emits pushes in
+        reverse-topo order with ``priority=-index``, so last-layer gradients
+        fly while the host is still issuing the shallow layers' pushes and
+        ``pull`` blocks only on its own bucket (docs/PERF.md §11). Every
+        worker must push the same keys in the same order — SPMD training
+        does this by construction, and the engine hash-verifies it for the
+        first MXNET_KVSTORE_CHECK_STEPS rounds. On non-dist stores priority
+        remains advisory: XLA's async dispatch orders work by data
+        dependency."""
         keys, grouped = _group_kv(key, value)
         for k in keys:
             if k not in self._store:
@@ -122,8 +130,16 @@ class KVStore:
             _tm.counter("kvstore.push_calls").inc()
             _tm.counter("kvstore.push_bytes").inc(pushed)
             sp = _tm.span("kvstore.push", nkeys=len(keys), bytes=pushed,
-                          dist="dist" in self._type)
+                          dist="dist" in self._type, priority=priority)
         with sp:
+            eng = self._engine()
+            if eng is not None:
+                # bucketed path never mutates the merged value: skip the
+                # defensive copy (the pack executable does the copy+cast)
+                merged_list = [self._reduce_local(vals, copy=False)
+                               for vals in grouped]
+                eng.push(keys, merged_list, priority)
+                return
             merged_list = [self._reduce_local(vals) for vals in grouped]
             if "dist" in self._type:
                 merged_list = self._allreduce_batch(merged_list)
@@ -134,7 +150,9 @@ class KVStore:
                     self._store[k] = merged
 
     def pull(self, key, out=None, priority=0):
-        """Broadcast stored weight to outputs (reference: kvstore_local.h:75)."""
+        """Broadcast stored weight to outputs (reference: kvstore_local.h:75).
+        On the bucketed dist path this blocks only on the requested keys' own
+        buckets — other buckets' collectives stay in flight."""
         assert out is not None
         keys, grouped = _group_kv(key, out)
         for k in keys:
@@ -147,15 +165,41 @@ class KVStore:
             _tm.counter("kvstore.pull_bytes").inc(pulled)
             sp = _tm.span("kvstore.pull", nkeys=len(keys), bytes=pulled)
         with sp:
+            if self._bucket_engine is not None:
+                self._bucket_engine.before_read(keys)
             for k, outs in zip(keys, grouped):
                 local = self._store[k]
                 for o in outs:
                     o[:] = local
 
-    def _reduce_local(self, vals: List[NDArray]) -> NDArray:
-        """Reduce this process's device copies of one key."""
+    def _engine(self):
+        """Lazy bucket engine for multi-process dist stores
+        (MXNET_KVSTORE_BUCKET=0 opts back into the unbucketed batched
+        collective, for A/B measurement)."""
+        if self._bucket_engine is not None:
+            return self._bucket_engine
+        if "dist" not in self._type:
+            return None
+        import os
+
+        if os.environ.get("MXNET_KVSTORE_BUCKET", "1").lower() in (
+                "0", "off", "false"):
+            return None
+        import jax
+
+        if jax.process_count() == 1:
+            return None
+        from .kvstore_bucket import BucketEngine
+
+        self._bucket_engine = BucketEngine(self)
+        return self._bucket_engine
+
+    def _reduce_local(self, vals: List[NDArray], copy=True) -> NDArray:
+        """Reduce this process's device copies of one key. ``copy=False``
+        skips the defensive copy for consumers that only read the value
+        (the store must never alias a caller-mutable NDArray)."""
         if len(vals) == 1:
-            return vals[0].copy()
+            return vals[0].copy() if copy else vals[0]
         # tree-free single fused sum: one XLA add chain, fused on-device
         # (reference: comm.h ReduceSumCPU / CommDevice::Reduce)
         return nd.add_n(*vals, num_args=len(vals))
@@ -216,6 +260,9 @@ class KVStore:
         if "dist" in self._type:
             import jax
 
+            if self._bucket_engine is not None:
+                # drain in-flight bucket collectives before the sync point
+                self._bucket_engine.finalize_all()
             if jax.process_count() > 1:
                 from jax.experimental.multihost_utils import sync_global_devices
 
@@ -235,11 +282,23 @@ class KVStore:
 
     def save_optimizer_states(self, fname):
         assert self._updater is not None, "Cannot save states for distributed training"
+        if (self._bucket_engine is not None
+                and self._bucket_engine._sharded_state):
+            raise MXNetError(
+                "optimizer state lives in per-bucket 1/W shards under "
+                "MXNET_KVSTORE_UPDATE=sharded and cannot be pickled per key; "
+                "run with MXNET_KVSTORE_UPDATE=replicated to save states")
         with open(fname, "wb") as fout:
             fout.write(self._updater.get_states())
 
     def load_optimizer_states(self, fname):
         assert self._updater is not None, "Cannot load states for distributed training"
+        if (self._bucket_engine is not None
+                and self._bucket_engine._sharded_state):
+            raise MXNetError(
+                "cannot load per-key optimizer states into the sharded "
+                "update's per-bucket 1/W shards (MXNET_KVSTORE_UPDATE="
+                "sharded); run with MXNET_KVSTORE_UPDATE=replicated")
         with open(fname, "rb") as fin:
             self._updater.set_states(fin.read())
 
@@ -285,6 +344,7 @@ class _Collective:
             by_proc.setdefault(d.process_index, d)
         devs = [by_proc[p] for p in sorted(by_proc)]
         self.n_workers = len(devs)
+        self.rank = jax.process_index()
         self.my_device = by_proc[jax.process_index()]
         self.mesh = Mesh(np_.array(devs), ("worker",))
         self.row_sharding = NamedSharding(self.mesh, P("worker"))
@@ -292,26 +352,61 @@ class _Collective:
         # row-sharded input + replicated output: the partitioner lowers the
         # axis-0 sum to an all-reduce over the worker axis (measured faster
         # than an explicit shard_map psum on the gloo CPU backend, and
-        # equivalent on ICI)
-        @functools.partial(
-            jax.jit, out_shardings=NamedSharding(self.mesh, P()))
-        def _sum_rows(x):
-            return x.sum(axis=0)
+        # equivalent on ICI). Accumulation runs in ``acc_dtype`` (fp32 for
+        # bf16-compressed wire buffers, MXNET_KVSTORE_COMM_DTYPE) — one
+        # jitted callable per accumulate dtype, shape/dtype specialization
+        # is jit's own cache.
+        self._sum_rows_cache = {}
 
-        self._sum_rows = _sum_rows
+        def _make_sum(acc):
+            import jax.numpy as jnp
+
+            acc_dt = jnp.dtype(acc) if acc else None
+
+            @functools.partial(
+                jax.jit, out_shardings=NamedSharding(self.mesh, P()))
+            def _sum_rows(x):
+                if acc_dt is not None and x.dtype != acc_dt:
+                    x = x.astype(acc_dt)
+                return x.sum(axis=0)
+
+            return _sum_rows
+
+        self._make_sum = _make_sum
+        self._sum_rows = _make_sum(None)
+
+    def make_global_rows(self, row):
+        """Zero-copy (W, n) global array from this process's (1, n) row."""
+        import jax
+
+        return jax.make_array_from_single_device_arrays(
+            (self.n_workers,) + tuple(row.shape[1:]), self.row_sharding,
+            [row])
+
+    def allreduce_rows(self, row, acc_dtype=None):
+        """All-reduce this process's (1, n) row against its peers; returns
+        the summed (n-vector as a) fully-replicated global array — kept ON
+        DEVICE (callers slice lazily via ``.addressable_data(0)``)."""
+        key = str(acc_dtype) if acc_dtype is not None else None
+        fn = self._sum_rows_cache.get(key)
+        if fn is None:
+            fn = self._make_sum(key)
+            self._sum_rows_cache[key] = fn
+        return fn(self.make_global_rows(row))
 
     def allreduce_concat(self, flats):
         """All-reduce the concatenation of 1-D arrays; returns the summed
-        flat array (fully replicated jax array)."""
+        flat array as a single-device jax array, ON DEVICE — the earlier
+        ``jnp.asarray(...)`` here forced a host copy of the full replicated
+        result (device→host→device per round); callers slice straight from
+        the device buffer now."""
         import jax
         import jax.numpy as jnp
 
         flat = jnp.concatenate(flats) if len(flats) > 1 else flats[0]
         row = jax.device_put(flat.reshape(1, -1), self.my_device)
-        global_arr = jax.make_array_from_single_device_arrays(
-            (self.n_workers,) + tuple(row.shape[1:]), self.row_sharding, [row])
-        out = self._sum_rows(global_arr)
-        return jnp.asarray(out.addressable_data(0))
+        out = self._sum_rows(self.make_global_rows(row))
+        return out.addressable_data(0)
 
 
 def _key_value(key, value):

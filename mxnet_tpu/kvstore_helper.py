@@ -57,14 +57,23 @@ def initialize_kvstore(kvstore, param_arrays, arg_params, param_names, update_on
             kvstore.pull(idx, param_on_devs, priority=-idx)
 
 
-def update_params_on_kvstore(param_arrays, grad_arrays, kvstore):
+def update_params_on_kvstore(param_arrays, grad_arrays, kvstore,
+                             priorities=None):
     """(reference: model.py:88 _update_params_on_kvstore) — push grads (store
     reduces + runs the optimizer), pull fresh weights back to every device.
 
-    All keys go in ONE push and ONE pull: in dist mode the store batches the
-    whole round into a single compiled all-reduce (the reference instead
-    hand-ordered per-key transfers with priority=-index; the batched
-    collective makes that scheduling XLA's problem)."""
+    On bucketed dist stores (see ``_bucketed``), pushes go PER KEY in
+    reverse-topo order (deepest layer first — the order backward produced
+    the gradients) with
+    ``priority=-index``, the reference's hand-ordered per-key transfer
+    schedule: each push lands in its static bucket
+    (kvstore_bucket.BucketPlan) and a filled bucket's collective dispatches
+    asynchronously while the host is still issuing the shallower layers'
+    pushes. The pull then walks keys in FORWARD order, so layer 0's weights
+    — the ones the next forward needs first — finalize while the deep
+    buckets' collectives are still in flight (docs/PERF.md §11). Non-dist
+    stores keep the single batched round: with no inter-process collective
+    there is nothing to overlap."""
     keys, grads, args = [], [], []
     for index, (arg_list, grad_list) in enumerate(zip(param_arrays, grad_arrays)):
         if grad_list[0] is None:
@@ -74,20 +83,49 @@ def update_params_on_kvstore(param_arrays, grad_arrays, kvstore):
         args.append(arg_list)
     if not keys:
         return
+    if _bucketed(kvstore):
+        prio = dict(priorities or {})
+        for k, g in zip(reversed(keys), reversed(grads)):
+            kvstore.push(k, g, priority=prio.get(k, -k))
+        for k, a in zip(keys, args):
+            kvstore.pull(k, a, priority=prio.get(k, -k))
+        return
     kvstore.push(keys, grads)
     kvstore.pull(keys, args)
 
 
-def update_params(param_arrays, grad_arrays, updater, num_device, kvstore=None):
+def _bucketed(kvstore) -> bool:
+    """True when the store's bucket engine will absorb per-key pushes
+    (multi-process dist, MXNET_KVSTORE_BUCKET not disabled). Otherwise the
+    single batched round is strictly better — per-key pushes on the
+    unbucketed dist path would launch one collective per key, and on local
+    stores there is no collective to overlap at all."""
+    try:
+        return "dist" in kvstore.type and kvstore._engine() is not None
+    except Exception:
+        return False
+
+
+def update_params(param_arrays, grad_arrays, updater, num_device, kvstore=None,
+                  priorities=None):
     """(reference: model.py:99 _update_params) — optionally reduce via kvstore,
-    then run the updater per device copy."""
+    then run the updater per device copy. Dist stores get the same per-key
+    reverse-topo priority schedule as ``update_params_on_kvstore`` (here the
+    pulled value is the reduced gradient; the updater runs locally)."""
     live = [(i, a, g) for i, (a, g) in enumerate(zip(param_arrays, grad_arrays))
             if g[0] is not None]
     if kvstore and live:
-        # one batched reduce round for every key (dist: one collective)
         keys = [i for i, _, _ in live]
-        kvstore.push(keys, [g for _, _, g in live])
-        kvstore.pull(keys, [g for _, _, g in live])
+        if _bucketed(kvstore):
+            prio = dict(priorities or {})
+            for i, _, g in reversed(live):
+                kvstore.push(i, g, priority=prio.get(i, -i))
+            for i, _, g in live:
+                kvstore.pull(i, g, priority=prio.get(i, -i))
+        else:
+            # one batched reduce round for every key (no collective to overlap)
+            kvstore.push(keys, [g for _, _, g in live])
+            kvstore.pull(keys, [g for _, _, g in live])
     for index, arg_list, grad_list in live:
         for k, p, g in zip(range(len(arg_list)), arg_list, grad_list):
             # use a unique integer key per (param, device) for updater state

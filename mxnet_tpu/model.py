@@ -27,7 +27,21 @@ except ImportError:
     pass
 
 __all__ = ["save_checkpoint", "load_checkpoint", "find_last_checkpoint",
-           "resume_or_init", "FeedForward"]
+           "resume_or_init", "FeedForward",
+           "_create_kvstore", "_initialize_kvstore",
+           "_update_params_on_kvstore", "_update_params"]
+
+# Reference-parity aliases (python/mxnet/model.py:40-116 kept these private
+# helpers ON model; downstream training loops import them from here). The
+# implementations live in kvstore_helper — including the bucketed per-key
+# priority schedule _update_params[_on_kvstore] run on dist stores
+# (docs/PERF.md §11).
+from .kvstore_helper import (                                  # noqa: E402
+    create_kvstore as _create_kvstore,
+    initialize_kvstore as _initialize_kvstore,
+    update_params_on_kvstore as _update_params_on_kvstore,
+    update_params as _update_params,
+)
 
 
 # per-prefix engine variables: successive epoch writes to one prefix are

@@ -93,6 +93,14 @@ class DataParallelExecutorGroup:
             else:  # labels
                 self.grad_req[name] = "null"
 
+        # per-param comm priority for the dist KVStore's bucketed push/pull
+        # (reference: executor_group.py's priority=-index transfer schedule):
+        # derived from the symbol's topo order — shallower params (consumed
+        # earlier in forward) get higher priority, so their pulls complete
+        # first and the next forward can start while deep buckets are still
+        # in flight. Keyed by kvstore key (= param index).
+        self.param_priorities = self._topo_priorities(symbol)
+
         self.execs = []
         self._bind_execs(shared_group)
 
@@ -111,6 +119,19 @@ class DataParallelExecutorGroup:
             if inputs_need_grad
             else []
         )
+
+    def _topo_priorities(self, symbol):
+        """{param index: priority} from the symbol DAG's topological order
+        (reverse-topo emission is the caller's job; see
+        kvstore_helper.update_params_on_kvstore)."""
+        try:
+            topo_vars = [n.name for n in symbol._topo() if n.is_variable]
+        except Exception:  # foreign symbol object (tests): fall back to
+            topo_vars = []  # argument order, which is topo by construction
+        pos = {n: i for i, n in enumerate(topo_vars)}
+        ranked = sorted(range(len(self.param_names)),
+                        key=lambda i: pos.get(self.param_names[i], i))
+        return {idx: -rank for rank, idx in enumerate(ranked)}
 
     def _bind_execs(self, shared_group):
         name2shape = {}

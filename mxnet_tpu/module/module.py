@@ -394,7 +394,9 @@ class Module(BaseModule):
             from ..kvstore_helper import update_params_on_kvstore
 
             update_params_on_kvstore(
-                self._exec_group.param_arrays, self._exec_group.grad_arrays, self._kvstore
+                self._exec_group.param_arrays, self._exec_group.grad_arrays,
+                self._kvstore,
+                priorities=self._exec_group.param_priorities,
             )
         else:
             from ..kvstore_helper import update_params
@@ -405,6 +407,7 @@ class Module(BaseModule):
                 updater=self._updater,
                 num_device=len(self._context),
                 kvstore=self._kvstore,
+                priorities=self._exec_group.param_priorities,
             )
 
     def get_outputs(self, merge_multi_context=True):

@@ -92,6 +92,16 @@ class Optimizer:
     def update(self, index, weight, grad, state):
         raise NotImplementedError()
 
+    def flat_update_spec(self):
+        """Spec for the kvstore bucket engine's fused sharded weight update
+        (kvstore_bucket, docs/PERF.md §11): ``(kind, hyper, n_states)``
+        describing a jittable flat-1D update whose math is identical to this
+        optimizer's fused per-key op, or ``None`` when the optimizer has no
+        flat lowering (the engine then falls back to the replicated
+        update). ``hyper`` must be trace-time constants; per-key lr/wd
+        arrive at runtime as vectors."""
+        return None
+
     # ----------------------------------------------------------------- mults
     def set_lr_mult(self, args_lr_mult):
         """Per-param lr multipliers; symbol ``__lr_mult__`` attrs feed in too
@@ -179,10 +189,20 @@ class SGD(Optimizer):
         else:
             imperative_invoke("sgd_update", [weight, grad], attrs, out=[weight])
 
+    def flat_update_spec(self):
+        """Flat lowering of sgd_update / sgd_mom_update (ops/optimizer_ops)."""
+        return ("sgd", {"momentum": self.momentum,
+                        "rescale_grad": self.rescale_grad,
+                        "clip_gradient": self.clip_gradient or 0.0},
+                1 if self.momentum != 0.0 else 0)
+
 
 @register
 class NAG(SGD):
     """Nesterov accelerated SGD (reference: optimizer.py:380)."""
+
+    def flat_update_spec(self):
+        return None  # Nesterov math differs from the flat sgd kernel
 
     def update(self, index, weight, grad, state):
         self._update_count(index)
@@ -288,6 +308,15 @@ class Adam(Optimizer):
         attrs = self._common_attrs(lr, wd)
         attrs.update(beta1=self.beta1, beta2=self.beta2, epsilon=self.epsilon)
         imperative_invoke("adam_update", [weight, grad, mean, var], attrs, out=[weight, mean, var])
+
+    def flat_update_spec(self):
+        """Flat lowering of adam_update; the per-key bias-corrected lr is
+        folded host-side into the lr segment vector (same fold ``update``
+        does), so per-key step counts stay exact."""
+        return ("adam", {"beta1": self.beta1, "beta2": self.beta2,
+                         "epsilon": self.epsilon,
+                         "rescale_grad": self.rescale_grad,
+                         "clip_gradient": self.clip_gradient or 0.0}, 2)
 
 
 @register

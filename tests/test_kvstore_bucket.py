@@ -1,0 +1,337 @@
+"""Unit tests for the bucketed dist-KVStore comm path (docs/PERF.md §11).
+
+Single-process coverage of the pieces the 8-process smoke
+(tests/nightly/dist_kvstore_overlap.py) exercises end to end: bucket-plan
+construction/determinism, _group_kv edge cases, the flat optimizer kernels'
+parity with the fused per-key ops, the cross-worker key-hash mismatch
+error, per-param topo priorities, and the PrefetchingIter bounded-join
+satellite.
+"""
+import os
+import threading
+import time
+
+import numpy as np
+import pytest
+
+import mxnet_tpu as mx
+from mxnet_tpu.base import MXNetError
+from mxnet_tpu.kvstore import _group_kv
+from mxnet_tpu.kvstore_bucket import (BucketEngine, BucketPlan, bucket_bytes,
+                                      comm_dtype_for, update_mode,
+                                      _FLAT_KERNELS)
+
+
+# ---------------------------------------------------------------- _group_kv
+def test_group_kv_single_key_single_value():
+    keys, grouped = _group_kv("w", mx.nd.ones((2,)))
+    assert keys == ["w"] and len(grouped) == 1 and len(grouped[0]) == 1
+
+
+def test_group_kv_single_key_list_value():
+    """One key, a per-device LIST of values."""
+    vals = [mx.nd.ones((2,)), mx.nd.ones((2,))]
+    keys, grouped = _group_kv("w", vals)
+    assert keys == ["w"]
+    assert len(grouped) == 1 and len(grouped[0]) == 2
+
+
+def test_group_kv_parallel_lists():
+    keys, grouped = _group_kv([3, 5], [mx.nd.ones((2,)), mx.nd.zeros((2,))])
+    assert keys == [3, 5]
+    assert all(len(g) == 1 for g in grouped)
+
+
+def test_group_kv_nested_per_device_lists():
+    keys, grouped = _group_kv(
+        [3, 5], [[mx.nd.ones((2,))] * 3, [mx.nd.zeros((2,))] * 2])
+    assert keys == [3, 5]
+    assert [len(g) for g in grouped] == [3, 2]
+
+
+def test_group_kv_duplicate_keys():
+    """Duplicate keys stay separate groups in call order (the reference's
+    GroupKVPairs allowed repeated keys per call)."""
+    keys, grouped = _group_kv([7, 7], [mx.nd.ones((2,)), mx.nd.ones((2,))])
+    assert keys == [7, 7]
+    assert len(grouped) == 2
+
+
+# --------------------------------------------------------------- BucketPlan
+RECORDS = [("fc3_w", (4, 32), "float32", 0), ("fc3_b", (4,), "float32", 0),
+           ("fc2_w", (32, 64), "float32", -1), ("fc2_b", (32,), "float32", -1),
+           ("fc1_w", (64, 8), "float32", -2), ("fc1_b", (64,), "float32", -2)]
+
+
+def test_plan_deterministic():
+    a = BucketPlan.build(RECORDS, n_workers=8, bucket_cap=4096)
+    b = BucketPlan.build(list(RECORDS), n_workers=8, bucket_cap=4096)
+    assert a.hash == b.hash
+    assert a.describe() == b.describe()
+
+
+def test_plan_order_sensitivity():
+    """A different arrival order is a DIFFERENT plan (the cross-worker hash
+    check relies on this to catch order mismatches)."""
+    a = BucketPlan.build(RECORDS, n_workers=8, bucket_cap=4096)
+    b = BucketPlan.build(list(reversed(RECORDS)), n_workers=8,
+                         bucket_cap=4096)
+    assert a.hash != b.hash
+
+
+def test_plan_packing_and_padding():
+    plan = BucketPlan.build(RECORDS, n_workers=8, bucket_cap=4096)
+    # every (key, part) appears exactly once and every key is covered
+    seen = [(s.key, s.part) for b in plan.buckets for s in b.slots]
+    assert len(seen) == len(set(seen))
+    assert {k for k, _ in seen} == {r[0] for r in RECORDS}
+    for b in plan.buckets:
+        assert b.total % 8 == 0, "bucket not padded to the worker count"
+        used = sum(s.size for s in b.slots)
+        assert b.total - used == b.pad < 8
+        # offsets are contiguous and non-overlapping
+        off = 0
+        for s in b.slots:
+            assert s.offset == off
+            off += s.size
+
+
+def test_plan_respects_cap():
+    plan = BucketPlan.build(RECORDS, n_workers=2, bucket_cap=1024)
+    assert len(plan.buckets) > 1
+    for b in plan.buckets:
+        if len(b.slots) > 1:  # single-slot buckets may hold an oversize key
+            assert sum(s.size for s in b.slots) * 4 <= 1024
+
+
+def test_plan_splits_oversize_key():
+    """A key larger than the cap splits into cap-sized parts across
+    consecutive buckets (the reference's big-array sharding)."""
+    plan = BucketPlan.build([("big", (3000,), "float32", 0),
+                             ("tail", (10,), "float32", -1)],
+                            n_workers=2, bucket_cap=4096)  # cap = 1024 elems
+    parts = plan.key_to_slots["big"]
+    assert len(parts) == 3
+    assert [s.part for _, s in parts] == [0, 1, 2]
+    assert [s.src_off for _, s in parts] == [0, 1024, 2048]
+    assert sum(s.size for _, s in parts) == 3000
+    # the tail key shares the last part's bucket
+    tail_bucket = plan.key_to_slots["tail"][0][0]
+    assert tail_bucket.index == parts[-1][0].index
+
+
+def test_plan_groups_by_dtype():
+    plan = BucketPlan.build([("a", (8,), "float32", 0),
+                             ("b", (8,), "float64", 0),
+                             ("c", (8,), "float32", 0)],
+                            n_workers=2, bucket_cap=10**6)
+    dtypes = {b.dtype for b in plan.buckets}
+    assert dtypes == {"float32", "float64"}
+    for b in plan.buckets:
+        assert all(s.dtype == b.dtype for s in b.slots)
+
+
+# ------------------------------------------------------------------ env knobs
+def test_bucket_bytes_env(monkeypatch):
+    monkeypatch.setenv("MXNET_KVSTORE_BUCKET_MB", "4")
+    assert bucket_bytes() == 4_000_000
+    monkeypatch.setenv("MXNET_KVSTORE_BUCKET_MB", "not-a-number")
+    assert bucket_bytes() == 25_000_000  # warn + default
+    monkeypatch.delenv("MXNET_KVSTORE_BUCKET_MB")
+    assert bucket_bytes() == 25_000_000
+
+
+def test_update_mode_env(monkeypatch):
+    monkeypatch.setenv("MXNET_KVSTORE_UPDATE", "sharded")
+    assert update_mode() == "sharded"
+    monkeypatch.setenv("MXNET_KVSTORE_UPDATE", "bogus")
+    assert update_mode() == "replicated"
+    monkeypatch.delenv("MXNET_KVSTORE_UPDATE")
+    assert update_mode() == "replicated"
+
+
+def test_comm_dtype_env(monkeypatch):
+    monkeypatch.delenv("MXNET_KVSTORE_COMM_DTYPE", raising=False)
+    assert comm_dtype_for("float32") == "float32"
+    monkeypatch.setenv("MXNET_KVSTORE_COMM_DTYPE", "bf16")
+    assert comm_dtype_for("float32") == "bfloat16"
+    # only fp32 compresses; integer/f64 buckets ship as-is
+    assert comm_dtype_for("float64") == "float64"
+    assert comm_dtype_for("int32") == "int32"
+
+
+def test_bf16_plan_halves_comm_bytes(monkeypatch):
+    monkeypatch.setenv("MXNET_KVSTORE_COMM_DTYPE", "bf16")
+    plan = BucketPlan.build([("a", (1000,), "float32", 0)],
+                            n_workers=2, bucket_cap=10**6)
+    b = plan.buckets[0]
+    assert b.comm_dtype == "bfloat16" and b.dtype == "float32"
+
+
+# ------------------------------------------------------- flat kernel parity
+@pytest.mark.parametrize("momentum", [0.0, 0.9])
+def test_flat_sgd_matches_fused_op(momentum):
+    """The sharded update's flat SGD kernel must reproduce the fused
+    sgd[_mom]_update op the replicated path runs per key."""
+    import jax.numpy as jnp
+
+    rs = np.random.RandomState(3)
+    w0 = rs.rand(64).astype("float32")
+    g = (rs.rand(64).astype("float32") - 0.5)
+    lr, wd, rescale = 0.05, 1e-4, 1.0 / 16
+
+    opt = mx.optimizer.SGD(learning_rate=lr, momentum=momentum, wd=wd,
+                           rescale_grad=rescale, clip_gradient=0.4)
+    kind, hyper, n_states = opt.flat_update_spec()
+    assert kind == "sgd" and n_states == (1 if momentum else 0)
+    kernel = _FLAT_KERNELS[kind](hyper)
+
+    # reference path: the per-key fused op through the Updater
+    upd = mx.optimizer.get_updater(opt)
+    w_ref = mx.nd.array(w0.copy())
+    for _ in range(3):
+        upd(0, mx.nd.array(g), w_ref)
+
+    # flat path
+    w = jnp.asarray(w0)
+    states = (jnp.zeros(64, jnp.float32),) * n_states
+    lrv = jnp.full((64,), lr, jnp.float32)
+    wdv = jnp.full((64,), wd, jnp.float32)
+    for _ in range(3):
+        w, states = kernel(w, jnp.asarray(g), states, lrv, wdv)
+    np.testing.assert_allclose(np.asarray(w), w_ref.asnumpy(), atol=1e-6)
+
+
+def test_flat_adam_matches_fused_op():
+    import jax.numpy as jnp
+
+    rs = np.random.RandomState(4)
+    w0 = rs.rand(32).astype("float32")
+    g = (rs.rand(32).astype("float32") - 0.5)
+
+    opt = mx.optimizer.Adam(learning_rate=0.01, wd=1e-3, rescale_grad=0.125)
+    kind, hyper, n_states = opt.flat_update_spec()
+    assert kind == "adam" and n_states == 2
+    kernel = _FLAT_KERNELS[kind](hyper)
+
+    upd = mx.optimizer.get_updater(opt)
+    w_ref = mx.nd.array(w0.copy())
+    for _ in range(3):
+        upd(0, mx.nd.array(g), w_ref)
+
+    import math
+
+    w = jnp.asarray(w0)
+    states = (jnp.zeros(32, jnp.float32), jnp.zeros(32, jnp.float32))
+    wdv = jnp.full((32,), 1e-3, jnp.float32)
+    for t in range(1, 4):
+        # the engine folds the bias-corrected lr host-side, as Adam.update does
+        lr_t = 0.01 * math.sqrt(1 - 0.999 ** t) / (1 - 0.9 ** t)
+        lrv = jnp.full((32,), lr_t, jnp.float32)
+        w, states = kernel(w, jnp.asarray(g), states, lrv, wdv)
+    np.testing.assert_allclose(np.asarray(w), w_ref.asnumpy(), atol=1e-6)
+
+
+def test_flat_spec_absent_where_math_differs():
+    assert mx.optimizer.NAG(momentum=0.9).flat_update_spec() is None
+    assert mx.optimizer.RMSProp().flat_update_spec() is None
+    assert mx.optimizer.create("ccsgd").flat_update_spec() is not None
+
+
+# -------------------------------------------------- key-set mismatch raise
+def test_key_mismatch_raises(monkeypatch):
+    """Workers disagreeing on the pushed key set must fail loudly instead of
+    deadlocking/misreducing inside the collective (the allgathered digests
+    are faked to diverge)."""
+    import jax
+
+    eng = BucketEngine.__new__(BucketEngine)
+    eng._check_rounds = 3
+    monkeypatch.setattr(jax, "process_count", lambda: 2)
+    monkeypatch.setattr(BucketEngine, "_allgather_digest",
+                        staticmethod(lambda arr: np.array(
+                            [arr[0], arr[0] + 1], dtype=arr.dtype)))
+    with pytest.raises(MXNetError, match="disagree on the pushed key"):
+        eng._verify_across_workers("round:[('w1', (4,), 'float32')]")
+
+
+def test_key_match_passes(monkeypatch):
+    import jax
+
+    eng = BucketEngine.__new__(BucketEngine)
+    eng._check_rounds = 3
+    monkeypatch.setattr(jax, "process_count", lambda: 2)
+    monkeypatch.setattr(BucketEngine, "_allgather_digest",
+                        staticmethod(lambda arr: np.array(
+                            [arr[0], arr[0]], dtype=arr.dtype)))
+    eng._verify_across_workers("round:[('w1', (4,), 'float32')]")  # no raise
+
+
+# ---------------------------------------------------------- topo priorities
+def test_param_priorities_follow_topo_order():
+    sym = mx.sym.Variable("data")
+    sym = mx.sym.FullyConnected(sym, num_hidden=8, name="fc1")
+    sym = mx.sym.Activation(sym, act_type="relu")
+    sym = mx.sym.FullyConnected(sym, num_hidden=4, name="fc2")
+    sym = mx.sym.SoftmaxOutput(sym, name="softmax")
+    mod = mx.mod.Module(sym, context=mx.cpu(), fused_step=False)
+    mod.bind([("data", (2, 16))], [("softmax_label", (2,))])
+    prios = mod._exec_group.param_priorities
+    names = mod._exec_group.param_names
+    # one priority per param, a permutation of -{0..n-1}
+    assert sorted(prios) == list(range(len(names)))
+    assert sorted(prios.values()) == [-i for i in
+                                      reversed(range(len(names)))]
+    # fc1 params (consumed first in forward) outrank fc2's
+    by_name = {names[i]: p for i, p in prios.items()}
+    assert by_name["fc1_weight"] > by_name["fc2_weight"]
+
+
+# ------------------------------------------------ PrefetchingIter satellite
+class _BlockingIter(mx.io.DataIter):
+    """Child iterator whose next() wedges forever after the first batch."""
+
+    def __init__(self):
+        super().__init__(batch_size=2)
+        self.provide_data = [mx.io.DataDesc("data", (2, 2))]
+        self.provide_label = [mx.io.DataDesc("softmax_label", (2,))]
+        self._n = 0
+        self.release = threading.Event()
+
+    def next(self):
+        self._n += 1
+        if self._n > 1:
+            self.release.wait()  # wedge until the test releases us
+            raise StopIteration
+        return mx.io.DataBatch(data=[mx.nd.zeros((2, 2))],
+                               label=[mx.nd.zeros((2,))], pad=0, index=None)
+
+    def reset(self):
+        pass
+
+
+def test_prefetching_iter_wedged_pump_raises_and_latches():
+    child = _BlockingIter()
+    pf = mx.io.PrefetchingIter(child, shutdown_timeout=0.3)
+    assert pf.iter_next()  # first batch flows
+    time.sleep(0.05)       # let the pump enter the wedged next()
+    with pytest.raises(MXNetError, match="pump thread"):
+        pf.reset()
+    # the failure latches: the iterator refuses further use instead of
+    # racing the wedged thread
+    with pytest.raises(MXNetError, match="wedged"):
+        pf.iter_next()
+    with pytest.raises(MXNetError, match="wedged"):
+        pf.reset()
+    child.release.set()  # let the thread die so the test run stays clean
+
+
+def test_prefetching_iter_normal_epoch_cycle():
+    data = np.arange(24, dtype="float32").reshape(12, 2)
+    labels = np.zeros((12,), "float32")
+    pf = mx.io.PrefetchingIter(
+        mx.io.NDArrayIter(data, labels, batch_size=4))
+    for _ in range(2):  # two epochs: reset joins cleanly, nothing latches
+        n = sum(1 for _ in pf)
+        assert n == 3
+        pf.reset()

@@ -1,6 +1,7 @@
 #!/usr/bin/env bash
 # CI entrypoint: static analysis first, then the fused conv+BN machinery
-# smoke, then the telemetry trace smoke, then the tier-1 test suite.
+# smoke, then the telemetry trace smoke, then the 8-process kvstore
+# bucket/overlap smoke, then the tier-1 test suite.
 #
 # Step 1 dogfoods the graphlint subsystem on every bundled model (the
 # acceptance gate: every model must lint with zero error-severity
@@ -14,12 +15,15 @@
 # non-slow subset. Step 4 runs a tiny fit loop under MXNET_TELEMETRY=trace,
 # dumps the chrome trace, and gates it with tools/mxtrace --check
 # (docs/OBSERVABILITY.md — the telemetry dump is a machine contract, so CI
-# smokes it end to end). Step 5 is the repo's tier-1 pytest command
-# (ROADMAP.md).
+# smokes it end to end). Step 5 runs the 8-process CPU kvstore smoke
+# (tests/nightly/dist_kvstore_overlap.py): bucket-plan overlap counters
+# during a Module.fit, sharded-vs-replicated weight parity, and the
+# bucketed allreduce bandwidth floor (docs/PERF.md §11). Step 6 is the
+# repo's tier-1 pytest command (ROADMAP.md).
 set -uo pipefail
 cd "$(dirname "$0")/.."
 
-echo "== [1/5] graphlint: all bundled models (plain + sharding-plan sweep) =="
+echo "== [1/6] graphlint: all bundled models (plain + sharding-plan sweep) =="
 JAX_PLATFORMS=cpu python tools/graphlint --all-models --min-severity warning \
     || { echo "graphlint FAILED"; exit 1; }
 # the same zoo under an abstract dp=8,model=2 mesh: the GL4xx sharding-plan
@@ -46,7 +50,7 @@ print("mesh sweep OK: %d models, peak-HBM %.3f..%.3f GiB/device"
 PYEOF
 rm -f "$MESH_SWEEP"
 
-echo "== [2/5] source lint (ruff/pyflakes if available) =="
+echo "== [2/6] source lint (ruff/pyflakes if available) =="
 if command -v ruff >/dev/null 2>&1; then
     ruff check mxnet_tpu/ || { echo "ruff FAILED"; exit 1; }
 elif python -c 'import pyflakes' >/dev/null 2>&1; then
@@ -55,7 +59,7 @@ else
     echo "(neither ruff nor pyflakes installed; compile-check runs in pytest)"
 fi
 
-echo "== [3/5] fused conv+BN: interpret-mode autotune smoke + bwd parity subset =="
+echo "== [3/6] fused conv+BN: interpret-mode autotune smoke + bwd parity subset =="
 FUSED_TABLE="$(mktemp /tmp/fused_conv_bn_table_ci.XXXXXX.py)"
 JAX_PLATFORMS=cpu python tools/fused_stats_bench.py --interpret --emit-table \
     --table-out "$FUSED_TABLE" \
@@ -76,7 +80,7 @@ JAX_PLATFORMS=cpu python -m pytest tests/test_pallas_conv_bn_bwd.py -q \
     -m 'not slow' -p no:cacheprovider \
     || { echo "bwd parity subset FAILED"; exit 1; }
 
-echo "== [4/5] telemetry: trace-on fit smoke + mxtrace schema gate =="
+echo "== [4/6] telemetry: trace-on fit smoke + mxtrace schema gate =="
 TRACE_DIR="$(mktemp -d /tmp/mxtrace_ci.XXXXXX)"
 JAX_PLATFORMS=cpu MXNET_DEFAULT_CONTEXT=cpu MXNET_TELEMETRY=trace \
 python - "$TRACE_DIR" <<'PYEOF' || { echo "telemetry fit smoke FAILED"; rm -rf "$TRACE_DIR"; exit 1; }
@@ -117,7 +121,28 @@ python tools/mxtrace "$TRACE_DIR/profile.json" --check \
     || { echo "mxtrace --check FAILED"; rm -rf "$TRACE_DIR"; exit 1; }
 rm -rf "$TRACE_DIR"
 
-echo "== [5/5] tier-1 tests =="
+echo "== [5/6] kvstore: 8-process bucket/overlap smoke (docs/PERF.md §11) =="
+# functional leg: overlap counters fire during Module.fit on the per-key
+# priority path, and sharded-update weights bit-match replicated (atol 1e-6)
+JAX_PLATFORMS=cpu MXNET_DEFAULT_CONTEXT=cpu \
+python tools/launch.py -n 8 --launcher local \
+    python tests/nightly/dist_kvstore_overlap.py --skip-bandwidth \
+    || { echo "kvstore overlap/parity smoke FAILED"; exit 1; }
+# bandwidth leg (fresh processes, nothing else resident): the bucketed
+# push+pull round-trip must stay >= the r05 scoreboard number (0.056 GB/s).
+# One retry absorbs transient host load — the floor is a regression gate,
+# not a record attempt.
+BW_CMD=(python tools/launch.py -n 8 --launcher local
+        python tests/nightly/dist_kvstore_overlap.py --only-bandwidth
+        --size-mb 64 --iters 4 --min-gbps 0.056)
+JAX_PLATFORMS=cpu MXNET_DEFAULT_CONTEXT=cpu MXNET_KVSTORE_BUCKET_MB=16 \
+"${BW_CMD[@]}" || {
+    echo "kvstore bandwidth smoke below floor; retrying once...";
+    JAX_PLATFORMS=cpu MXNET_DEFAULT_CONTEXT=cpu MXNET_KVSTORE_BUCKET_MB=16 \
+    "${BW_CMD[@]}" || { echo "kvstore bandwidth smoke FAILED"; exit 1; }
+}
+
+echo "== [6/6] tier-1 tests =="
 rm -f /tmp/_t1.log
 timeout -k 10 870 env JAX_PLATFORMS=cpu python -m pytest tests/ -q -m 'not slow' \
     --continue-on-collection-errors -p no:cacheprovider -p no:xdist -p no:randomly \
