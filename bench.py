@@ -401,6 +401,48 @@ def _bench_allreduce():
     return res
 
 
+def _bench_serving():
+    """Serving leg (docs/SERVING.md): QPS + p99 under a fixed open-loop
+    load for lenet/mlp, continuous-batching-vs-batch-1 saturation speedup
+    on mlp, and the transformer KV-cache decode rate — the scoreboard's
+    serving trajectory next to the training numbers. Each model runs
+    tools/serve_bench.py in a fresh subprocess (its telemetry/counter
+    deltas must not bleed into this process)."""
+    root = os.path.dirname(os.path.abspath(__file__))
+    legs = {
+        "mlp": ["--model", "mlp", "--qps", "120", "--duration", "2",
+                "--compare-batch1"],
+        "lenet": ["--model", "lenet", "--qps", "40", "--duration", "2"],
+        "transformer_decode": ["--model", "transformer-decode", "--qps",
+                               "30", "--duration", "2", "--rows", "4"],
+    }
+    out = {}
+    for name, extra in legs.items():
+        try:
+            r = subprocess.run(
+                [sys.executable, os.path.join(root, "tools",
+                                              "serve_bench.py"),
+                 "--json"] + extra,
+                capture_output=True, text=True, timeout=300,
+                cwd=root)
+            rec = None
+            for l in r.stdout.splitlines():
+                if l.startswith("{"):
+                    rec = json.loads(l)
+            if rec is None:
+                raise RuntimeError("no JSON (rc=%d): %s"
+                                   % (r.returncode,
+                                      (r.stderr or r.stdout).strip()[-300:]))
+            keep = {k: rec.get(k) for k in
+                    ("qps", "p50_ms", "p99_ms", "batch_occupancy",
+                     "retraces_post_warmup", "batching_speedup")
+                    if rec.get(k) is not None}
+            out[name] = keep
+        except Exception as exc:
+            out[name] = {"error": "%s: %s" % (type(exc).__name__, exc)}
+    return out
+
+
 def main():
     degraded = False
     # nothing to probe when the platform is already pinned to CPU
@@ -433,6 +475,10 @@ def main():
         ar = _bench_allreduce()
     except Exception as exc:
         ar = {"error": "%s: %s" % (type(exc).__name__, exc)}
+    try:
+        serving = _bench_serving()
+    except Exception as exc:  # the serving leg must not sink the bench
+        serving = {"error": "%s: %s" % (type(exc).__name__, exc)}
 
     result = {
         "metric": "resnet50_train_throughput",
@@ -502,6 +548,7 @@ def main():
                 "device_mesh_fabric")
     else:
         result["allreduce_error"] = ar["error"]
+    result["serving"] = serving
     print(json.dumps(result))
 
 

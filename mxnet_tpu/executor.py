@@ -47,6 +47,7 @@ class _GraphProgram:
         # Pallas kernel stack; disabled under ctx-group placement (the fused
         # subgraph would straddle a device boundary) and by env kill-switch
         self._fusion_plan = {}
+        self._infer_fusion = False
         if fusion and not self.group2ctx and \
                 os.environ.get("MXNET_FUSED_CONV_BN", "auto") != "0":
             from . import fusion as _fusion
@@ -57,6 +58,13 @@ class _GraphProgram:
             # escape interpret() into the jit output pytree (Group symbols)
             self._fusion_plan = _fusion.plan(
                 self.topo, output_ids={id(n) for n, _ in symbol._outputs})
+            # grad-less/inference executions additionally need the plan
+            # declared ACTIVE for is_train=False (fusion.infer_default():
+            # forced env, on-device WINS match, or a quantized variant) —
+            # the default keeps CPU eval numerics byte-identical to the
+            # unfused op-by-op lowering
+            self._infer_fusion = bool(self._fusion_plan) \
+                and _fusion.infer_default()
         # PlaceDevice-pass analogue (reference: graph_executor.cc:242
         # AssignContext → nnvm PlaceDevice inserting _CrossDeviceCopy): map
         # each node carrying a __ctx_group__ attr to its concrete device;
@@ -137,7 +145,8 @@ class _GraphProgram:
         """Run the graph on jax values. Returns (outputs, new_aux_tuple)."""
         import jax
 
-        fusion_on = bool(self._fusion_plan) and is_train
+        fusion_on = bool(self._fusion_plan) \
+            and (is_train or self._infer_fusion)
         if fusion_on:
             from . import fusion as _fusion
 

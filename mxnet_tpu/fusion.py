@@ -38,12 +38,14 @@ import os
 import jax
 import jax.numpy as jnp
 
-from .ops.pallas_conv_bn import (_xla_conv, conv_block, plan_blocks,
-                                 plan_bwd_blocks, strided_dims, supported)
+from .ops.pallas_conv_bn import (_xla_conv, conv_block, conv_block_infer,
+                                 plan_blocks, plan_bwd_blocks, strided_dims,
+                                 supported)
 from . import telemetry as _tm
 
 __all__ = ["plan", "execute", "resolve", "gate", "gate_explain", "bwd_mode",
-           "conv_reject_reason", "bn_reject_reason"]
+           "conv_reject_reason", "bn_reject_reason", "infer_default",
+           "quant_mode"]
 
 
 # --------------------------------------------------------------------- values
@@ -315,12 +317,17 @@ def _table_device_matches():
 
 
 def gate_explain(kernel, stride, x_shape, w_shape, dtype, prologue,
-                 res=False):
+                 res=False, train=True):
     """The per-shape engage decision WITH the predicate that made it:
     ``(engaged, reason)``. Same predicate order as the reference planner's
     gate; ``gate`` is this plus telemetry counting. Keep each reason a
     single precise predicate — telemetry spans and fusion_explain (GL301)
-    report them verbatim."""
+    report them verbatim.
+
+    ``train=False`` is the inference predicate (grad-less bind): the same
+    shape/VMEM and WINS checks apply, but no backward budget exists — the
+    stash/bwd-policy machinery (``bwd_mode``) is never consulted, so a
+    shape only needs the FORWARD win to engage."""
     env = os.environ.get("MXNET_FUSED_CONV_BN", "auto")
     if env == "0":
         return False, "MXNET_FUSED_CONV_BN=0 (fusion disabled)"
@@ -341,21 +348,55 @@ def gate_explain(kernel, stride, x_shape, w_shape, dtype, prologue,
 
     if bool(WINS.get(_wins_key(kernel, stride, x_shape, w_shape, res),
                      False)):
-        return True, "WINS-table win for this shape"
+        return True, ("WINS-table win for this shape"
+                      if train else
+                      "WINS-table forward win for this shape (inference: "
+                      "no backward budget to clear)")
     return False, "no WINS-table win for this shape"
 
 
-def gate(kernel, stride, x_shape, w_shape, dtype, prologue, res=False):
+def gate(kernel, stride, x_shape, w_shape, dtype, prologue, res=False,
+         train=True):
     """Per-shape engage decision: env override, else the committed on-chip
     WINS table (device-matched, per measured VARIANT — 'p' prologue-only,
     'pr' prologue+residual; bare convs have no measured contract and never
-    engage in auto mode), else off. Untileable calls never engage."""
+    engage in auto mode), else off. Untileable calls never engage.
+    ``train=False`` counts into the ``fusion.infer_*`` telemetry family
+    instead of ``fusion.fwd_*``."""
     engaged, _ = gate_explain(kernel, stride, x_shape, w_shape, dtype,
-                              prologue, res=res)
+                              prologue, res=res, train=train)
     if _tm.enabled():
-        _tm.counter("fusion.fwd_engaged" if engaged
-                    else "fusion.fwd_fallback").inc()
+        if train:
+            _tm.counter("fusion.fwd_engaged" if engaged
+                        else "fusion.fwd_fallback").inc()
+        else:
+            _tm.counter("fusion.infer_engaged" if engaged
+                        else "fusion.infer_fallback").inc()
     return engaged
+
+
+def infer_default():
+    """Whether the fusion plan is ACTIVE on inference (grad-less /
+    ``is_train=False``) executions of a program. Distinct from the
+    per-shape ``gate`` decision: an active plan applies the structural
+    rewrites (BN prologue fold, moving-stat constant fold, quantized
+    weights) with the per-shape Pallas engage still decided by
+    ``gate(train=False)``; an inactive plan leaves inference on the plain
+    op-by-op lowering, byte-identical to the pre-serving behavior.
+
+    Active when fusion is forced (``MXNET_FUSED_CONV_BN=1``), when the
+    committed WINS table matches this device generation (on-chip serving),
+    or when a quantized inference variant is requested
+    (``MXNET_SERVE_QUANT`` — quantization is applied by the fused execute
+    path, so it needs the plan live even where Pallas declines)."""
+    env = os.environ.get("MXNET_FUSED_CONV_BN", "auto")
+    if env == "0":
+        return False
+    if env == "1":
+        return True
+    if quant_mode() != "off":
+        return True
+    return _table_device_matches()
 
 
 def _wins_key(kernel, stride, x_shape, w_shape, res):
@@ -444,25 +485,41 @@ def execute(directive, node, ins, aux, is_train):
     (possibly fusion markers); returns (outs_tuple_or_marker, new_aux)."""
     kind = directive["kind"]
     if kind == "bn":
-        return _exec_bn(directive, node, ins, aux)
+        return _exec_bn(directive, node, ins, aux, is_train)
     if kind == "relu_fold":
         v = ins[0]
         if isinstance(v, Deferred):
             return (v.with_relu(),), ()
         return (jnp.maximum(resolve(v), 0),), ()
     if kind == "conv":
-        return _exec_conv(directive, node, ins), ()
+        if not is_train:
+            return (_exec_conv_infer(directive, node, ins),), ()
+        return (_exec_conv(directive, node, ins),), ()
     if kind == "resadd":
-        return _exec_resadd(directive, ins), ()
+        return (_exec_resadd(directive, ins),), ()
     raise AssertionError(kind)
 
 
-def _exec_bn(directive, node, ins, aux):
+def _exec_bn(directive, node, ins, aux, is_train=True):
     data_v, gamma, beta = ins
     moving_mean, moving_var = aux
     a = node.parsed_attrs()
     eps, momentum = float(a["eps"]), float(a["momentum"])
     fix_gamma = bool(a["fix_gamma"])
+
+    if not is_train:
+        # inference: normalize with the MOVING stats — per-channel scale and
+        # shift are constants, so the fold costs nothing even mid-chain
+        x = data_v.c if isinstance(data_v, WithStats) else resolve(data_v)
+        istd = jax.lax.rsqrt(moving_var.astype(jnp.float32) + eps)
+        scale32 = istd if fix_gamma else gamma.astype(jnp.float32) * istd
+        shift32 = beta.astype(jnp.float32) \
+            - moving_mean.astype(jnp.float32) * scale32
+        if directive["fold"]:
+            out = Deferred(x, scale32, shift32, relu=False)
+        else:
+            out = _normalize(x, scale32, shift32)
+        return (out,), (moving_mean, moving_var)
 
     if isinstance(data_v, WithStats):
         x, ssum, ssq = data_v.c, data_v.ssum, data_v.ssq
@@ -627,6 +684,112 @@ def _exec_conv(directive, node, ins):
             _note_conv(node, x.shape, False, reason)
     xn = v.materialize() if isinstance(v, Deferred) else x
     return _xla_conv(xn, w, None, None, None, kernel, stride, False)
+
+
+# --------------------------------------------- inference (grad-less) variants
+_warned_quant_env = False
+
+
+def quant_mode():
+    """The requested quantized-inference variant: ``off`` | ``bf16`` |
+    ``int8`` (``MXNET_SERVE_QUANT``, docs/SERVING.md). Unrecognized values
+    warn once and stay off."""
+    env = os.environ.get("MXNET_SERVE_QUANT", "off").strip().lower()
+    if env in ("", "0", "off", "none", "fp32", "float32"):
+        return "off"
+    if env in ("bf16", "bfloat16"):
+        return "bf16"
+    if env == "int8":
+        return "int8"
+    global _warned_quant_env
+    if not _warned_quant_env:
+        _warned_quant_env = True
+        import logging
+
+        logging.getLogger("mxnet_tpu").warning(
+            "MXNET_SERVE_QUANT=%r not recognized (off|bf16|int8); "
+            "quantized inference stays off", env)
+    return "off"
+
+
+def _quant_conv_inputs(x, w, mode):
+    """The quantized-inference input transform for one conv site.
+
+    Deliberately traced INTO the compiled program: weights are executor
+    inputs (arg_dict), so hoisting the transform would mean freezing them
+    into the executable — a different ownership model the predict API's
+    param-update path contradicts. The steady-state cost is O(|w|)
+    (abs-max reduce + round) against the conv's O(|w|·B·H·W): under 1% at
+    serving batch shapes, and XLA fuses the bf16 casts into the conv's
+    operand reads.
+
+    - ``bf16``: activations AND weights compute in bfloat16 (the MXU fast
+      path; f32 accumulate comes from the conv's preferred_element_type).
+    - ``int8``: weight-only symmetric per-output-channel quantization —
+      weights snap to the 255-point int8 grid and dequantize through their
+      per-channel scale. Compute stays in the activation dtype, so this
+      measures the ACCURACY of int8 weights with fp32 math; an int8-MAC
+      kernel can adopt the same grid later without changing results
+      further.
+    """
+    if mode == "bf16":
+        return x.astype(jnp.bfloat16), w.astype(jnp.bfloat16)
+    if mode == "int8":
+        w32 = w.astype(jnp.float32)
+        s = jnp.max(jnp.abs(w32), axis=tuple(range(1, w.ndim)),
+                    keepdims=True) / 127.0
+        s = jnp.where(s > 0, s, 1.0)
+        wq = jnp.clip(jnp.round(w32 / s), -127, 127)
+        return x, (wq * s).astype(w.dtype)
+    return x, w
+
+
+def _exec_conv_infer(directive, node, ins):
+    """The grad-less execute path for a planned conv: moving-stat BN
+    prologue stays folded (``_exec_bn`` inference branch), weights ride the
+    quantized variant when requested, and ``gate(train=False)`` decides the
+    Pallas-vs-XLA lowering with no backward budget in the predicate.
+    Residual defers never engage here (the add runs as a plain elementwise
+    — at inference the deferral saves no statistics pass), so no
+    ``PendingConv`` marker is created."""
+    v, w = ins
+    kernel, stride = directive["kernel"], directive["stride"]
+    if isinstance(v, Deferred):
+        x, scale, shift, relu = v.raw, v.scale, v.shift, v.relu
+    else:
+        x, scale, shift, relu = resolve(v), None, None, False
+    quant = quant_mode()
+    x_c, w_c = _quant_conv_inputs(x, resolve(w), quant)
+    kind, _, _ = _mesh_kind()
+    if kind == _MESH_NONE:
+        engaged = gate(kernel, stride, x_c.shape, w_c.shape, x_c.dtype,
+                       scale is not None, res=False, train=False)
+        reason = None
+    else:
+        engaged, reason = False, ("multi-device mesh: inference fusion "
+                                  "runs single-device only")
+        if _tm.enabled():
+            _tm.counter("fusion.infer_fallback").inc()
+    if engaged:
+        _note_conv(node, x.shape, True,
+                   "engaged (inference%s)"
+                   % ("" if quant == "off" else ", quant=" + quant))
+        # stats-free kernel variant: at is_train=False every downstream BN
+        # folds its MOVING stats, so the training kernel's ssum/ssq
+        # epilogue would be dead outputs the opaque pallas_call still
+        # computes — return a plain tensor, not WithStats
+        c = conv_block_infer(x_c, w_c, scale, shift, kernel, stride, relu)
+        return c.astype(x.dtype)
+    if _tm.tracing():
+        if reason is None:
+            _, reason = gate_explain(kernel, stride, x_c.shape, w_c.shape,
+                                     x_c.dtype, scale is not None,
+                                     res=False, train=False)
+        _note_conv(node, x.shape, False, reason)
+    # XLA fallback keeps the prologue folded into the conv's elementwise
+    # preamble (no separate BN materialization) and the quantized weights
+    c = _xla_conv(x_c, w_c, scale, shift, None, kernel, stride, relu)
+    return c.astype(x.dtype)
 
 
 def _exec_resadd(directive, ins):

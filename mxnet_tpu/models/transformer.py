@@ -33,9 +33,13 @@ def _split_fused(fused, n_parts, seq_len, num_heads, dh):
     return outs
 
 
-def _attention_block(x, name, num_heads, model_dim, seq_len, causal=True):
+def _attention_block(x, name, num_heads, model_dim, seq_len, causal=True,
+                     return_kv=False):
     """Self-attention with ONE fused 3·M-wide qkv GEMM (better MXU shape
-    than three M-wide projections; used for every q==kv site)."""
+    than three M-wide projections; used for every q==kv site).
+    ``return_kv`` also hands back the head-major (B, H, T, dh) key/value
+    tensors — the serving prefill graph (get_prefill_symbol) exports them
+    to seed the decode path's ring KV buffer."""
     dh = model_dim // num_heads
     qkv = sym.FullyConnected(data=x, num_hidden=3 * model_dim, flatten=False,
                              name="%s_qkv" % name)
@@ -44,8 +48,11 @@ def _attention_block(x, name, num_heads, model_dim, seq_len, causal=True):
                                  name="%s_att" % name)
     att = sym.SwapAxis(att, dim1=1, dim2=2)  # (B,T,H,D)
     att = sym.Reshape(att, shape=(-1, seq_len, model_dim))
-    return sym.FullyConnected(data=att, num_hidden=model_dim, flatten=False,
+    proj = sym.FullyConnected(data=att, num_hidden=model_dim, flatten=False,
                               name="%s_proj" % name)
+    if return_kv:
+        return proj, k, v
+    return proj
 
 
 def _split_heads(x, seq_len, num_heads, dh):
@@ -141,6 +148,132 @@ def get_symbol_mt(vocab_size=32000, num_layers=6, num_heads=8, model_dim=512,
     logits = sym.FullyConnected(data=y, num_hidden=vocab_size, name="mt_head")
     label_flat = sym.Reshape(label, shape=(-1,))
     return sym.SoftmaxOutput(data=logits, label=label_flat, name="softmax")
+
+
+# --------------------------------------------------------------------- serving
+def get_prefill_symbol(vocab_size=32000, num_layers=6, num_heads=8,
+                       model_dim=512, ffn_dim=2048, prefill_len=64,
+                       pos_len=None, **kwargs):
+    """Serving prefill graph (docs/SERVING.md): the decoder-only LM of
+    ``get_symbol`` over a fixed ``prefill_len`` bucket, additionally
+    exporting every layer's head-major key/value tensors so the serving
+    path can seed the decode executable's ring KV buffer.
+
+    Weight names are IDENTICAL to ``get_symbol`` — a trained checkpoint
+    loads into either. ``pos_len`` is the trained position table's length
+    (defaults to ``prefill_len``); prompts are right-padded to
+    ``prefill_len`` by the caller, and causality guarantees pad tokens
+    cannot influence earlier positions.
+
+    Outputs: ``[logits (B·P, vocab), k_0, v_0, ..., k_{L-1}, v_{L-1}]``
+    with each k/v of shape (B, H, P, dh).
+    """
+    pos_len = pos_len or prefill_len
+    data = sym.Variable("data")  # (B, P) int tokens, right-padded
+    embed = sym.Embedding(data=data, input_dim=vocab_size,
+                          output_dim=model_dim, name="embed")
+    pos = sym.Variable("pos_embed_weight", shape=(pos_len, model_dim))
+    if prefill_len != pos_len:
+        pos = sym.slice_axis(pos, axis=0, begin=0, end=prefill_len)
+    x = sym.broadcast_add(
+        embed, sym.Reshape(pos, shape=(1, prefill_len, model_dim)),
+        name="pos_add")
+    kvs = []
+    for i in range(num_layers):
+        name = "layer%d" % i
+        a, k, v = _attention_block(
+            _layer_norm(x, "%s_ln1" % name, model_dim), name, num_heads,
+            model_dim, prefill_len, causal=True, return_kv=True)
+        kvs += [k, v]
+        x = x + a
+        x = x + _ffn(_layer_norm(x, "%s_ln2" % name, model_dim), name,
+                     model_dim, ffn_dim)
+    x = _layer_norm(x, "final_ln", model_dim)
+    logits = sym.FullyConnected(
+        data=sym.Reshape(x, shape=(-1, model_dim)), num_hidden=vocab_size,
+        name="lm_head")
+    return sym.Group([logits] + kvs)
+
+
+def get_decode_symbol(vocab_size=32000, num_layers=6, num_heads=8,
+                      model_dim=512, ffn_dim=2048, max_len=64, pos_len=None,
+                      **kwargs):
+    """Serving single-token decode graph (docs/SERVING.md): ONE token per
+    stream through the ``get_symbol`` stack, attending over a preallocated
+    ring KV buffer of ``max_len`` slots per layer. Compiles ONCE — every
+    decode step replays the same executable regardless of position.
+
+    Inputs beyond the weights:
+      - ``data`` (B, 1): the current token ids.
+      - ``pos_idx`` (B, 1): absolute positions (rows of the trained
+        position table, so ``pos < pos_len``).
+      - ``slot_onehot`` (max_len,): one-hot of the ring slot this token
+        writes (``pos % max_len``). The KV update is in-graph:
+        ``kv' = kv·(1-oh) + kv_new·oh`` — no per-step host scatter, no
+        per-slot recompile.
+      - ``kv_mask`` (max_len,): additive score mask — 0 on slots holding
+        real context (INCLUDING the current slot), a large negative on
+        empty slots.
+      - ``kv_k_i`` / ``kv_v_i`` (B, H, max_len, dh) per layer: the ring
+        buffers. The updated buffers are program OUTPUTS; the caller swaps
+        them back in as the next step's inputs (KVCacheDecoder does).
+
+    T=1 collapses attention to a masked weighted sum, so it is composed
+    from broadcast primitives (scores = Σ_d q·k, softmax, Σ_s p·v) instead
+    of the fused MultiHeadAttention op — same math, fp32-exact against the
+    full-sequence forward at matching positions.
+
+    Outputs: ``[logits (B, vocab), k'_0, v'_0, ..., k'_{L-1}, v'_{L-1}]``.
+    """
+    pos_len = pos_len or max_len
+    dh = model_dim // num_heads
+    scale = 1.0 / float(np.sqrt(dh))
+    data = sym.Variable("data")
+    pos_idx = sym.Variable("pos_idx")
+    oh = sym.Variable("slot_onehot")
+    msk = sym.Variable("kv_mask")
+    oh4 = sym.Reshape(oh, shape=(1, 1, max_len, 1))
+    keep4 = 1.0 - oh4
+    msk3 = sym.Reshape(msk, shape=(1, 1, max_len))
+    emb = sym.Embedding(data=data, input_dim=vocab_size,
+                        output_dim=model_dim, name="embed")
+    posrow = sym.Embedding(data=pos_idx, input_dim=pos_len,
+                           output_dim=model_dim, name="pos_embed")
+    x = emb + posrow  # (B, 1, M)
+    kv_outs = []
+    for i in range(num_layers):
+        name = "layer%d" % i
+        ln = _layer_norm(x, "%s_ln1" % name, model_dim)
+        qkv = sym.FullyConnected(data=ln, num_hidden=3 * model_dim,
+                                 flatten=False, name="%s_qkv" % name)
+        q, k_new, v_new = _split_fused(qkv, 3, 1, num_heads, dh)
+        kv_k = sym.Variable("kv_k_%d" % i)
+        kv_v = sym.Variable("kv_v_%d" % i)
+        k_upd = sym.broadcast_add(sym.broadcast_mul(kv_k, keep4),
+                                  sym.broadcast_mul(k_new, oh4),
+                                  name="%s_kupd" % name)
+        v_upd = sym.broadcast_add(sym.broadcast_mul(kv_v, keep4),
+                                  sym.broadcast_mul(v_new, oh4),
+                                  name="%s_vupd" % name)
+        kv_outs += [k_upd, v_upd]
+        scores = sym.sum(sym.broadcast_mul(q, k_upd), axis=3) * scale
+        scores = sym.broadcast_add(scores, msk3)  # (B, H, S)
+        p = sym.softmax(scores, axis=-1)
+        ctx = sym.sum(sym.broadcast_mul(sym.expand_dims(p, axis=3), v_upd),
+                      axis=2)  # (B, H, dh)
+        att = sym.Reshape(
+            sym.SwapAxis(sym.Reshape(ctx, shape=(-1, num_heads, 1, dh)),
+                         dim1=1, dim2=2),
+            shape=(-1, 1, model_dim))
+        x = x + sym.FullyConnected(data=att, num_hidden=model_dim,
+                                   flatten=False, name="%s_proj" % name)
+        x = x + _ffn(_layer_norm(x, "%s_ln2" % name, model_dim), name,
+                     model_dim, ffn_dim)
+    x = _layer_norm(x, "final_ln", model_dim)
+    logits = sym.FullyConnected(
+        data=sym.Reshape(x, shape=(-1, model_dim)), num_hidden=vocab_size,
+        name="lm_head")
+    return sym.Group([logits] + kv_outs)
 
 
 def get_symbol(vocab_size=32000, num_layers=6, num_heads=8, model_dim=512,

@@ -228,7 +228,7 @@ def _roll_cols(a, s, hw):
 
 
 def _kernel(*refs, b_steps, bn, hw, taps, shifts, relu, has_prologue,
-            has_res, emit_xn=False):
+            has_res, emit_xn=False, emit_stats=True):
     import jax.experimental.pallas as pl
 
     it = iter(refs)
@@ -239,17 +239,18 @@ def _kernel(*refs, b_steps, bn, hw, taps, shifts, relu, has_prologue,
     shift_ref = next(it) if has_prologue else None
     res_ref = next(it) if has_res else None
     c_ref = next(it)
-    sum_ref = next(it)
-    sq_ref = next(it)
+    sum_ref = next(it) if emit_stats else None
+    sq_ref = next(it) if emit_stats else None
     xn_ref = next(it) if emit_xn else None
-    acc_s, acc_q = it
+    acc_s, acc_q = it if emit_stats else (None, None)
 
     b = pl.program_id(1)
 
-    @pl.when(b == 0)
-    def _init():
-        acc_s[...] = jnp.zeros_like(acc_s)
-        acc_q[...] = jnp.zeros_like(acc_q)
+    if emit_stats:
+        @pl.when(b == 0)
+        def _init():
+            acc_s[...] = jnp.zeros_like(acc_s)
+            acc_q[...] = jnp.zeros_like(acc_q)
 
     xn = x_ref[0]  # (K, HW)
     if has_prologue:
@@ -274,26 +275,30 @@ def _kernel(*refs, b_steps, bn, hw, taps, shifts, relu, has_prologue,
     if has_res:
         c32 = c32 + res_ref[0].astype(jnp.float32)
     c_ref[0] = c32.astype(c_ref.dtype)
-    acc_s[...] += jnp.sum(c32, axis=1, keepdims=True)
-    acc_q[...] += jnp.sum(c32 * c32, axis=1, keepdims=True)
+    if emit_stats:
+        acc_s[...] += jnp.sum(c32, axis=1, keepdims=True)
+        acc_q[...] += jnp.sum(c32 * c32, axis=1, keepdims=True)
 
-    @pl.when(b == b_steps - 1)
-    def _flush():
-        sum_ref[...] = acc_s[...]
-        sq_ref[...] = acc_q[...]
+        @pl.when(b == b_steps - 1)
+        def _flush():
+            sum_ref[...] = acc_s[...]
+            sq_ref[...] = acc_q[...]
 
 
 @functools.partial(jax.jit, static_argnames=("kernel_hw", "stride", "relu",
-                                             "interpret", "emit_xn"))
+                                             "interpret", "emit_xn",
+                                             "emit_stats"))
 def _conv_block_fwd_impl(x, w, scale, shift, res, *, kernel_hw, stride,
-                         relu, interpret, emit_xn=False):
+                         relu, interpret, emit_xn=False, emit_stats=True):
     """Pallas forward. x (B,K,H,W); w (N,K,kh,kw); scale/shift (K,) or None;
     res (B,N,H',W') or None. Returns (c, ssum, ssq) plus the materialized
     prologue activation xn (post-stride shape) when ``emit_xn`` (the
-    backward stash policy)."""
+    backward stash policy). ``emit_stats=False`` (grad-less inference)
+    elides the statistics epilogue entirely and returns just ``c``."""
     import jax.experimental.pallas as pl
     from jax.experimental.pallas import tpu as pltpu
 
+    assert emit_stats or not emit_xn  # xn stash is a backward-only policy
     B, K, H, W = x.shape
     N = w.shape[0]
     kh, kw = kernel_hw
@@ -337,16 +342,16 @@ def _conv_block_fwd_impl(x, w, scale, shift, res, *, kernel_hw, stride,
     params = None if interpret else pltpu.CompilerParams(
         dimension_semantics=(pltpu.GridDimensionSemantics.PARALLEL,
                              pltpu.GridDimensionSemantics.ARBITRARY))
-    out_specs = [
-        pl.BlockSpec((1, bn, HW), lambda n, b: (b, n, 0)),
-        pl.BlockSpec((bn, 1), lambda n, b: (n, 0)),
-        pl.BlockSpec((bn, 1), lambda n, b: (n, 0)),
-    ]
-    out_shape = [
-        jax.ShapeDtypeStruct((B, N, HW), dt),
-        jax.ShapeDtypeStruct((N, 1), jnp.float32),
-        jax.ShapeDtypeStruct((N, 1), jnp.float32),
-    ]
+    out_specs = [pl.BlockSpec((1, bn, HW), lambda n, b: (b, n, 0))]
+    out_shape = [jax.ShapeDtypeStruct((B, N, HW), dt)]
+    scratch = []
+    if emit_stats:
+        out_specs += [pl.BlockSpec((bn, 1), lambda n, b: (n, 0)),
+                      pl.BlockSpec((bn, 1), lambda n, b: (n, 0))]
+        out_shape += [jax.ShapeDtypeStruct((N, 1), jnp.float32),
+                      jax.ShapeDtypeStruct((N, 1), jnp.float32)]
+        scratch = [pltpu.VMEM((bn, 1), jnp.float32),
+                   pltpu.VMEM((bn, 1), jnp.float32)]
     if emit_xn:
         out_specs.append(pl.BlockSpec((1, K, HW), lambda n, b: (b, 0, 0)))
         out_shape.append(jax.ShapeDtypeStruct((B, K, HW), dt))
@@ -354,16 +359,17 @@ def _conv_block_fwd_impl(x, w, scale, shift, res, *, kernel_hw, stride,
         functools.partial(
             _kernel, b_steps=B, bn=bn, hw=HW, taps=taps, shifts=shifts,
             relu=relu, has_prologue=has_prologue, has_res=res is not None,
-            emit_xn=emit_xn),
+            emit_xn=emit_xn, emit_stats=emit_stats),
         grid=(n_tiles, B),
         in_specs=in_specs,
         out_specs=out_specs,
         out_shape=out_shape,
-        scratch_shapes=[pltpu.VMEM((bn, 1), jnp.float32),
-                        pltpu.VMEM((bn, 1), jnp.float32)],
+        scratch_shapes=scratch,
         compiler_params=params,
         interpret=interpret,
     )(*inputs)
+    if not emit_stats:
+        return outs[0].reshape(B, N, H, W)
     c, s, q = outs[:3]
     if emit_xn:
         return (c.reshape(B, N, H, W), s[:, 0], q[:, 0],
@@ -432,6 +438,19 @@ def conv_block(x, w, scale, shift, res, kernel_hw=(1, 1), stride=(1, 1),
     c, s, q = _conv_block_fwd(x, w, scale, shift, res, kernel_hw, stride,
                               relu, use_pallas, bwd)[0]
     return c, s, q
+
+
+def conv_block_infer(x, w, scale, shift, kernel_hw=(1, 1), stride=(1, 1),
+                     relu=False):
+    """Grad-less inference forward: the same fused prologue+conv kernel
+    with the statistics epilogue elided (at ``is_train=False`` every
+    downstream BN normalizes with its moving stats, so ssum/ssq would be
+    dead outputs the opaque kernel still had to compute). Returns just
+    ``c``; NOT differentiable — serving/predict paths only."""
+    return _conv_block_fwd_impl(x, w, scale, shift, None,
+                                kernel_hw=kernel_hw, stride=stride,
+                                relu=relu, interpret=_interpret_mode(),
+                                emit_stats=False)
 
 
 def _interpret_mode():

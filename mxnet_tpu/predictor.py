@@ -3,9 +3,13 @@
 Counterpart of the reference's C predict API (include/mxnet/c_predict_api.h,
 src/c_api/c_predict_api.cc: MXPredCreate / MXPredSetInput / MXPredForward /
 MXPredGetOutput / MXPredReshape) — the surface its amalgamation/mobile builds
-ship. TPU-native: "create" compiles the whole inference graph into one XLA
-executable at bind time; reshape re-binds (recompiles once per new shape,
-then cached by XLA's compile cache).
+ship. TPU-native: executors come from the serving subsystem's
+``PersistentExecutableCache`` (docs/SERVING.md) — ONE compiled executable
+per input-shape set, created on first use and kept hot, so repeated
+``forward()`` at an identical shape is a guaranteed zero-recompile replay
+and ``reshape()`` back to a previously-seen shape reuses its executable
+instead of re-binding (the pre-serving behavior re-bound and re-traced on
+every reshape).
 
     pred = Predictor(open("m-symbol.json").read(), open("m-0010.params","rb").read(),
                      {"data": (1, 3, 224, 224)})
@@ -77,15 +81,25 @@ class Predictor:
 
         self._ctx = ctx or current_context()
         self._input_shapes = {k: tuple(v) for k, v in input_shapes.items()}
+        from .serving import PersistentExecutableCache
+
+        # unsealed: the predict API allows new shapes at any time, each
+        # compiled once; reshape() back to a seen shape is a cache hit.
+        # MXNET_SERVE_MAX_EXECUTABLES (default 8, 0=unbounded) LRU-bounds
+        # the retained executors so a reshape-heavy workload over many
+        # distinct shapes cannot grow device memory without limit.
+        from .serving.engine import _env_int
+
+        cap = _env_int("MXNET_SERVE_MAX_EXECUTABLES", 8)
+        self._cache = PersistentExecutableCache(
+            self._sym, self._arg_params, self._aux_params, ctx=self._ctx,
+            max_executables=cap)
         self._bind()
 
     def _bind(self):
-        arg_names = self._sym.list_arguments()
-        shapes = dict(self._input_shapes)
-        for k, v in self._arg_params.items():
-            if k in arg_names and k not in shapes:
-                shapes[k] = v.shape
-        self._exe = self._sym.simple_bind(self._ctx, grad_req="null", **shapes)
+        self._exe = self._cache.executable(dict(self._input_shapes))
+        # sync the CURRENT params (reshape may have harvested updates) into
+        # the possibly-reused executor
         for k, v in self._arg_params.items():
             if k in self._exe.arg_dict:
                 self._exe.arg_dict[k][:] = v
@@ -108,8 +122,9 @@ class Predictor:
         self._exe.forward(is_train=False)
 
     def reshape(self, new_input_shapes):
-        """(reference: MXPredReshape) — re-bind with new shapes; the old
-        executable stays in XLA's compile cache."""
+        """(reference: MXPredReshape) — switch to the executable for the
+        new shapes. A shape set seen before reuses its cached executable
+        with ZERO recompilation; a new one compiles once."""
         self._input_shapes.update({k: tuple(v) for k, v in new_input_shapes.items()})
         # preserve current (possibly updated) params
         for k in self._arg_params:

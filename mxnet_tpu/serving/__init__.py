@@ -1,0 +1,32 @@
+"""mxnet_tpu.serving: the production inference engine (docs/SERVING.md).
+
+The "millions of users" leg of the roadmap: the training side compiles one
+XLA executable per step and replays it; serving gets the same discipline.
+PyGraph's thesis (PAPERS.md) — per-call dispatch overhead disappears when
+the compiled graph is captured once and replayed — maps here onto a
+``PersistentExecutableCache``: one pre-compiled executable per
+(model, shape bucket, dtype), kept hot across requests, persisted per
+device kind, with any post-warmup recompile a HARD error diagnosed by the
+GL201-203 retrace guard. ``InferenceEngine`` feeds those executables from a
+thread-safe request queue with continuous batching over the buckets
+(pad-to-bucket, admit mid-flight until ``MXNET_SERVE_MAX_DELAY_MS``).
+``KVCacheDecoder`` is the autoregressive variant: a prefill-bucket
+executable plus a single-token decode executable over a preallocated ring
+KV buffer (models/transformer.py serving symbols).
+
+    cache = serving.PersistentExecutableCache(sym, arg_params, aux_params)
+    eng = serving.InferenceEngine(cache, buckets=(1, 2, 4, 8),
+                                  item_shapes={"data": (3, 28, 28)})
+    eng.start()
+    probs = eng.infer({"data": batch})          # blocking convenience
+    fut = eng.submit({"data": batch})           # or async
+    probs = fut.result(timeout=5.0)
+"""
+from __future__ import annotations
+
+from .cache import PersistentExecutableCache
+from .engine import InferenceEngine, ServeFuture
+from .kv_decode import KVCacheDecoder
+
+__all__ = ["PersistentExecutableCache", "InferenceEngine", "ServeFuture",
+           "KVCacheDecoder"]

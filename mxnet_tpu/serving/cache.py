@@ -1,0 +1,358 @@
+"""Persistent per-bucket executable cache (docs/SERVING.md).
+
+The serving analogue of the training side's one-executable-per-step
+discipline. Each (model, input-shape bucket, dtype) gets ONE grad-less
+executor, bound and compiled at warmup and kept hot for the life of the
+process — a request never pays bind/trace/compile. After ``seal()`` a
+lookup miss (a shape no warmed bucket covers — the request that WOULD have
+recompiled) is a hard ``MXNetError`` carrying the GL201-203 retrace-guard
+diagnosis, so a production server can never silently degrade into
+per-request compilation.
+
+Persistence (TVM's measure-and-cache discipline, PAPERS.md): the warmed
+bucket set is written as a JSON manifest under
+``{cache_dir}/{device_kind}/{model_key}.json`` so the next process warms
+the same buckets without being told, and JAX's persistent compilation
+cache is pointed at ``{cache_dir}/xla`` so the XLA *artifacts* themselves
+survive restarts on the same device kind (compile once per fleet rollout,
+not once per process).
+"""
+from __future__ import annotations
+
+import hashlib
+import json
+import logging
+import os
+import re
+import threading
+from collections import OrderedDict
+from typing import Dict, List, Optional, Sequence
+
+import numpy as np
+
+from ..base import MXNetError
+from .. import telemetry as _tm
+
+__all__ = ["PersistentExecutableCache", "serve_cache_dir"]
+
+log = logging.getLogger("mxnet_tpu.serving")
+
+_xla_cache_lock = threading.Lock()
+_xla_cache_dir = None
+
+
+def serve_cache_dir():
+    """The configured on-disk cache root (``MXNET_SERVE_CACHE_DIR``), or
+    None when persistence is off (the default)."""
+    d = os.environ.get("MXNET_SERVE_CACHE_DIR", "").strip()
+    return d or None
+
+
+def _enable_xla_persistence(root):
+    """Point JAX's persistent compilation cache at ``{root}/xla`` (once per
+    process — the setting is global). Best-effort: serving must work on jax
+    builds without the feature."""
+    global _xla_cache_dir
+    with _xla_cache_lock:
+        if _xla_cache_dir is not None:
+            return
+        import jax
+
+        target = os.path.join(root, "xla")
+        try:
+            os.makedirs(target, exist_ok=True)
+            jax.config.update("jax_compilation_cache_dir", target)
+            # serving executables are small; without this the default
+            # min-compile-time floor would skip persisting exactly them
+            try:
+                jax.config.update(
+                    "jax_persistent_cache_min_compile_time_secs", 0)
+            except Exception:
+                pass
+            _xla_cache_dir = target
+        except Exception as exc:
+            log.warning("serving: XLA persistent cache unavailable (%s); "
+                        "manifest-only persistence", exc)
+            _xla_cache_dir = ""
+
+
+def _device_kind():
+    import jax
+
+    try:
+        kind = jax.devices()[0].device_kind
+    except Exception:
+        kind = "unknown"
+    return re.sub(r"[^A-Za-z0-9_.-]+", "_", str(kind))
+
+
+def _shape_key(input_shapes):
+    return tuple(sorted((str(n), tuple(int(d) for d in s))
+                        for n, s in input_shapes.items()))
+
+
+class PersistentExecutableCache:
+    """One pre-compiled grad-less executor per input-shape bucket.
+
+    ``arg_params``/``aux_params`` are {name: NDArray-or-ndarray}; every
+    symbol argument that is not a param is an INPUT whose shape the bucket
+    key carries. ``model_key`` names the on-disk manifest (defaults to a
+    digest of the symbol JSON + dtype).
+    """
+
+    def __init__(self, symbol, arg_params=None, aux_params=None, ctx=None,
+                 dtype="float32", model_key=None, cache_dir=None,
+                 max_executables=None):
+        from ..context import current_context
+
+        self._sym = symbol
+        self._ctx = ctx or current_context()
+        self._dtype = str(dtype)
+        self._arg_params = dict(arg_params or {})
+        self._aux_params = dict(aux_params or {})
+        # ONE set of param/aux device arrays shared by every bucket
+        # executor (a per-bucket simple_bind would hold len(buckets) full
+        # weight copies); populated lazily by the first _bind
+        self._shared_args: Dict[str, object] = {}
+        self._shared_aux: Optional[Dict[str, object]] = None
+        # LRU bound for UNSEALED use (the predict API's open-ended reshape
+        # surface): past the cap the least-recently-used executor is
+        # dropped so distinct shapes can't grow device memory without
+        # bound. A sealed cache is fixed-size by construction and never
+        # evicts. None/0 = unbounded.
+        self._max_exes = int(max_executables or 0) or None
+        self._exes: "OrderedDict[tuple, object]" = OrderedDict()
+        self._lock = threading.RLock()
+        self._sealed = False
+        digest = hashlib.sha1(
+            (symbol.tojson() + "|" + self._dtype).encode()).hexdigest()[:16]
+        self._model_key = re.sub(r"[^A-Za-z0-9_.-]+", "_",
+                                 model_key or digest)
+        self._digest = digest
+        self._cache_dir = cache_dir if cache_dir is not None \
+            else serve_cache_dir()
+        if self._cache_dir:
+            _enable_xla_persistence(self._cache_dir)
+
+    # ------------------------------------------------------------- binding
+    @property
+    def input_names(self) -> List[str]:
+        params = set(self._arg_params)
+        return [n for n in self._sym.list_arguments() if n not in params]
+
+    @property
+    def sealed(self):
+        return self._sealed
+
+    def keys(self):
+        with self._lock:
+            return list(self._exes)
+
+    def _infer_full(self, input_shapes):
+        """Full static shape/type inference at these input shapes (the
+        param/aux hints come from the checkpoint) — no bind, no compile."""
+        from ..base import np_dtype
+
+        shapes = {n: tuple(s) for n, s in input_shapes.items()}
+        types = {}
+        arg_names = set(self._sym.list_arguments())
+        for n, v in self._arg_params.items():
+            if n not in arg_names:
+                continue  # extra checkpoint entries are ignored, as in
+                # the predict API's allow_extra_params behavior
+            shapes.setdefault(n, tuple(v.shape))
+            types[n] = np.dtype(getattr(v, "dtype", self._dtype)).name
+        for n in shapes:
+            types.setdefault(n, self._dtype)
+        return self._sym._infer_impl(
+            shapes, {k: np_dtype(v) for k, v in types.items()},
+            partial=False)
+
+    def output_shapes(self, input_shapes) -> List[tuple]:
+        """Statically inferred output shapes at these input shapes.
+        Pure inference: safe to probe batch sizes that are not buckets."""
+        return [tuple(s) for s in self._infer_full(input_shapes)[1]]
+
+    def _bind(self, input_shapes):
+        from ..ndarray import zeros
+
+        arg_name_list = self._sym.list_arguments()
+        res = self._infer_full(input_shapes)
+        arg_shapes, _, aux_shapes, arg_types, _, aux_types = res
+        inputs = set(self.input_names)
+        args = {}
+        for n, s, t in zip(arg_name_list, arg_shapes, arg_types):
+            if n in inputs:
+                # input slots are per-bucket: their shape IS the cache key
+                args[n] = zeros(s, ctx=self._ctx, dtype=t)
+                continue
+            arr = self._shared_args.get(n)
+            if arr is None:
+                arr = zeros(s, ctx=self._ctx, dtype=t)
+                if n in self._arg_params:
+                    arr[:] = self._arg_params[n]
+                self._shared_args[n] = arr
+            args[n] = arr
+        if self._shared_aux is None:
+            self._shared_aux = {}
+            for n, s, t in zip(self._sym.list_auxiliary_states(),
+                               aux_shapes, aux_types):
+                arr = zeros(s, ctx=self._ctx, dtype=t)
+                if n in self._aux_params:
+                    arr[:] = self._aux_params[n]
+                self._shared_aux[n] = arr
+        # each bucket gets its OWN graph program (no shared_exec): sharing
+        # the jit entry would classify buckets 2..N's warmup compiles as
+        # retraces in telemetry, polluting the zero-retrace contract
+        return self._sym.bind(self._ctx, args, args_grad=None,
+                              grad_req="null",
+                              aux_states=dict(self._shared_aux))
+
+    def _retrace_diagnosis(self):
+        try:
+            from ..analysis import lint
+
+            rep = lint(self._sym, passes=["retrace_guard"])
+            return "; ".join("%s: %s" % (d.code, d.message) for d in rep) \
+                or ("no GL201-203 pattern in the graph: the shape change "
+                    "came from the caller (an unwarmed bucket)")
+        except Exception as exc:  # diagnosis must never mask the miss
+            return "retrace-guard diagnosis failed: %s" % exc
+
+    def executable(self, input_shapes):
+        """Get (or, before ``seal()``, bind+compile) the executor for this
+        exact input-shape bucket. A post-seal miss is a hard error: it is
+        precisely the call that would have retraced."""
+        key = _shape_key(input_shapes)
+        exe = self._exes.get(key)
+        if exe is not None:
+            if self._max_exes and not self._sealed:
+                with self._lock:  # LRU recency only matters when evicting
+                    if key in self._exes:
+                        self._exes.move_to_end(key)
+            if _tm.enabled():
+                _tm.counter("serving.executable_hit").inc()
+            return exe
+        with self._lock:
+            exe = self._exes.get(key)
+            if exe is not None:
+                if _tm.enabled():
+                    _tm.counter("serving.executable_hit").inc()
+                return exe
+            if self._sealed:
+                raise MXNetError(
+                    "serving: post-warmup executable-cache miss for input "
+                    "shapes %s (warmed buckets: %s). A miss here would "
+                    "retrace+recompile on the request path; retrace-guard "
+                    "diagnosis: %s"
+                    % (dict(input_shapes),
+                       [dict(k) for k in self._exes],
+                       self._retrace_diagnosis()))
+            with _tm.span("serving.compile", model=self._model_key,
+                          shapes=str(dict(input_shapes))):
+                exe = self._bind(input_shapes)
+                # force the XLA compile NOW (bind only traces lazily):
+                # warmup pays it, the request path never does
+                exe.forward(is_train=False)
+                np.asarray(exe.outputs[0].asnumpy())
+            if _tm.enabled():
+                _tm.counter("serving.executable_compile").inc()
+            self._exes[key] = exe
+            if self._max_exes and not self._sealed \
+                    and len(self._exes) > self._max_exes:
+                old_key, _ = self._exes.popitem(last=False)
+                log.info("serving: evicted LRU executable %s from %r "
+                         "(cap %d)", dict(old_key), self._model_key,
+                         self._max_exes)
+                if _tm.enabled():
+                    _tm.counter("serving.executable_evict").inc()
+            if _tm.enabled():
+                # after any eviction, so the gauge is the true live count
+                _tm.gauge("serving.executables").set(len(self._exes))
+            return exe
+
+    # -------------------------------------------------------------- warmup
+    def warmup(self, bucket_shapes: Optional[Sequence[dict]] = None,
+               seal=True):
+        """Pre-compile one executable per bucket. ``bucket_shapes`` is a
+        list of {input_name: shape} dicts; None replays the persisted
+        manifest (restart path). Returns the number of warmed buckets.
+
+        Warming ZERO buckets (no/stale manifest on the restart path, or an
+        empty list) neither seals nor persists: sealing an empty cache
+        would turn every future request into a hard miss with no way back
+        — the caller must warm explicit buckets instead."""
+        if bucket_shapes is None:
+            bucket_shapes = self._load_manifest()
+        if not bucket_shapes:
+            log.warning("serving: warmup(%s) found no buckets for %r; "
+                        "cache left UNSEALED (an empty sealed cache would "
+                        "reject every request)",
+                        "manifest" if bucket_shapes == [] else bucket_shapes,
+                        self._model_key)
+            return 0
+        with _tm.span("serving.warmup", model=self._model_key,
+                      buckets=len(bucket_shapes)):
+            for shapes in bucket_shapes:
+                self.executable(shapes)
+        if seal:
+            self.seal()
+        self._save_manifest()
+        return len(bucket_shapes)
+
+    def seal(self):
+        """Freeze the bucket set: from now on any lookup miss raises."""
+        self._sealed = True
+
+    # --------------------------------------------------------- persistence
+    def _manifest_path(self):
+        if not self._cache_dir:
+            return None
+        return os.path.join(self._cache_dir, _device_kind(),
+                            self._model_key + ".json")
+
+    def _save_manifest(self):
+        path = self._manifest_path()
+        if path is None:
+            return
+        try:
+            os.makedirs(os.path.dirname(path), exist_ok=True)
+            buckets = [{n: list(s) for n, s in key} for key in self._exes]
+            tmp = path + ".tmp"
+            with open(tmp, "w") as f:
+                json.dump({"model_key": self._model_key,
+                           "digest": self._digest, "dtype": self._dtype,
+                           "device_kind": _device_kind(),
+                           "buckets": buckets}, f, indent=1)
+            os.replace(tmp, path)
+        except OSError as exc:
+            log.warning("serving: could not persist manifest %s (%s)",
+                        path, exc)
+
+    def _load_manifest(self):
+        path = self._manifest_path()
+        if path is None or not os.path.exists(path):
+            return []
+        try:
+            with open(path) as f:
+                rec = json.load(f)
+        except (OSError, ValueError) as exc:
+            log.warning("serving: unreadable manifest %s (%s)", path, exc)
+            return []
+        if rec.get("digest") != self._digest:
+            # a different model (or dtype) under the same key: stale
+            log.warning("serving: manifest %s digest mismatch "
+                        "(model changed); ignoring", path)
+            return []
+        return [{n: tuple(s) for n, s in b.items()}
+                for b in rec.get("buckets", [])]
+
+    # ------------------------------------------------------------- running
+    def run(self, inputs: Dict[str, np.ndarray]):
+        """One batch through the bucket executable matching the inputs'
+        exact shapes. Returns the outputs as numpy arrays."""
+        exe = self.executable({n: np.shape(v) for n, v in inputs.items()})
+        for n, v in inputs.items():
+            exe.arg_dict[n][:] = v
+        exe.forward(is_train=False)
+        return [o.asnumpy() for o in exe.outputs]

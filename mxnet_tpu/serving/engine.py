@@ -1,0 +1,371 @@
+"""Continuous-batching inference engine (docs/SERVING.md).
+
+One batcher thread owns the device: requests land in a thread-safe FIFO
+queue, the batcher assembles them into the smallest shape bucket that
+covers the pending rows — admitting requests that arrive mid-assembly up
+to a deadline (``MXNET_SERVE_MAX_DELAY_MS``) — pads the batch to the
+bucket, and replays the bucket's pre-compiled executable from the
+``PersistentExecutableCache``. Per-request outputs are sliced back out and
+delivered through futures, so N concurrent callers cost ONE dispatch.
+
+Why buckets instead of exact shapes: XLA compiles per shape. A fixed
+bucket ladder (1, 2, 4, 8, ...) bounds the executable count, warmup
+pre-compiles every rung, and the sealed cache turns "a request shape we
+never warmed" into a structured error instead of a silent recompile.
+
+Ordering: strict FIFO. A batch takes the queue head and every following
+request that still fits the largest bucket; a request is never overtaken
+by one submitted after it.
+"""
+from __future__ import annotations
+
+import os
+import threading
+import time
+from collections import deque
+from typing import Dict, List, Optional, Sequence
+
+import numpy as np
+
+from ..base import MXNetError
+from .. import telemetry as _tm
+from .cache import PersistentExecutableCache
+
+__all__ = ["InferenceEngine", "ServeFuture"]
+
+
+def _env_float(name, default):
+    try:
+        return float(os.environ.get(name, default))
+    except (TypeError, ValueError):
+        return float(default)
+
+
+def _env_int(name, default):
+    try:
+        return int(os.environ.get(name, default))
+    except (TypeError, ValueError):
+        return int(default)
+
+
+class ServeFuture:
+    """Delivery slot for one request's outputs. ``done_at`` is the
+    ``time.perf_counter()`` stamp of delivery (None until done) — load
+    generators read it for per-request latency without a waiter thread."""
+
+    __slots__ = ("_event", "_result", "_error", "done_at")
+
+    def __init__(self):
+        self._event = threading.Event()
+        self._result = None
+        self._error = None
+        self.done_at = None
+
+    def done(self):
+        return self._event.is_set()
+
+    def set_result(self, result):
+        self._result = result
+        self.done_at = time.perf_counter()
+        self._event.set()
+
+    def set_error(self, exc):
+        self._error = exc
+        self.done_at = time.perf_counter()
+        self._event.set()
+
+    def result(self, timeout=None):
+        if not self._event.wait(timeout):
+            raise MXNetError("serving: request timed out after %ss"
+                             % timeout)
+        if self._error is not None:
+            raise self._error
+        return self._result
+
+
+class _Request:
+    __slots__ = ("inputs", "rows", "future", "t_enq")
+
+    def __init__(self, inputs, rows):
+        self.inputs = inputs
+        self.rows = rows
+        self.future = ServeFuture()
+        self.t_enq = time.perf_counter()
+
+
+class InferenceEngine:
+    """Continuous batching over shape buckets on one model.
+
+    ``buckets`` are batch sizes (ascending after sort); ``item_shapes``
+    maps each model input to its PER-ITEM shape (no batch dim) — bucket
+    ``b`` binds input ``name`` at ``(b,) + item_shapes[name]``.
+    """
+
+    def __init__(self, cache: PersistentExecutableCache,
+                 item_shapes: Dict[str, Sequence[int]],
+                 buckets: Sequence[int] = (1, 2, 4, 8),
+                 max_delay_ms: Optional[float] = None,
+                 max_queue: Optional[int] = None,
+                 name: Optional[str] = None):
+        if not buckets:
+            raise MXNetError("serving: need at least one bucket")
+        self.cache = cache
+        self.buckets = tuple(sorted(set(int(b) for b in buckets)))
+        if self.buckets[0] < 1:
+            raise MXNetError("serving: buckets must be >= 1, got %s"
+                             % (buckets,))
+        self.item_shapes = {n: tuple(s) for n, s in item_shapes.items()}
+        unknown = set(self.item_shapes) - set(cache.input_names)
+        if unknown:
+            raise MXNetError(
+                "serving: item shapes name %s which are not model inputs %s"
+                % (sorted(unknown), cache.input_names))
+        # model inputs NOT in item_shapes (e.g. a SoftmaxOutput label) are
+        # left to simple_bind's shape inference and stay zero-filled
+        self.max_delay_s = (_env_float("MXNET_SERVE_MAX_DELAY_MS", 5.0)
+                            if max_delay_ms is None else float(max_delay_ms)
+                            ) / 1000.0
+        self.max_queue = (_env_int("MXNET_SERVE_MAX_QUEUE", 1024)
+                          if max_queue is None else int(max_queue))
+        self.name = name or cache._model_key
+        self._queue = deque()
+        self._cond = threading.Condition()
+        self._stop = False
+        self._thread = None
+        self._started = False
+        self._row_factors = None  # per-output rows-per-item; see start()
+
+    # ------------------------------------------------------------ lifecycle
+    def bucket_shapes(self):
+        return [{n: (b,) + s for n, s in self.item_shapes.items()}
+                for b in self.buckets]
+
+    def start(self, warmup=True):
+        """Pre-compile every bucket executable (sealing the cache) and
+        launch the batcher thread."""
+        if self._started:
+            return self
+        if warmup:
+            self.cache.warmup(self.bucket_shapes())
+        self._row_factors = self._output_row_factors()
+        self._stop = False
+        self._thread = threading.Thread(target=self._batcher_loop,
+                                        name="mxserve-batcher-%s" % self.name,
+                                        daemon=True)
+        self._started = True
+        self._thread.start()
+        return self
+
+    def _output_row_factors(self):
+        """Classify each model output as batch-major or not from STATIC
+        shape inference at two probe batch sizes: output i is batch-major
+        with k rows per item iff its leading dim is k*b for the same k at
+        both probes (a (B*T, V) flattened head has k=T). A constant
+        leading dim (time-major or aux outputs) fails the cross-probe
+        check and is replicated whole to every request — a single-size
+        divisibility test would mis-slice it whenever it happened to
+        divide. Probing is pure inference (no bind/compile), so the second
+        probe need not be a real bucket — this disambiguates even a
+        one-bucket ladder."""
+        b0 = self.buckets[-1]
+        factors = None
+        for b in (b0, b0 + 1):
+            shapes = {n: (b,) + s for n, s in self.item_shapes.items()}
+            try:
+                outs = self.cache.output_shapes(shapes)
+            except Exception:
+                if factors is not None:
+                    break  # off-bucket probe unsupported: keep probe 1
+                raise
+            ks = [None if not s or s[0] % b else s[0] // b for s in outs]
+            factors = ks if factors is None else \
+                [k if k == k2 else None for k, k2 in zip(factors, ks)]
+        return factors
+
+    def close(self, timeout=30.0):
+        """Drain the queue (every accepted request still gets an answer),
+        then stop the batcher. If the batcher is wedged past ``timeout``
+        the engine stays in the stopped-but-not-joined state: submits keep
+        raising and ``start()`` refuses to launch a second batcher beside
+        the zombie (two threads would race on the shared executor)."""
+        if not self._started:
+            return
+        with self._cond:
+            self._stop = True
+            self._cond.notify_all()
+        self._thread.join(timeout)
+        if self._thread.is_alive():
+            raise MXNetError(
+                "serving: batcher %r did not drain within %.1fs; engine "
+                "left stopped (not restartable) — a request is likely "
+                "wedged in dispatch" % (self._thread.name, timeout))
+        self._started = False
+
+    def __enter__(self):
+        return self.start()
+
+    def __exit__(self, *exc):
+        self.close()
+
+    # -------------------------------------------------------------- submit
+    def _validate(self, inputs):
+        arrs, rows = {}, None
+        for n, shape in self.item_shapes.items():
+            if n not in inputs:
+                raise MXNetError("serving: missing input %r" % n)
+            a = np.asarray(inputs[n])
+            if a.ndim == len(shape):  # single item: implicit batch of 1
+                a = a[None]
+            if tuple(a.shape[1:]) != shape:
+                raise MXNetError(
+                    "serving: input %r item shape %s does not match the "
+                    "engine's %s" % (n, tuple(a.shape[1:]), shape))
+            if rows is None:
+                rows = a.shape[0]
+            elif a.shape[0] != rows:
+                raise MXNetError(
+                    "serving: inconsistent batch rows across inputs "
+                    "(%d vs %d for %r)" % (rows, a.shape[0], n))
+            arrs[n] = a
+        if rows == 0:
+            raise MXNetError("serving: empty request")
+        if rows > self.buckets[-1]:
+            raise MXNetError(
+                "serving: request rows %d exceed the largest bucket %d "
+                "(oversize requests must be split by the caller)"
+                % (rows, self.buckets[-1]))
+        return arrs, rows
+
+    def submit(self, inputs) -> ServeFuture:
+        """Enqueue one request ({input: array} or a bare array for
+        single-input models); returns a ``ServeFuture``."""
+        if not isinstance(inputs, dict):
+            names = list(self.item_shapes)
+            if len(names) != 1:
+                raise MXNetError(
+                    "serving: model has inputs %s; pass a dict" % names)
+            inputs = {names[0]: inputs}
+        try:
+            arrs, rows = self._validate(inputs)
+        except MXNetError:
+            # every shed request counts: oversize/malformed here, queue
+            # backpressure below — serving.rejected is the load-shedding
+            # dashboard row (docs/OBSERVABILITY.md)
+            if _tm.enabled():
+                _tm.counter("serving.rejected").inc()
+            raise
+        req = _Request(arrs, rows)
+        with self._cond:
+            if not self._started or self._stop:
+                raise MXNetError("serving: engine is not running "
+                                 "(call start(), or already closed)")
+            if len(self._queue) >= self.max_queue:
+                if _tm.enabled():
+                    _tm.counter("serving.rejected").inc()
+                raise MXNetError(
+                    "serving: queue full (%d requests); backpressure"
+                    % len(self._queue))
+            self._queue.append(req)
+            depth = len(self._queue)
+            self._cond.notify_all()
+        if _tm.enabled():
+            _tm.counter("serving.requests").inc()
+            _tm.gauge("serving.queue_depth").set(depth)
+        return req.future
+
+    def infer(self, inputs, timeout=60.0):
+        """Blocking convenience: submit + wait; returns the output list."""
+        return self.submit(inputs).result(timeout=timeout)
+
+    # ------------------------------------------------------------- batcher
+    def _gather(self):
+        """Take the queue head and every following request that still fits
+        the largest bucket, waiting out the batching deadline for
+        mid-flight arrivals. Returns a non-empty request list, or None on
+        shutdown with an empty queue."""
+        max_rows = self.buckets[-1]
+        with self._cond:
+            while not self._queue:
+                if self._stop:
+                    return None
+                self._cond.wait(0.1)
+            deadline = self._queue[0].t_enq + self.max_delay_s
+            while True:
+                rows = 0
+                full = False
+                for r in self._queue:
+                    if rows + r.rows > max_rows:
+                        full = True
+                        break
+                    rows += r.rows
+                now = time.perf_counter()
+                if full or rows >= max_rows or now >= deadline or self._stop:
+                    break
+                self._cond.wait(deadline - now)
+            batch = []
+            taken = 0
+            while self._queue:
+                r = self._queue[0]
+                if taken + r.rows > max_rows:
+                    break
+                batch.append(self._queue.popleft())
+                taken += r.rows
+            depth = len(self._queue)
+        if _tm.enabled():
+            _tm.gauge("serving.queue_depth").set(depth)
+        return batch
+
+    def _dispatch(self, batch: List[_Request]):
+        rows = sum(r.rows for r in batch)
+        bucket = next(b for b in self.buckets if b >= rows)
+        padded = {}
+        for n, shape in self.item_shapes.items():
+            buf = np.zeros((bucket,) + shape,
+                           dtype=batch[0].inputs[n].dtype)
+            off = 0
+            for r in batch:
+                buf[off:off + r.rows] = r.inputs[n]
+                off += r.rows
+            padded[n] = buf
+        t0 = time.perf_counter()
+        if _tm.enabled():
+            _tm.counter("serving.batches").inc()
+            _tm.counter("serving.batch_items").inc(rows)
+            _tm.counter("serving.batch_capacity").inc(bucket)
+            _tm.counter("serving.padded_rows").inc(bucket - rows)
+            _tm.gauge("serving.batch_occupancy").set(rows / float(bucket))
+            qw = _tm.timer("serving.queue_wait")
+            for r in batch:
+                qw.add(t0 - r.t_enq)
+        with _tm.span("serving.dispatch", model=self.name, bucket=bucket,
+                      rows=rows, requests=len(batch)):
+            outs = self.cache.run(padded)
+        if _tm.enabled():
+            _tm.timer("serving.dispatch").add(time.perf_counter() - t0)
+        # slice each output back out by its statically classified
+        # rows-per-item factor (non-batch-major outputs replicate whole)
+        per_row = self._row_factors
+        off = 0
+        for r in batch:
+            res = []
+            for o, k in zip(outs, per_row):
+                res.append(o if k is None else o[off * k:(off + r.rows) * k])
+            r.future.set_result(res)
+            off += r.rows
+
+    def _batcher_loop(self):
+        while True:
+            batch = self._gather()
+            if batch is None:
+                return
+            try:
+                with _tm.span("serving.batch", model=self.name,
+                              requests=len(batch)):
+                    self._dispatch(batch)
+            except BaseException as exc:  # deliver, don't kill the loop
+                err = exc if isinstance(exc, Exception) else \
+                    MXNetError("serving: batcher died: %r" % (exc,))
+                for r in batch:
+                    r.future.set_error(err)
+                if not isinstance(exc, Exception):
+                    raise

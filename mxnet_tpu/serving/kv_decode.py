@@ -1,0 +1,203 @@
+"""KV-cache incremental decode for the transformer LM (docs/SERVING.md).
+
+Autoregressive serving without per-step recompilation: ONE prefill
+executable (prompt bucket, exports every layer's K/V) plus ONE
+single-token decode executable over a preallocated ring KV buffer of
+``max_len`` slots per layer. Both come from a sealed
+``PersistentExecutableCache``, so after warmup a greedy decode of any
+length replays exactly two XLA programs — the full-sequence re-forward it
+replaces costs O(T) work per token and a recompile per prompt length.
+
+Ring layout: position ``p`` writes slot ``p % max_len``; the write happens
+IN-GRAPH (``slot_onehot`` blend, models/transformer.py
+``get_decode_symbol``), and the updated buffers are program outputs the
+decoder swaps back in as the next step's inputs — a device-side pointer
+swap, no copy, no host round-trip. Attention over slots is
+order-agnostic (position information lives in the embeddings), so ring
+wraparound needs no rotation: once ``p >= max_len`` every slot is valid
+and the oldest token is simply the one overwritten.
+"""
+from __future__ import annotations
+
+from typing import Dict, Optional
+
+import numpy as np
+
+from ..base import MXNetError
+from .. import telemetry as _tm
+from .cache import PersistentExecutableCache
+
+__all__ = ["KVCacheDecoder"]
+
+_NEG = np.float32(-1e9)
+
+
+class KVCacheDecoder:
+    """Batched greedy/streaming decode over the serving transformer.
+
+    ``arg_params`` is the trained {name: array} dict of
+    ``models/transformer.get_symbol`` (embed/pos/layerN_*/final_ln/lm_head
+    weights — the serving graphs share those names exactly).
+    """
+
+    def __init__(self, arg_params: Dict[str, object], vocab_size,
+                 num_layers=2, num_heads=2, model_dim=32, ffn_dim=64,
+                 max_len=64, prefill_len: Optional[int] = None,
+                 pos_len: Optional[int] = None, batch=1, ctx=None,
+                 dtype="float32", cache_dir=None, model_key=None):
+        from ..models import transformer as _tf
+
+        self.vocab_size = int(vocab_size)
+        self.num_layers = int(num_layers)
+        self.num_heads = int(num_heads)
+        self.model_dim = int(model_dim)
+        self.max_len = int(max_len)
+        self.prefill_len = int(prefill_len or max_len)
+        self.pos_len = int(pos_len or max_len)
+        self.batch = int(batch)
+        self.dh = self.model_dim // self.num_heads
+        if self.prefill_len > self.max_len:
+            raise MXNetError("kv_decode: prefill_len %d > max_len %d"
+                             % (self.prefill_len, self.max_len))
+        cfg = dict(vocab_size=self.vocab_size, num_layers=self.num_layers,
+                   num_heads=self.num_heads, model_dim=self.model_dim,
+                   ffn_dim=int(ffn_dim), pos_len=self.pos_len)
+        key = model_key or "transformer_decode"
+        self._pf_cache = PersistentExecutableCache(
+            _tf.get_prefill_symbol(prefill_len=self.prefill_len, **cfg),
+            arg_params, {}, ctx=ctx, dtype=dtype, cache_dir=cache_dir,
+            model_key=key + "-prefill")
+        self._dec_cache = PersistentExecutableCache(
+            _tf.get_decode_symbol(max_len=self.max_len, **cfg),
+            arg_params, {}, ctx=ctx, dtype=dtype, cache_dir=cache_dir,
+            model_key=key + "-decode")
+        self._dec_exe = None
+        self._pos = 0
+        self._warm = False
+
+    # ------------------------------------------------------------ lifecycle
+    def _decode_shapes(self):
+        B, S, H, dh = self.batch, self.max_len, self.num_heads, self.dh
+        shapes = {"data": (B, 1), "pos_idx": (B, 1), "slot_onehot": (S,),
+                  "kv_mask": (S,)}
+        for i in range(self.num_layers):
+            shapes["kv_k_%d" % i] = (B, H, S, dh)
+            shapes["kv_v_%d" % i] = (B, H, S, dh)
+        return shapes
+
+    def warmup(self):
+        """Compile the prefill and decode executables; seal both caches —
+        any later shape drift is a hard retrace error, not a recompile."""
+        if self._warm:
+            return self
+        self._pf_cache.warmup([{"data": (self.batch, self.prefill_len)}])
+        self._dec_cache.warmup([self._decode_shapes()])
+        self._dec_exe = self._dec_cache.executable(self._decode_shapes())
+        self._warm = True
+        return self
+
+    def reset(self):
+        """Forget all context (the KV slots are masked out, not zeroed —
+        the mask is the source of truth for validity)."""
+        self._pos = 0
+
+    @property
+    def position(self):
+        return self._pos
+
+    # -------------------------------------------------------------- prefill
+    def prefill(self, tokens):
+        """Consume a (B, L<=prefill_len) prompt in one executable call:
+        seeds the ring KV buffer with positions 0..L-1 and returns the
+        (B, vocab) logits at position L-1 (the first generation step's
+        distribution)."""
+        self.warmup()
+        tokens = np.asarray(tokens, dtype=np.float32)
+        if tokens.ndim == 1:
+            tokens = tokens[None]
+        B, L = tokens.shape
+        if B != self.batch:
+            raise MXNetError("kv_decode: prefill batch %d != engine batch %d"
+                             % (B, self.batch))
+        if not 0 < L <= self.prefill_len:
+            raise MXNetError("kv_decode: prompt length %d not in "
+                             "(0, %d]" % (L, self.prefill_len))
+        P = self.prefill_len
+        padded = np.zeros((B, P), np.float32)
+        padded[:, :L] = tokens
+        with _tm.span("serving.prefill", rows=B, prompt_len=L):
+            pf = self._pf_cache.executable({"data": (B, P)})
+            pf.arg_dict["data"][:] = padded
+            pf.forward(is_train=False)
+            # only the last real position's logits cross to the host
+            logits = np.asarray(
+                pf.outputs[0]._jax().reshape(
+                    B, P, self.vocab_size)[:, L - 1, :])
+        # seed the decode ring: slots 0..P-1 <- prefill K/V, entirely
+        # device-side — pointer swap when the ring is exactly the prefill
+        # window, a device scatter otherwise; the K/V tensors never round-
+        # trip through the host (slots >= L are garbage but masked until
+        # their positions are actually written)
+        exe = self._dec_exe
+        for i in range(self.num_layers):
+            for tag, out in (("kv_k_%d" % i, pf.outputs[1 + 2 * i]),
+                             ("kv_v_%d" % i, pf.outputs[2 + 2 * i])):
+                if P == self.max_len:
+                    exe.arg_dict[tag]._set_jax(out._jax())
+                else:
+                    ring = exe.arg_dict[tag]._jax()
+                    exe.arg_dict[tag]._set_jax(
+                        ring.at[:, :, 0:P, :].set(out._jax()))
+        self._pos = L
+        if _tm.enabled():
+            _tm.counter("serving.prefill_tokens").inc(B * L)
+        return logits
+
+    # --------------------------------------------------------------- decode
+    def decode_step(self, tokens):
+        """One token per stream through the decode executable. ``tokens``
+        is (B,) or (B, 1); returns (B, vocab) logits for the NEXT
+        position. The ring KV update happens in-graph; host-side this is
+        arg/output pointer swaps only."""
+        self.warmup()
+        p, S = self._pos, self.max_len
+        if p >= self.pos_len:
+            raise MXNetError(
+                "kv_decode: position %d exceeds the trained position table "
+                "(%d rows)" % (p, self.pos_len))
+        tok = np.asarray(tokens, dtype=np.float32).reshape(self.batch, 1)
+        slot = p % S
+        oh = np.zeros((S,), np.float32)
+        oh[slot] = 1.0
+        mask = np.zeros((S,), np.float32)
+        if p + 1 < S:
+            mask[p + 1:] = _NEG  # slots beyond the history are empty
+        exe = self._dec_exe
+        exe.arg_dict["data"][:] = tok
+        exe.arg_dict["pos_idx"][:] = np.full((self.batch, 1), p, np.float32)
+        exe.arg_dict["slot_onehot"][:] = oh
+        exe.arg_dict["kv_mask"][:] = mask
+        with _tm.span("serving.decode_step", rows=self.batch, pos=p):
+            exe.forward(is_train=False)
+            logits = exe.outputs[0].asnumpy()
+        for i in range(self.num_layers):
+            exe.arg_dict["kv_k_%d" % i]._set_jax(
+                exe.outputs[1 + 2 * i]._jax())
+            exe.arg_dict["kv_v_%d" % i]._set_jax(
+                exe.outputs[2 + 2 * i]._jax())
+        self._pos = p + 1
+        if _tm.enabled():
+            _tm.counter("serving.decode_tokens").inc(self.batch)
+        return logits
+
+    def greedy(self, prompt, n_tokens):
+        """Greedy-decode ``n_tokens`` continuations of a (B, L) prompt.
+        Returns (B, n_tokens) int64 token ids."""
+        logits = self.prefill(prompt)
+        out = np.zeros((self.batch, n_tokens), np.int64)
+        for t in range(n_tokens):
+            nxt = np.argmax(logits, axis=-1)
+            out[:, t] = nxt
+            if t + 1 < n_tokens:
+                logits = self.decode_step(nxt)
+        return out

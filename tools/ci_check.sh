@@ -1,7 +1,7 @@
 #!/usr/bin/env bash
 # CI entrypoint: static analysis first, then the fused conv+BN machinery
 # smoke, then the telemetry trace smoke, then the 8-process kvstore
-# bucket/overlap smoke, then the tier-1 test suite.
+# bucket/overlap smoke, then the serving smoke, then the tier-1 test suite.
 #
 # Step 1 dogfoods the graphlint subsystem on every bundled model (the
 # acceptance gate: every model must lint with zero error-severity
@@ -18,12 +18,15 @@
 # smokes it end to end). Step 5 runs the 8-process CPU kvstore smoke
 # (tests/nightly/dist_kvstore_overlap.py): bucket-plan overlap counters
 # during a Module.fit, sharded-vs-replicated weight parity, and the
-# bucketed allreduce bandwidth floor (docs/PERF.md §11). Step 6 is the
-# repo's tier-1 pytest command (ROADMAP.md).
+# bucketed allreduce bandwidth floor (docs/PERF.md §11). Step 6 runs the serving
+# engine smoke (tools/serve_bench.py --check): QPS/p99 under a tiny
+# open-loop load with zero post-warmup retraces, for both the bucketed
+# engine and the transformer KV-cache decode path (docs/SERVING.md).
+# Step 7 is the repo's tier-1 pytest command (ROADMAP.md).
 set -uo pipefail
 cd "$(dirname "$0")/.."
 
-echo "== [1/6] graphlint: all bundled models (plain + sharding-plan sweep) =="
+echo "== [1/7] graphlint: all bundled models (plain + sharding-plan sweep) =="
 JAX_PLATFORMS=cpu python tools/graphlint --all-models --min-severity warning \
     || { echo "graphlint FAILED"; exit 1; }
 # the same zoo under an abstract dp=8,model=2 mesh: the GL4xx sharding-plan
@@ -50,7 +53,7 @@ print("mesh sweep OK: %d models, peak-HBM %.3f..%.3f GiB/device"
 PYEOF
 rm -f "$MESH_SWEEP"
 
-echo "== [2/6] source lint (ruff/pyflakes if available) =="
+echo "== [2/7] source lint (ruff/pyflakes if available) =="
 if command -v ruff >/dev/null 2>&1; then
     ruff check mxnet_tpu/ || { echo "ruff FAILED"; exit 1; }
 elif python -c 'import pyflakes' >/dev/null 2>&1; then
@@ -59,7 +62,7 @@ else
     echo "(neither ruff nor pyflakes installed; compile-check runs in pytest)"
 fi
 
-echo "== [3/6] fused conv+BN: interpret-mode autotune smoke + bwd parity subset =="
+echo "== [3/7] fused conv+BN: interpret-mode autotune smoke + bwd parity subset =="
 FUSED_TABLE="$(mktemp /tmp/fused_conv_bn_table_ci.XXXXXX.py)"
 JAX_PLATFORMS=cpu python tools/fused_stats_bench.py --interpret --emit-table \
     --table-out "$FUSED_TABLE" \
@@ -80,7 +83,7 @@ JAX_PLATFORMS=cpu python -m pytest tests/test_pallas_conv_bn_bwd.py -q \
     -m 'not slow' -p no:cacheprovider \
     || { echo "bwd parity subset FAILED"; exit 1; }
 
-echo "== [4/6] telemetry: trace-on fit smoke + mxtrace schema gate =="
+echo "== [4/7] telemetry: trace-on fit smoke + mxtrace schema gate =="
 TRACE_DIR="$(mktemp -d /tmp/mxtrace_ci.XXXXXX)"
 JAX_PLATFORMS=cpu MXNET_DEFAULT_CONTEXT=cpu MXNET_TELEMETRY=trace \
 python - "$TRACE_DIR" <<'PYEOF' || { echo "telemetry fit smoke FAILED"; rm -rf "$TRACE_DIR"; exit 1; }
@@ -121,7 +124,7 @@ python tools/mxtrace "$TRACE_DIR/profile.json" --check \
     || { echo "mxtrace --check FAILED"; rm -rf "$TRACE_DIR"; exit 1; }
 rm -rf "$TRACE_DIR"
 
-echo "== [5/6] kvstore: 8-process bucket/overlap smoke (docs/PERF.md §11) =="
+echo "== [5/7] kvstore: 8-process bucket/overlap smoke (docs/PERF.md §11) =="
 # functional leg: overlap counters fire during Module.fit on the per-key
 # priority path, and sharded-update weights bit-match replicated (atol 1e-6)
 JAX_PLATFORMS=cpu MXNET_DEFAULT_CONTEXT=cpu \
@@ -142,7 +145,20 @@ JAX_PLATFORMS=cpu MXNET_DEFAULT_CONTEXT=cpu MXNET_KVSTORE_BUCKET_MB=16 \
     "${BW_CMD[@]}" || { echo "kvstore bandwidth smoke FAILED"; exit 1; }
 }
 
-echo "== [6/6] tier-1 tests =="
+echo "== [6/7] serving: serve_bench smoke (docs/SERVING.md) =="
+# tiny-model CPU serving smoke: sustained QPS > 0, finite p99, ZERO
+# post-warmup retraces/compiles (the sealed executable-cache contract,
+# gated via the GL201-203 guard + executor compile/cache-hit telemetry),
+# and the serving.* span families present in the trace buffer
+JAX_PLATFORMS=cpu MXNET_DEFAULT_CONTEXT=cpu \
+python tools/serve_bench.py --model mlp --qps 100 --duration 1 --check \
+    || { echo "serve_bench engine smoke FAILED"; exit 1; }
+JAX_PLATFORMS=cpu MXNET_DEFAULT_CONTEXT=cpu \
+python tools/serve_bench.py --model transformer-decode --qps 16 \
+    --duration 1 --rows 2 --check \
+    || { echo "serve_bench kv-decode smoke FAILED"; exit 1; }
+
+echo "== [7/7] tier-1 tests =="
 rm -f /tmp/_t1.log
 timeout -k 10 870 env JAX_PLATFORMS=cpu python -m pytest tests/ -q -m 'not slow' \
     --continue-on-collection-errors -p no:cacheprovider -p no:xdist -p no:randomly \

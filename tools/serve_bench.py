@@ -1,0 +1,330 @@
+#!/usr/bin/env python
+"""Serving benchmark: synthetic open-loop load against the inference engine.
+
+The headline perf artifact for the serving subsystem (docs/SERVING.md): a
+load generator submits requests on a fixed open-loop schedule (arrivals do
+NOT wait for completions — the honest serving-latency regime) against an
+``InferenceEngine`` over a warmed ``PersistentExecutableCache``, then
+reports
+
+  - sustained QPS (completed requests / wall time),
+  - p50 / p99 request latency (submit -> delivery),
+  - batch occupancy (dispatched rows / dispatched bucket capacity),
+  - post-warmup retrace/compile counts (MUST be zero — the engine's whole
+    point; the sealed cache raises on the miss that would retrace, and the
+    executor's compile/cache-hit telemetry proves the replay),
+  - with ``--compare-batch1``: closed-loop saturation throughput of the
+    bucket ladder vs a batch-size-1 engine — continuous batching's
+    amortization of per-dispatch overhead, the PR's >=2x acceptance
+    number.
+
+``--model transformer-decode`` measures the KV-cache autoregressive path
+instead: per-token decode-step latency and tokens/s over batched streams
+(prefill bucket + single-token decode executable, zero retraces across
+positions).
+
+    python tools/serve_bench.py --model mlp --qps 200 --duration 3 --json
+    python tools/serve_bench.py --model lenet --compare-batch1 --check
+"""
+from __future__ import annotations
+
+import argparse
+import json
+import math
+import os
+import sys
+import time
+
+import numpy as np
+
+ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+if ROOT not in sys.path:
+    sys.path.insert(0, ROOT)
+
+ITEM_SHAPES = {
+    "mlp": (784,),
+    "lenet": (1, 28, 28),
+    "resnet-18": (3, 32, 32),
+}
+
+
+def _build_model(name):
+    from mxnet_tpu import models
+    from mxnet_tpu import context as _ctx
+
+    item = ITEM_SHAPES[name]
+    kwargs = {"num_classes": 10}
+    if name.startswith("resnet"):
+        kwargs["image_shape"] = ",".join(str(d) for d in item)
+    net = models.get_symbol(name, **kwargs)
+    probe = net.simple_bind(_ctx.current_context(), grad_req="null",
+                            data=(1,) + item)
+    rs = np.random.RandomState(0)
+    arg_params = {k: (rs.randn(*a.shape) * 0.1).astype("float32")
+                  for k, a in probe.arg_dict.items()
+                  if k not in ("data", "softmax_label")}
+    aux_params = {k: np.abs(rs.randn(*a.shape)).astype("float32") + 0.5
+                  for k, a in probe.aux_dict.items()}
+    return net, arg_params, aux_params, item
+
+
+def _percentiles(lat_ms):
+    if not lat_ms:
+        return None, None
+    return (float(np.percentile(lat_ms, 50)),
+            float(np.percentile(lat_ms, 99)))
+
+
+def _mk_engine(net, arg_params, aux_params, item, buckets, max_delay_ms,
+               cache_dir, tag):
+    from mxnet_tpu.serving import InferenceEngine, PersistentExecutableCache
+
+    cache = PersistentExecutableCache(net, arg_params, aux_params,
+                                      cache_dir=cache_dir,
+                                      model_key=tag)
+    return InferenceEngine(cache, {"data": item}, buckets=buckets,
+                           max_delay_ms=max_delay_ms, name=tag)
+
+
+def _counters():
+    from mxnet_tpu import telemetry
+
+    return dict(telemetry.counters())
+
+
+def _open_loop(eng, item, qps, duration, rows):
+    """Submit at the target rate for ``duration`` seconds; returns
+    (latencies_ms, completed, elapsed, offered)."""
+    rs = np.random.RandomState(1)
+    payloads = [rs.rand(rows, *item).astype("float32") for _ in range(8)]
+    futs = []
+    start = time.perf_counter()
+    n = 0
+    interval = 1.0 / qps
+    while True:
+        now = time.perf_counter()
+        if now - start >= duration:
+            break
+        target = start + n * interval
+        if target > now:
+            time.sleep(target - now)
+        t0 = time.perf_counter()
+        try:
+            futs.append((t0, eng.submit({"data": payloads[n % 8]})))
+        except Exception:
+            futs.append((t0, None))  # backpressure drop counts as offered
+        n += 1
+    lat = []
+    dropped = 0
+    for t0, f in futs:
+        if f is None:
+            dropped += 1
+            continue
+        f.result(timeout=60.0)
+        lat.append((f.done_at - t0) * 1000.0)
+    elapsed = time.perf_counter() - start
+    return lat, len(lat), elapsed, n, dropped
+
+
+def _closed_loop(eng, item, n_requests, rows):
+    """Saturation: all requests in flight at once; returns QPS."""
+    rs = np.random.RandomState(2)
+    x = rs.rand(rows, *item).astype("float32")
+    t0 = time.perf_counter()
+    futs = [eng.submit({"data": x}) for _ in range(n_requests)]
+    for f in futs:
+        f.result(timeout=120.0)
+    return n_requests / (time.perf_counter() - t0)
+
+
+def bench_engine(args):
+    from mxnet_tpu import telemetry
+
+    net, arg_params, aux_params, item = _build_model(args.model)
+    buckets = tuple(int(b) for b in args.buckets.split(","))
+    eng = _mk_engine(net, arg_params, aux_params, item, buckets,
+                     args.max_delay_ms, args.cache_dir, args.model)
+    eng.start()  # warmup compiles + seals here
+    # burn-in: first post-warmup dispatch pays one-time jax dispatch-path
+    # setup; keep it out of the measured window
+    eng.infer({"data": np.zeros((args.rows,) + item, "float32")})
+    c_warm = _counters()
+    lat, completed, elapsed, offered, dropped = _open_loop(
+        eng, item, args.qps, args.duration, args.rows)
+    c_end = _counters()
+    p50, p99 = _percentiles(lat)
+    items = c_end.get("serving.batch_items", 0) - \
+        c_warm.get("serving.batch_items", 0)
+    capacity = c_end.get("serving.batch_capacity", 0) - \
+        c_warm.get("serving.batch_capacity", 0)
+    res = {
+        "mode": "engine",
+        "model": args.model,
+        "buckets": list(buckets),
+        "max_delay_ms": args.max_delay_ms,
+        "offered_qps": args.qps,
+        "qps": round(completed / elapsed, 2) if elapsed else 0.0,
+        "requests": offered,
+        "completed": completed,
+        "dropped": dropped,
+        "p50_ms": None if p50 is None else round(p50, 3),
+        "p99_ms": None if p99 is None else round(p99, 3),
+        "batches": c_end.get("serving.batches", 0)
+        - c_warm.get("serving.batches", 0),
+        "batch_occupancy": round(items / capacity, 4) if capacity else None,
+        "retraces_post_warmup": c_end.get("executor.retrace", 0)
+        - c_warm.get("executor.retrace", 0),
+        "compiles_post_warmup": c_end.get("executor.compile", 0)
+        - c_warm.get("executor.compile", 0),
+    }
+    if args.compare_batch1:
+        n_req = max(64, int(args.qps * min(args.duration, 2)))
+        qps_b = _closed_loop(eng, item, n_req, args.rows)
+        eng.close()
+        eng1 = _mk_engine(net, arg_params, aux_params, item, (args.rows,),
+                          0.0, None, args.model + "-b1")
+        eng1.start()
+        qps_1 = _closed_loop(eng1, item, n_req, args.rows)
+        eng1.close()
+        res["qps_batched_saturated"] = round(qps_b, 2)
+        res["qps_batch1_saturated"] = round(qps_1, 2)
+        res["batching_speedup"] = round(qps_b / qps_1, 2) if qps_1 else None
+    else:
+        eng.close()
+    return res
+
+
+def bench_decode(args):
+    from mxnet_tpu.serving import KVCacheDecoder
+
+    cfg = dict(vocab_size=256, num_layers=2, num_heads=2, model_dim=64,
+               ffn_dim=128)
+    S = 64
+    # random weights straight from the decode graph's own shapes
+    from mxnet_tpu.models import transformer as _tf
+    from mxnet_tpu import context as _ctx
+
+    probe_sym = _tf.get_symbol(seq_len=S, **cfg)
+    probe = probe_sym.simple_bind(_ctx.current_context(), grad_req="null",
+                                  data=(1, S), softmax_label=(1, S))
+    rs = np.random.RandomState(0)
+    params = {k: (rs.randn(*a.shape) * 0.1).astype("float32")
+              for k, a in probe.arg_dict.items()
+              if k not in ("data", "softmax_label")}
+    B = args.rows
+    dec = KVCacheDecoder(params, max_len=S, prefill_len=16, pos_len=S,
+                         batch=B, cache_dir=args.cache_dir, **cfg)
+    dec.warmup()
+    c_warm = _counters()
+    prompt = rs.randint(1, 256, (B, 8)).astype("float32")
+    logits = dec.prefill(prompt)
+    # one burn-in step: the first post-warmup dispatch pays one-time jax
+    # dispatch-path setup that would otherwise read as a fake p99 outlier
+    logits = dec.decode_step(np.argmax(logits, axis=-1))
+    steps = min(int(args.qps * args.duration), S - 8 - 2) or 1
+    lat = []
+    t0 = time.perf_counter()
+    for _ in range(steps):
+        tok = np.argmax(logits, axis=-1)
+        t1 = time.perf_counter()
+        logits = dec.decode_step(tok)
+        lat.append((time.perf_counter() - t1) * 1000.0)
+    elapsed = time.perf_counter() - t0
+    c_end = _counters()
+    p50, p99 = _percentiles(lat)
+    return {
+        "mode": "kv_decode",
+        "model": "transformer-decode",
+        "streams": B,
+        "decode_steps": steps,
+        "qps": round(B * steps / elapsed, 2),  # tokens/s across streams
+        "p50_ms": round(p50, 3),
+        "p99_ms": round(p99, 3),
+        "batch_occupancy": 1.0,
+        "retraces_post_warmup": c_end.get("executor.retrace", 0)
+        - c_warm.get("executor.retrace", 0),
+        "compiles_post_warmup": c_end.get("executor.compile", 0)
+        - c_warm.get("executor.compile", 0),
+    }
+
+
+def _check(res, trace_families):
+    ok = True
+
+    def _fail(msg):
+        nonlocal ok
+        ok = False
+        sys.stderr.write("serve_bench --check FAILED: %s\n" % msg)
+
+    if not res.get("qps"):
+        _fail("qps not > 0: %r" % res.get("qps"))
+    p99 = res.get("p99_ms")
+    if p99 is None or not math.isfinite(p99):
+        _fail("p99 not finite: %r" % p99)
+    if res.get("retraces_post_warmup"):
+        _fail("post-warmup retraces: %d" % res["retraces_post_warmup"])
+    if res.get("compiles_post_warmup"):
+        _fail("post-warmup compiles: %d" % res["compiles_post_warmup"])
+    need = {"serving.dispatch"} if res["mode"] == "engine" \
+        else {"serving.decode_step", "serving.prefill"}
+    missing = need - trace_families
+    if missing:
+        _fail("missing serving.* trace families: %s" % sorted(missing))
+    if res.get("batching_speedup") is not None \
+            and res["batching_speedup"] < 2.0:
+        _fail("continuous batching speedup %.2fx < 2x over batch-size-1"
+              % res["batching_speedup"])
+    return ok
+
+
+def main(argv=None):
+    ap = argparse.ArgumentParser(description=__doc__.split("\n", 1)[0])
+    ap.add_argument("--model", default="mlp",
+                    choices=sorted(ITEM_SHAPES) + ["transformer-decode"])
+    ap.add_argument("--qps", type=float, default=200.0,
+                    help="offered open-loop rate (decode: steps*duration)")
+    ap.add_argument("--duration", type=float, default=3.0)
+    ap.add_argument("--rows", type=int, default=1,
+                    help="rows per request (decode: streams)")
+    ap.add_argument("--buckets", default="1,2,4,8")
+    ap.add_argument("--max-delay-ms", type=float, default=5.0)
+    ap.add_argument("--cache-dir", default=None,
+                    help="persist executables/manifests here "
+                         "(default: MXNET_SERVE_CACHE_DIR)")
+    ap.add_argument("--compare-batch1", action="store_true",
+                    help="also measure saturation QPS vs a batch-1 engine")
+    ap.add_argument("--quant", default=None, choices=[None, "off", "bf16",
+                                                      "int8"],
+                    help="sets MXNET_SERVE_QUANT for the run")
+    ap.add_argument("--json", action="store_true")
+    ap.add_argument("--check", action="store_true",
+                    help="CI gate: assert qps>0, finite p99, zero "
+                         "post-warmup retraces/compiles, serving.* spans")
+    args = ap.parse_args(argv)
+
+    if args.quant:
+        os.environ["MXNET_SERVE_QUANT"] = args.quant
+    from mxnet_tpu import telemetry
+
+    telemetry.set_mode("trace" if args.check else "counters")
+    if args.model == "transformer-decode":
+        res = bench_decode(args)
+    else:
+        res = bench_engine(args)
+    res["quant"] = args.quant or os.environ.get("MXNET_SERVE_QUANT", "off")
+
+    ok = True
+    if args.check:
+        families = {e[0] for e in telemetry.drain_events()}
+        ok = _check(res, families)
+        res["check"] = "ok" if ok else "FAILED"
+    if args.json or args.check:
+        print(json.dumps(res))
+    else:
+        for k, v in res.items():
+            print("%-26s %s" % (k, v))
+    return 0 if ok else 1
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())
