@@ -11,11 +11,20 @@ from __future__ import annotations
 
 import numpy as np
 
-__all__ = ["MXNetError", "string_types", "numeric_types"]
+__all__ = ["MXNetError", "EvictedError", "string_types", "numeric_types"]
 
 
 class MXNetError(Exception):
     """Error raised by the framework (reference: python/mxnet/base.py MXNetError)."""
+
+
+class EvictedError(MXNetError):
+    """This worker was evicted from an elastic job (docs/FAULT_TOLERANCE.md):
+    the surviving membership re-formed without it — either because it is
+    draining after SIGTERM (expected; exit 0) or because its heartbeat went
+    stale from the coordinator's point of view (clock skew / stalled host).
+    Rejoining a generation that has written this worker off would corrupt
+    the collective, so the only safe move is to stop training and exit."""
 
 
 string_types = (str,)

@@ -12,19 +12,47 @@ import time
 __all__ = ["Speedometer", "do_checkpoint", "module_checkpoint", "log_train_metric", "ProgressBar"]
 
 
-def module_checkpoint(mod, prefix, period=1, save_optimizer_states=False):
-    """Epoch-end callback checkpointing a module (reference: callback.py:11)."""
+def module_checkpoint(mod, prefix, period=1, save_optimizer_states=False,
+                      keep=None):
+    """Epoch-end callback checkpointing a module (reference: callback.py:11).
+
+    ``keep`` (default: ``MXNET_CHECKPOINT_KEEP``, unlimited when unset)
+    retains only the last K epoch checkpoints so long elastic runs don't
+    grow disk without bound. Deletion is manifest-aware: the newest epoch
+    whose files are COMPLETE — including, for a sharded ``.states``
+    pointer, the whole shard set it references — is never deleted, and a
+    deleted sharded pointer takes its backing shard directory with it
+    (checkpoint.prefix_retention, docs/FAULT_TOLERANCE.md)."""
     period = int(max(1, period))
 
     def _callback(iter_no, sym=None, arg=None, aux=None):
         if (iter_no + 1) % period == 0:
             mod.save_checkpoint(prefix, iter_no + 1, save_optimizer_states)
+            _apply_keep(prefix, keep)
 
     return _callback
 
 
-def do_checkpoint(prefix, period=1):
-    """Epoch-end callback saving symbol+params (reference: callback.py:39)."""
+def _apply_keep(prefix, keep):
+    from . import checkpoint as ckpt
+
+    if keep is None:
+        k = ckpt.checkpoint_keep()
+    else:
+        k = int(keep)
+        if k <= 0:
+            # same contract as MXNET_CHECKPOINT_KEEP: non-positive warns
+            # and disables (a negative k would slice epochs[:-k] wrong)
+            logging.warning("checkpoint keep=%r is not a positive int; "
+                            "retention disabled", keep)
+            k = None
+    if k:
+        ckpt.prefix_retention(prefix, k)
+
+
+def do_checkpoint(prefix, period=1, keep=None):
+    """Epoch-end callback saving symbol+params (reference: callback.py:39);
+    ``keep`` retains the last K epochs (see ``module_checkpoint``)."""
     from .model import save_checkpoint
 
     period = int(max(1, period))
@@ -32,6 +60,7 @@ def do_checkpoint(prefix, period=1):
     def _callback(iter_no, sym, arg, aux):
         if (iter_no + 1) % period == 0:
             save_checkpoint(prefix, iter_no + 1, sym, arg, aux)
+            _apply_keep(prefix, keep)
 
     return _callback
 

@@ -268,39 +268,298 @@ class KVStore:
 
                 sync_global_devices("mxnet_tpu_kvstore_barrier")
 
-    def get_num_dead_node(self, node_id=0, timeout=3):
+    def get_num_dead_node(self, node_id=0, timeout=None):
         """Dead-worker count (reference: kvstore.h:234-244 — a ps-lite
         heartbeat scan, meaningful because that topology tolerated dead
-        workers). The SPMD runtime is gang-scheduled (SURVEY.md §5.3): the
-        JAX coordination service heartbeats every process itself and a dead
-        peer aborts the whole job with a runtime error rather than leaving it
-        degraded. So while this process is running, the worker set is by
-        construction fully live — return 0. Failure recovery is
-        checkpoint-resume (``mx.model.resume_or_init``), not elastic
-        membership."""
+        workers). Under the default gang-scheduled runtime (SURVEY.md §5.3)
+        the JAX coordination service heartbeats every process itself and a
+        dead peer aborts the whole job rather than leaving it degraded, so
+        while this process runs the worker set is by construction fully
+        live — return 0; recovery is checkpoint-resume. Under
+        ``MXNET_ELASTIC=1`` (docs/FAULT_TOLERANCE.md) death propagation is
+        disabled and membership is OURS to track: the heartbeat-file scan
+        is authoritative, exactly the reference's ps-lite semantics.
+
+        ``timeout`` defaults to ``MXNET_ELASTIC_DEAD_TIMEOUT`` (60 s) —
+        NOT the reference's 3 s, which was tuned to ps-lite's 1 s beat and
+        would class ~half of the live workers dead against this port's
+        default 5 s heartbeat interval."""
+        from . import dist
+
+        if "dist" in self._type and dist.elastic_enabled():
+            if timeout is None:
+                return dist.num_dead_nodes(
+                    timeout=dist.dead_timeout_seconds())
+            return dist.num_dead_nodes(timeout=timeout)
         return 0
 
     def save_optimizer_states(self, fname):
+        """Persist optimizer state. Replicated/local: the per-key Updater
+        state pickle, written atomically (temp + os.replace). Sharded
+        (MXNET_KVSTORE_UPDATE=sharded): each worker writes its 1/W flat
+        shard to ``<fname>.sharded/step-<N>/`` plus a digest-guarded
+        manifest, and ``fname`` itself becomes a small pointer file — the
+        format load_optimizer_states resolves for both same-W (shard-direct,
+        momentum bit-parity) and different-W (re-flattened) resume
+        (docs/FAULT_TOLERANCE.md)."""
         assert self._updater is not None, "Cannot save states for distributed training"
-        if (self._bucket_engine is not None
-                and self._bucket_engine._sharded_state):
-            raise MXNetError(
-                "optimizer state lives in per-bucket 1/W shards under "
-                "MXNET_KVSTORE_UPDATE=sharded and cannot be pickled per key; "
-                "run with MXNET_KVSTORE_UPDATE=replicated to save states")
-        with open(fname, "wb") as fout:
-            fout.write(self._updater.get_states())
+        from . import checkpoint as ckpt
+
+        eng = self._bucket_engine
+        if eng is not None and eng._sharded_state:
+            eng.finalize_all()
+            opt = self._optimizer
+            step = int(opt.num_update) if opt is not None else 0
+            # ephemeral writer, closed after the (blocking) save: fname is
+            # epoch-numbered under module_checkpoint, so caching per path
+            # would never hit and each epoch would leak an idle daemon
+            # writer thread
+            writer = ckpt.Checkpointer(fname + ".sharded")
+            try:
+                writer.save_sharded(self, step, block=True)
+            finally:
+                writer.close()
+            import json
+
+            ckpt.atomic_write_bytes(fname, json.dumps(
+                {"format": "mxtpu-sharded-states",
+                 "dir": fname + ".sharded", "step": step}).encode())
+            return
+        ckpt.atomic_write_bytes(fname, self._updater.get_states())
 
     def load_optimizer_states(self, fname):
+        """Inverse of save_optimizer_states. A sharded pointer file loads
+        through mxnet_tpu.checkpoint: when the live bucket plan and world
+        match the manifest, this worker's shard file device_puts straight
+        into the flat state (bit-parity); otherwise the shard set is
+        re-flattened into per-key Updater states on the host and the engine
+        re-shards them under its own plan (different-W resume). Optimizer
+        update counts are restored from the manifest either way. A torn or
+        corrupt file raises a structured MXNetError naming the path."""
         assert self._updater is not None, "Cannot load states for distributed training"
-        if (self._bucket_engine is not None
-                and self._bucket_engine._sharded_state):
-            raise MXNetError(
-                "cannot load per-key optimizer states into the sharded "
-                "update's per-bucket 1/W shards (MXNET_KVSTORE_UPDATE="
-                "sharded); run with MXNET_KVSTORE_UPDATE=replicated")
+        from . import checkpoint as ckpt
+
+        pointer = ckpt.read_sharded_pointer(fname)
+        if pointer is not None:
+            self._load_sharded_states(fname, pointer)
+            return
         with open(fname, "rb") as fin:
-            self._updater.set_states(fin.read())
+            blob = fin.read()
+        try:
+            self._updater.set_states(blob)
+        except Exception as e:
+            raise MXNetError(
+                "optimizer-state file %r is torn or not a state pickle "
+                "(%s: %s) — likely a crash mid-save; delete it and resume "
+                "from the previous checkpoint"
+                % (fname, type(e).__name__, e)) from e
+        if self._bucket_engine is not None:
+            # flat shards (if any) must re-seed from the freshly loaded
+            # per-key states, not keep pre-load momentum
+            self._bucket_engine.reseed_updater_states()
+
+    def _load_sharded_states(self, fname, pointer):
+        from . import checkpoint as ckpt
+
+        root, step = pointer["dir"], pointer["step"]
+        manifest = ckpt.load_manifest(root, step)
+        if manifest is None:
+            raise MXNetError(
+                "sharded optimizer-state pointer %r names step %s in %r but "
+                "no readable manifest exists there — the checkpoint set is "
+                "torn or was deleted" % (fname, step, root))
+        self._check_flat_spec(manifest, fname)
+        self._seed_states_from_manifest(root, step, manifest)
+
+    def _check_flat_spec(self, manifest, path):
+        """The live optimizer must lower to the same flat kernel family as
+        the one that wrote the checkpoint — states are not portable across
+        optimizer kinds."""
+        opt = self._optimizer
+        if opt is None:
+            return
+        kind, _, n_states = opt.flat_update_spec() or (None, None, None)
+        want = manifest["optimizer"]
+        if kind is not None and (want["kind"] != kind
+                                 or want["n_states"] != n_states):
+            raise MXNetError(
+                "sharded optimizer states at %r were saved by a %r "
+                "optimizer (%d state slots); the live optimizer %s "
+                "lowers to %r (%d slots) — states are not portable "
+                "across optimizer kinds"
+                % (path, want["kind"], want["n_states"],
+                   type(opt).__name__, kind, n_states))
+
+    def _seed_states_from_manifest(self, root, step, manifest, flats=None):
+        """Seed optimizer state from a sharded checkpoint step: shard-direct
+        when the live plan/world match (momentum bit-parity), else re-flatten
+        every worker's shard into per-key Updater states (different-W
+        resume). Update counts restore from the manifest either way.
+
+        At FIT-START resume no plan is committed yet (it commits on the
+        first push round), so even a same-W resume takes the re-flatten
+        path — which costs nothing extra there: ``load_sharded_checkpoint``
+        must read every shard file anyway to reconstruct the full WEIGHTS
+        (they are sharded 1/W per file too), and re-flatten is pure
+        concatenate/slice — bit-lossless (tested:
+        test_same_world_fit_resume_bit_parity)."""
+        from . import checkpoint as ckpt
+
+        eng = self._bucket_engine
+        import jax
+
+        same_world = manifest["world"] == jax.process_count()
+        if (eng is not None and eng.plan is not None and same_world
+                and eng.mode == "sharded"
+                and eng.plan.hash == manifest.get("plan_hash")):
+            # the mode check matters: an engine downgraded to replicated
+            # (partial-push veto) never consumes _preloaded_shards — the
+            # re-flatten path below seeds _updater.states, which replicated
+            # updates actually read
+            # shard-direct: this worker's own shard seeds its flat slices
+            # verbatim — no re-flatten, momentum bit-parity
+            n_states = manifest["optimizer"]["n_states"]
+            if flats is not None:
+                # the caller already read + digest-verified EVERY shard
+                # (read_flat_buckets); slice our rows back out instead of
+                # paying a second read + sha256 of our own shard file
+                world = int(manifest["world"])
+                shards = {}
+                for b in manifest["plan"]["buckets"]:
+                    idx = int(b["index"])
+                    sliced = []
+                    for s in flats[idx]["states"]:
+                        n = s.shape[0] // world
+                        sliced.append(s[self.rank * n:(self.rank + 1) * n])
+                    shards[idx] = sliced
+            else:
+                local = ckpt.read_local_shard(root, step, manifest,
+                                              self.rank)
+                shards = {
+                    int(b["index"]): [local["b%d.s%d"
+                                            % (int(b["index"]), i)]
+                                      for i in range(n_states)]
+                    for b in manifest["plan"]["buckets"]}
+            eng.preload_flat_shards(shards)
+        else:
+            if flats is None:
+                flats = ckpt.read_flat_buckets(root, step, manifest)
+            states = ckpt.per_key_states(manifest, flats)
+            from .ndarray import NDArray
+            import jax.numpy as jnp
+
+            for key, tup in states.items():
+                nds = tuple(NDArray(jnp.asarray(a)) for a in tup)
+                self._updater.states[key] = (
+                    nds[0] if len(nds) == 1 else nds if nds else None)
+            if eng is not None:
+                eng.reseed_updater_states()
+        opt = self._optimizer
+        if opt is not None:
+            for key, count in manifest.get("update_counts", ()):
+                opt._index_update_count[key] = int(count)
+            opt.num_update = max(opt.num_update,
+                                 int(manifest.get("num_update", 0)))
+
+    # ---------------------------------------------------------------- elastic
+    #
+    # The pause/re-form/resume state machine (docs/FAULT_TOLERANCE.md):
+    #
+    #     running --(pause decision agreed)--> paused
+    #     paused  --(dist.reform succeeded)--> reforming
+    #     reforming --(weights/state reseeded)--> resuming
+    #     resuming --(first post-re-form round)--> running
+    #
+    # Driven by module.elastic.ElasticFit; surfaced here because the store
+    # is what every training loop already holds a handle to. Unrecoverable
+    # transitions (coordinator death, below-min survivors, no checkpoint)
+    # raise structured MXNetErrors from the dist/checkpoint layers.
+
+    _ELASTIC_STATES = ("running", "paused", "reforming", "resuming")
+
+    @property
+    def elastic_state(self) -> str:
+        """Where this store is in the elastic state machine; ``running``
+        outside a recovery window (and always, for non-elastic jobs)."""
+        return getattr(self, "_elastic_state", "running")
+
+    def _set_elastic_state(self, state):
+        assert state in self._ELASTIC_STATES, state
+        self._elastic_state = state
+        if _tm.enabled():
+            _tm.event("kvstore.elastic_state", state=state)
+            _tm.gauge("kvstore.elastic_paused").set(
+                0 if state == "running" else 1)
+
+    def _reseed(self, key, value):
+        """Overwrite one stored weight (recovery path: ``init`` refuses
+        duplicates by design, but a re-formed worker reseeding from a
+        checkpoint must replace)."""
+        if key not in self._store:
+            raise MXNetError("cannot reseed key %s before init" % key)
+        self._store[key] = value.copy()
+
+    def reform(self):
+        """Re-form this store over the CURRENT (post-recovery) process set:
+        rebuild the compiled collective layer and re-plan the bucket engine
+        for the new worker count. The caller (dist.reform via the elastic
+        controller, docs/FAULT_TOLERANCE.md) has already rebuilt the JAX
+        backend over the survivors; store values and optimizer state must be
+        reseeded afterwards — they referenced the old backend's buffers."""
+        if "dist" not in self._type:
+            return
+        self._set_elastic_state("reforming")
+        _Collective._cache = None  # stale worker mesh must not survive
+        if self._bucket_engine is not None:
+            self._bucket_engine.reform()
+
+    def load_sharded_checkpoint(self, root, step=None):
+        """Seed stored WEIGHTS and optimizer state from a sharded
+        checkpoint set under ``root`` (docs/FAULT_TOLERANCE.md): the
+        recovery path after an elastic re-form, and the cold-start path for
+        a job relaunched at a different world size. ``step=None`` resolves
+        the newest COMPLETE step. Weight keys must already be inited (the
+        training loop binds before it recovers). Returns ``(step,
+        weights)`` with ``weights`` mapping key -> host np array so the
+        caller (Module's recovery hook) can adopt them into its executors.
+
+        Raises a structured ``MXNetError`` when no complete checkpoint
+        exists, the manifest is for a different optimizer family, or the
+        shard set fails its digest check."""
+        from . import checkpoint as ckpt
+
+        if step is None:
+            got = ckpt.latest_complete(root)
+            if got is None:
+                raise MXNetError(
+                    "no COMPLETE sharded checkpoint under %r — nothing to "
+                    "recover from (a torn/in-flight step does not count)"
+                    % (root,))
+            step, manifest = got
+        else:
+            manifest = ckpt.load_manifest(root, step)
+            if manifest is None:
+                raise MXNetError(
+                    "checkpoint step %s under %r has no readable manifest"
+                    % (step, root))
+        if manifest.get("kind") != "sharded":
+            raise MXNetError(
+                "checkpoint step %s under %r is %r, not a sharded set"
+                % (step, root, manifest.get("kind")))
+        self._check_flat_spec(manifest, root)
+        with _tm.span("checkpoint.load", step=step,
+                      world=manifest.get("world")):
+            flats = ckpt.read_flat_buckets(root, step, manifest)
+            weights = ckpt.per_key_states(manifest, flats, weights=True)
+            from .ndarray import NDArray
+            import jax.numpy as jnp
+
+            for key, w in weights.items():
+                if key in self._store:
+                    self._store[key] = NDArray(jnp.asarray(w))
+            self._seed_states_from_manifest(root, step, manifest,
+                                            flats=flats)
+        return step, weights
 
 
 class _Collective:

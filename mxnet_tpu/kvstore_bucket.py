@@ -231,6 +231,16 @@ class BucketPlan:
                 "n_workers": self.n_workers,
                 "buckets": [b.describe() for b in self.buckets]}
 
+    def describe_portable(self):
+        """JSON-round-trippable describe() (tuples -> lists) — the slot map
+        a checkpoint manifest records so any-world loaders can re-flatten
+        the shard set (mxnet_tpu.checkpoint.per_key_states)."""
+        d = self.describe()
+        for b in d["buckets"]:
+            b["slots"] = [[s[0], s[1], s[2], list(s[3])] + list(s[4:])
+                          for s in b["slots"]]
+        return d
+
 
 # --------------------------------------------------------------------- flat
 # jittable flat optimizer kernels for the sharded update — each mirrors the
@@ -314,6 +324,8 @@ class BucketEngine:
         self._sharded_state: Dict[int, dict] = {}
         self._mode = update_mode()
         self._mode_reason = None
+        self._plan_records = None     # committed plan's records (for replan)
+        self._preloaded_shards = {}   # bucket idx -> [np local state shards]
         self._pending_parts: Dict = {}  # split-key segments awaiting assembly
         self._ticked = set()          # keys whose update count ticked (round)
         self._round_seq: List = []    # (key, shape, dtype) arrival this round
@@ -470,10 +482,71 @@ class BucketEngine:
             self._finalize(st)
         self._close_round()
 
+    # ---------------------------------------------------------- resume/reform
+    def preload_flat_shards(self, shards):
+        """Seed the NEXT flat-state build from checkpoint shards: ``shards``
+        maps bucket index -> [np local 1/W state slices] (this worker's).
+        The live sharded state (if any) is dropped so the next flush
+        rebuilds from the preload — the same-W shard-direct resume path of
+        mxnet_tpu.checkpoint (momentum bit-parity: the exact bytes the
+        checkpoint captured device_put straight back)."""
+        self._preloaded_shards = dict(shards)
+        self._sharded_state.clear()
+        self._sharded_step.clear()
+        # a load clears any prior capability veto: the caller proved the
+        # optimizer/world alignment by matching the manifest digest
+        if self._mode_reason and "partial push round" not in self._mode_reason:
+            self._mode_reason = None
+
+    def reseed_updater_states(self):
+        """Drop flat sharded state so the next flush re-seeds from the
+        per-key Updater states (the different-W / re-flattened resume path;
+        also used after load_optimizer_states mid-run)."""
+        self._preloaded_shards.clear()
+        self._sharded_state.clear()
+        self._sharded_step.clear()
+
+    def reform(self, records=None):
+        """Rebuild this engine for the CURRENT world (after an elastic
+        re-form changed the process set, docs/FAULT_TOLERANCE.md): drop
+        every compiled executable, collective handle and in-flight bucket,
+        then re-plan the committed key sequence for the new worker count.
+        The cross-worker plan-digest allgather re-verifies agreement, and
+        the first-N round checks re-arm — a re-formed job gets the same
+        validation a fresh one does."""
+        records = records if records is not None else self._plan_records
+        self._collective = None     # _Collective.get() re-keys on the backend
+        self._states = {}
+        self._packs = {}
+        self._sharded_step = {}
+        self._sharded_state = {}
+        self._preloaded_shards = {}
+        self._pending_parts = {}
+        self._ticked = set()
+        self._round_seq = []
+        self._round_t0 = None
+        self._round_flushes = []
+        self._rounds_done = 0
+        self._mode = update_mode()
+        self._mode_reason = None
+        self.plan = None
+        self._recording = []
+        if records is not None:
+            self._plan_records = list(records)
+            self.plan = BucketPlan.build(records, self._coll().n_workers)
+            self._states = {b.index: _BucketState(b)
+                            for b in self.plan.buckets}
+            log.info("KVStore bucket plan re-formed: %d keys -> %d "
+                     "bucket(s) over %d worker(s), hash %s",
+                     len(records), len(self.plan.buckets),
+                     self._coll().n_workers, self.plan.hash[:12])
+            self._verify_across_workers("plan:" + self.plan.hash)
+
     # ------------------------------------------------------------------ plan
     def _commit_plan(self):
         records = [(k, tuple(m.shape), str(m.dtype), p)
                    for k, m, p in self._recording]
+        self._plan_records = records
         self.plan = BucketPlan.build(records, self._coll().n_workers)
         self._states = {b.index: _BucketState(b) for b in self.plan.buckets}
         log.info("KVStore bucket plan: %d keys -> %d bucket(s), cap %.1f MB, "
@@ -566,14 +639,69 @@ class BucketEngine:
                     _tm.counter("kvstore.bytes.allreduce").inc(wire)
         st.t_dispatch = time.perf_counter()
 
+    def _gather_per_key_states(self):
+        """All-gather every bucket's 1/W flat state shards and stitch them
+        into per-key HOST arrays: ``(n_states, {key: [np, ...]})``. Split
+        keys stitch their per-bucket segments; parts whose bucket never
+        dispatched shardedly contribute zeros (the state a fresh Updater
+        would lazily create). The all-gather is a COLLECTIVE — every
+        current member must call this together. Read-only: the live
+        sharded state is untouched."""
+        if not self._sharded_state:
+            return 0, {}
+        import jax
+        from jax.sharding import NamedSharding, PartitionSpec as P
+
+        coll = self._coll()
+        gather = jax.jit(lambda x: x,
+                         out_shardings=NamedSharding(coll.mesh, P()))
+        n_states = 0
+        pending: Dict = {}  # key -> {part: [np state segments]}
+        for spec in (s.spec for s in self._states.values()):
+            sstate = self._sharded_state.get(spec.index)
+            if sstate is None or not sstate["states"]:
+                continue
+            n_states = len(sstate["states"])
+            full = [np.asarray(gather(s).addressable_data(0))
+                    for s in sstate["states"]]
+            for s in spec.slots:
+                pending.setdefault(s.key, {})[s.part] = [
+                    fs[s.offset:s.offset + s.size] for fs in full]
+        if not n_states:
+            return 0, {}
+        out = {}
+        for key, parts in pending.items():
+            slots = [sl for _, sl in self.plan.key_to_slots[key]]
+            segs = []
+            for sl in slots:  # zeros for parts whose bucket never dispatched
+                segs.append(parts.get(sl.part,
+                                      [np.zeros((sl.size,),
+                                                np.dtype(sl.dtype))
+                                       for _ in range(n_states)]))
+            shape = slots[0].shape
+            out[key] = [np.concatenate([p[i] for p in segs]).reshape(shape)
+                        if len(segs) > 1 else segs[0][i].reshape(shape)
+                        for i in range(n_states)]
+        return n_states, out
+
+    def export_per_key_states(self):
+        """Per-key optimizer states from the live flat shards, on host —
+        the pause-time snapshot elastic recovery seeds from when no
+        complete checkpoint exists (the all-gather path,
+        docs/FAULT_TOLERANCE.md). Collective: requires the full CURRENT
+        membership still participating (i.e. a DRAINING departure, not a
+        crash). Finalizes in-flight buckets first. ``{}`` when the engine
+        holds no flat state (replicated mode)."""
+        self.finalize_all()
+        _, states = self._gather_per_key_states()
+        return states
+
     def _downgrade_sharded(self):
         """Move the WHOLE engine from the fused sharded update back to
         replicated, without losing optimizer history: drain any in-flight
         sharded buckets, all-gather every bucket's 1/W flat state shards,
         and seed the per-key Updater states the replicated path reads from
-        now on. Split keys stitch their per-bucket state segments; parts
-        whose bucket never dispatched shardedly contribute zeros (the state
-        a fresh Updater would lazily create)."""
+        now on."""
         if self._mode_reason is not None:
             return
         self._mode_reason = ("partial push round — bucket keys were not all "
@@ -584,47 +712,21 @@ class BucketEngine:
                 self._finalize(st)
         if not self._sharded_state:
             return
-        import jax
         import jax.numpy as jnp
-        from jax.sharding import NamedSharding, PartitionSpec as P
 
         log.warning(
             "KVStore: partial push round under MXNET_KVSTORE_UPDATE=sharded "
             "— downgrading to the replicated update (per-key optimizer "
             "states seeded from the flat shards; momentum history preserved)")
-        coll = self._coll()
-        gather = jax.jit(lambda x: x,
-                         out_shardings=NamedSharding(coll.mesh, P()))
-        upd = self._kv._updater
-        n_states = 0
-        pending: Dict = {}  # key -> {part: [np state segments]}
-        for spec in (s.spec for s in self._states.values()):
-            sstate = self._sharded_state.pop(spec.index, None)
-            self._sharded_step.pop(spec.index, None)
-            if sstate is None or not sstate["states"]:
-                continue
-            n_states = len(sstate["states"])
-            full = [np.asarray(gather(s).addressable_data(0))
-                    for s in sstate["states"]]
-            for s in spec.slots:
-                pending.setdefault(s.key, {})[s.part] = [
-                    fs[s.offset:s.offset + s.size] for fs in full]
+        n_states, per_key = self._gather_per_key_states()
+        self._sharded_state.clear()
+        self._sharded_step.clear()
         if not n_states:
             return
-        for key, parts in pending.items():
-            slots = [sl for _, sl in self.plan.key_to_slots[key]]
-            segs = []
-            for sl in slots:  # zeros for parts whose bucket never dispatched
-                segs.append(parts.get(sl.part,
-                                      [np.zeros((sl.size,),
-                                                np.dtype(sl.dtype))
-                                       for _ in range(n_states)]))
-            shape = slots[0].shape
+        upd = self._kv._updater
+        for key, arrs in per_key.items():
             ctx = self._kv._store[key].context
-            nds = [NDArray(jnp.asarray(np.concatenate(
-                       [p[i] for p in segs]) if len(segs) > 1
-                       else segs[0][i]).reshape(shape), ctx=ctx)
-                   for i in range(n_states)]
+            nds = [NDArray(jnp.asarray(a), ctx=ctx) for a in arrs]
             upd.states[key] = nds[0] if n_states == 1 else tuple(nds)
 
     # -------------------------------------------------------------- finalize
@@ -866,26 +968,40 @@ class BucketEngine:
         fn = jax.jit(shard_map_compat(body, mesh, in_specs=in_specs,
                                       out_specs=out_specs))
         # persistent flat weight (replicated) + optimizer state (sharded).
-        # States seed from the per-key Updater states when present (a
-        # checkpoint resume via load_optimizer_states must not silently
-        # restart momentum at zero), else zeros — what a fresh Updater
-        # would lazily create.
+        # States seed, in priority order, from (1) a preloaded checkpoint
+        # shard (same-W shard-direct resume, mxnet_tpu.checkpoint — this
+        # worker's 1/W slice device_puts straight in, bit-parity by
+        # construction), (2) the per-key Updater states when present (a
+        # resume via load_optimizer_states must not silently restart
+        # momentum at zero), else (3) zeros — what a fresh Updater would
+        # lazily create.
+        preloaded = self._preloaded_shards.pop(spec.index, None)
         states = []
         for i in range(n_states):
-            host = np.zeros((spec.total,), spec.dtype)
-            for s in spec.slots:
-                loaded = self._kv._updater.states.get(s.key)
-                if loaded is None:
-                    continue
-                if n_states > 1 and not isinstance(loaded, (tuple, list)):
-                    continue  # foreign-optimizer state layout: start fresh
-                part = loaded if n_states == 1 else loaded[i]
-                flat_part = np.asarray(part._jax()).reshape(-1)
-                host[s.offset:s.offset + s.size] = \
-                    flat_part[s.src_off:s.src_off + s.size]
+            if preloaded is not None:
+                loc = np.asarray(preloaded[i]).reshape(-1)
+                if loc.shape[0] != shard:
+                    raise MXNetError(
+                        "preloaded checkpoint shard for bucket %d has %d "
+                        "elements, expected %d — plan/world mismatch "
+                        "(the manifest digest guard should have caught this)"
+                        % (spec.index, loc.shape[0], shard))
+                host_local = loc
+            else:
+                host = np.zeros((spec.total,), spec.dtype)
+                for s in spec.slots:
+                    loaded = self._kv._updater.states.get(s.key)
+                    if loaded is None:
+                        continue
+                    if n_states > 1 and not isinstance(loaded, (tuple, list)):
+                        continue  # foreign-optimizer state layout: start fresh
+                    part = loaded if n_states == 1 else loaded[i]
+                    flat_part = np.asarray(part._jax()).reshape(-1)
+                    host[s.offset:s.offset + s.size] = \
+                        flat_part[s.src_off:s.src_off + s.size]
+                host_local = host[coll.rank * shard:(coll.rank + 1) * shard]
             s_local = jax.device_put(
-                jnp.asarray(host[coll.rank * shard:(coll.rank + 1) * shard],
-                            dtype=acc_dt), coll.my_device)
+                jnp.asarray(host_local, dtype=acc_dt), coll.my_device)
             states.append(jax.make_array_from_single_device_arrays(
                 (spec.total,), NamedSharding(mesh, P("worker")), [s_local]))
         self._sharded_state[spec.index] = {

@@ -86,7 +86,12 @@ def save_checkpoint(prefix, epoch, symbol, arg_params, aux_params):
 
     def write():
         try:
-            nd.save(param_name, snap)
+            # temp + os.replace: a crash mid-write leaves the previous
+            # epoch's file intact, never a torn one a later load chokes on
+            from .checkpoint import atomic_replace
+
+            with atomic_replace(param_name) as tmp:
+                nd.save(tmp, snap)
             logging.info('Saved checkpoint to "%s"', param_name)
         except Exception as exc:  # surfaced at the next save/load/find
             logging.error('checkpoint write to "%s" FAILED: %s',
@@ -153,10 +158,15 @@ def resume_or_init(prefix):
 
 
 def load_checkpoint(prefix, epoch):
-    """(reference: model.py:349) → (symbol, arg_params, aux_params)"""
+    """(reference: model.py:349) → (symbol, arg_params, aux_params).
+    A torn/partial params file raises a structured ``MXNetError`` naming
+    the path (checkpoint.load_ndarrays_checked) instead of a raw
+    deserialization error far from the cause."""
+    from .checkpoint import load_ndarrays_checked
+
     _wait_checkpoint_writes(prefix)
     symbol = sym_mod.load("%s-symbol.json" % prefix)
-    save_dict = nd.load("%s-%04d.params" % (prefix, epoch))
+    save_dict = load_ndarrays_checked("%s-%04d.params" % (prefix, epoch))
     arg_params = {}
     aux_params = {}
     for k, v in save_dict.items():

@@ -8,6 +8,8 @@ from .module import Module
 from .bucketing_module import BucketingModule
 from .sequential_module import SequentialModule
 from .python_module import PythonModule, PythonLossModule
+from .elastic import ElasticFit
 
 __all__ = ["BaseModule", "BatchEndParam", "Module", "BucketingModule",
-           "SequentialModule", "PythonModule", "PythonLossModule"]
+           "SequentialModule", "PythonModule", "PythonLossModule",
+           "ElasticFit"]

@@ -202,9 +202,41 @@ class BaseModule:
         num_epoch=None,
         validation_metric=None,
         monitor=None,
+        elastic=None,
     ):
-        """Train over a data iterator (reference: base_module.py:368)."""
+        """Train over a data iterator (reference: base_module.py:368).
+
+        ``elastic`` opts into fault-tolerant training
+        (docs/FAULT_TOLERANCE.md): ``True`` or a dict of ``ElasticFit``
+        knobs (``checkpoint_dir``, ``checkpoint_period``, ``reseed``, ...).
+        The loop then checkpoints asynchronously off the step path and —
+        on an elastic dist job (``MXNET_ELASTIC=1``) — survives worker
+        death by pausing, re-forming the collective over the survivors and
+        resuming. Returns the ``ElasticFit`` controller (check
+        ``.evicted`` on it) instead of None."""
         assert num_epoch is not None, "please specify number of epochs"
+        # explicit None/False test: elastic={} is a valid all-defaults knob
+        # set and must not silently fall through to the classic loop
+        if elastic is not None and elastic is not False:
+            if monitor is not None:
+                raise MXNetError(
+                    "fit(elastic=) does not support monitor= — per-op "
+                    "monitoring and collective re-forms don't mix")
+            from .elastic import ElasticFit
+
+            knobs = dict(elastic) if isinstance(elastic, dict) else {}
+            return ElasticFit(self, **knobs).fit(
+                train_data, eval_data=eval_data, eval_metric=eval_metric,
+                epoch_end_callback=epoch_end_callback,
+                batch_end_callback=batch_end_callback, kvstore=kvstore,
+                optimizer=optimizer, optimizer_params=optimizer_params,
+                eval_end_callback=eval_end_callback,
+                eval_batch_end_callback=eval_batch_end_callback,
+                initializer=initializer, arg_params=arg_params,
+                aux_params=aux_params, allow_missing=allow_missing,
+                force_rebind=force_rebind, force_init=force_init,
+                begin_epoch=begin_epoch, num_epoch=num_epoch,
+                validation_metric=validation_metric)
         from ..initializer import Uniform
 
         if initializer is None:
@@ -285,15 +317,22 @@ class BaseModule:
 
     # ----------------------------------------------------------- persistence
     def save_params(self, fname):
-        """(reference: base_module.py:630)"""
+        """(reference: base_module.py:630). Atomic: temp + ``os.replace``
+        — a crash mid-save leaves the previous file, never a torn one."""
+        from ..checkpoint import atomic_replace
+
         arg_params, aux_params = self.get_params()
         save_dict = {("arg:%s" % k): v.as_in_context(v.context) for k, v in arg_params.items()}
         save_dict.update({("aux:%s" % k): v.as_in_context(v.context) for k, v in aux_params.items()})
-        nd.save(fname, save_dict)
+        with atomic_replace(fname) as tmp:
+            nd.save(tmp, save_dict)
 
     def load_params(self, fname):
-        """(reference: base_module.py:645)"""
-        save_dict = nd.load(fname)
+        """(reference: base_module.py:645). A torn/partial file raises a
+        structured ``MXNetError`` naming ``fname``."""
+        from ..checkpoint import load_ndarrays_checked
+
+        save_dict = load_ndarrays_checked(fname)
         arg_params = {}
         aux_params = {}
         for k, value in save_dict.items():

@@ -439,27 +439,40 @@ class Module(BaseModule):
 
     # ----------------------------------------------------------- persistence
     def save_optimizer_states(self, fname):
+        """Atomic (temp + ``os.replace``) everywhere; the kvstore path
+        routes through ``KVStore.save_optimizer_states`` so the sharded
+        update's 1/W flat shards checkpoint too (a pointer file +
+        digest-guarded shard set, docs/FAULT_TOLERANCE.md)."""
         assert self.optimizer_initialized
+        from ..checkpoint import atomic_write_bytes
+
         if self._spmd is not None:
-            with open(fname, "wb") as f:
-                f.write(self._spmd.get_states())
+            atomic_write_bytes(fname, self._spmd.get_states())
         elif self._update_on_kvstore:
-            with open(fname, "wb") as f:
-                f.write(self._kvstore._updater.get_states())
+            self._kvstore.save_optimizer_states(fname)
         else:
-            with open(fname, "wb") as f:
-                f.write(self._updater.get_states())
+            atomic_write_bytes(fname, self._updater.get_states())
 
     def load_optimizer_states(self, fname):
+        """Inverse of ``save_optimizer_states``; a torn/corrupt file raises
+        a structured ``MXNetError`` naming ``fname``."""
         assert self.optimizer_initialized
+        if self._update_on_kvstore and self._spmd is None:
+            self._kvstore.load_optimizer_states(fname)
+            return
         with open(fname, "rb") as f:
             states = f.read()
-        if self._spmd is not None:
-            self._spmd.set_states(states)
-        elif self._update_on_kvstore:
-            self._kvstore._updater.set_states(states)
-        else:
-            self._updater.set_states(states)
+        try:
+            if self._spmd is not None:
+                self._spmd.set_states(states)
+            else:
+                self._updater.set_states(states)
+        except Exception as e:
+            raise MXNetError(
+                "optimizer-state file %r is torn or not a state pickle "
+                "(%s: %s) — likely a crash mid-save; delete it and resume "
+                "from the previous checkpoint"
+                % (fname, type(e).__name__, e)) from e
 
     def save_checkpoint(self, prefix, epoch, save_optimizer_states=False):
         """(reference: module.py save_checkpoint)"""

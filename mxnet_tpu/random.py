@@ -41,6 +41,28 @@ def seed(seed_state: int):
     np.random.seed(int(seed_state) & 0x7FFFFFFF)
 
 
+def refresh_backend():
+    """Re-materialize the global key on the CURRENT backend (elastic
+    re-form, docs/FAULT_TOLERANCE.md): the key's device buffer belongs to
+    the torn-down backend, and if its last ``split`` dispatched into the
+    failed collective era its definition event is poisoned — the first
+    post-re-form draw would then die with the OLD generation's transport
+    error. A key whose buffer is unreadable is dropped; the next draw
+    re-seeds (weights/optimizer state come from the checkpoint, so RNG
+    continuity across a crash is best-effort by design)."""
+    global _KEY
+    if _KEY is None:
+        return
+    import jax.numpy as jnp
+
+    try:
+        host = np.asarray(_KEY)
+    except Exception:
+        _KEY = None
+        return
+    _KEY = jnp.asarray(host)
+
+
 def uniform(low=0.0, high=1.0, shape=(1,), ctx=None, dtype=np.float32, out=None):
     from .ndarray import imperative_invoke
     from .context import current_context

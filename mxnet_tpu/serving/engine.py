@@ -133,6 +133,7 @@ class InferenceEngine:
         self._stop = False
         self._thread = None
         self._started = False
+        self._fatal = None        # batcher-death latch; see _latch_failure
         self._row_factors = None  # per-output rows-per-item; see start()
 
     # ------------------------------------------------------------ lifecycle
@@ -143,6 +144,11 @@ class InferenceEngine:
     def start(self, warmup=True):
         """Pre-compile every bucket executable (sealing the cache) and
         launch the batcher thread."""
+        if self._fatal is not None:
+            # mirror PrefetchingIter._shutdown: a latched engine stays
+            # failed — restarting a batcher over state a dead thread left
+            # mid-flight would race the executor
+            raise self._fatal
         if self._started:
             return self
         if warmup:
@@ -256,6 +262,10 @@ class InferenceEngine:
             raise
         req = _Request(arrs, rows)
         with self._cond:
+            if self._fatal is not None:
+                # without this latch every future after the batcher's death
+                # would hang forever — fail fast instead
+                raise self._fatal
             if not self._started or self._stop:
                 raise MXNetError("serving: engine is not running "
                                  "(call start(), or already closed)")
@@ -353,19 +363,49 @@ class InferenceEngine:
             r.future.set_result(res)
             off += r.rows
 
+    def _latch_failure(self, exc):
+        """The batcher thread is dying: latch the failure so every pending
+        queued future fails NOW and every later ``submit()``/``start()``
+        raises promptly, instead of hanging forever on a thread that will
+        never drain the queue (the PrefetchingIter._shutdown latch
+        pattern)."""
+        err = MXNetError(
+            "serving: batcher thread of engine %r died: %r — engine "
+            "latched, pending and future requests fail; build a new "
+            "engine" % (self.name, exc))
+        err.__cause__ = exc
+        with self._cond:
+            self._fatal = err
+            pending = list(self._queue)
+            self._queue.clear()
+            self._stop = True
+            self._cond.notify_all()
+        for r in pending:
+            r.future.set_error(err)
+        if _tm.enabled():
+            _tm.counter("serving.batcher_deaths").inc()
+            _tm.gauge("serving.queue_depth").set(0)
+
     def _batcher_loop(self):
-        while True:
-            batch = self._gather()
-            if batch is None:
-                return
-            try:
-                with _tm.span("serving.batch", model=self.name,
-                              requests=len(batch)):
-                    self._dispatch(batch)
-            except BaseException as exc:  # deliver, don't kill the loop
-                err = exc if isinstance(exc, Exception) else \
-                    MXNetError("serving: batcher died: %r" % (exc,))
-                for r in batch:
-                    r.future.set_error(err)
-                if not isinstance(exc, Exception):
-                    raise
+        batch = None
+        try:
+            while True:
+                batch = self._gather()
+                if batch is None:
+                    return
+                try:
+                    with _tm.span("serving.batch", model=self.name,
+                                  requests=len(batch)):
+                        self._dispatch(batch)
+                except Exception as exc:  # deliver, don't kill the loop
+                    for r in batch:
+                        if not r.future.done():
+                            r.future.set_error(exc)
+        except BaseException as exc:
+            # anything that escapes the loop kills the thread: a
+            # non-Exception from dispatch, a bug in _gather/slicing, OOM
+            for r in batch or ():
+                if not r.future.done():
+                    r.future.set_error(exc)
+            self._latch_failure(exc)
+            raise

@@ -1,0 +1,271 @@
+"""Elastic fault-tolerance chaos smoke (docs/FAULT_TOLERANCE.md).
+
+Orchestrated end-to-end (tools/ci_check.sh):
+
+    python tests/nightly/dist_elastic_chaos.py --orchestrate <workdir>
+
+which runs three phases:
+
+  1. **chaos** — ``tools/launch.py -n 8 --elastic``: 8 workers run
+     ``Module.fit(elastic=...)`` in sharded-update mode with periodic async
+     checkpoints; worker ORIGINAL RANK 7 SIGTERMs itself mid-run. The drain
+     protocol kicks in: rank 7 proposes the pause, everyone trains through
+     the agreed round, rank 7 exits cleanly (rc 0), the 7 survivors re-form,
+     reseed from the newest complete sharded checkpoint (``reseed=
+     "checkpoint"`` pins the deterministic-rollback path), rescale the
+     gradient normalization 8→7 and finish training. Rank 0 writes the
+     final weights + a report (generation, world, reseed step, telemetry).
+  2. **control** — a FRESH 7-worker elastic job pointed at a pruned copy of
+     the checkpoint dir containing exactly the step the survivors reseeded
+     from. It takes the different-W resume path (manifest world=8, live
+     world=7), fast-forwards its iterator to the recorded position and
+     trains the same remaining rounds.
+  3. **compare** — chaos-survivor weights must match the control run's
+     within fp32 tolerance: provable only if the re-form really reseeded
+     from the checkpoint and replayed identically.
+
+Also asserts: the ``checkpoint.inflight`` gauge was observed > 0 while
+training (the async write really overlaps the step), the job re-formed to
+generation 1 / world 7, and the evicted worker exited rc 0.
+"""
+import argparse
+import json
+import os
+import shutil
+import signal
+import subprocess
+import sys
+import threading
+import time
+
+import numpy as np
+
+sys.path.insert(0, os.path.abspath(
+    os.path.join(os.path.dirname(__file__), "..", "..")))
+
+EPOCHS = 2
+BATCHES = 12          # per epoch per worker
+BATCH = 8
+KILL_ROUND = 8        # rank 7 SIGTERMs itself after this many updates
+CKPT_PERIOD = 3
+
+
+def _mlp():
+    import mxnet_tpu as mx
+
+    sym = mx.sym.Variable("data")
+    sym = mx.sym.FullyConnected(sym, num_hidden=32, name="fc1")
+    sym = mx.sym.Activation(sym, act_type="relu")
+    sym = mx.sym.FullyConnected(sym, num_hidden=16, name="fc2")
+    sym = mx.sym.Activation(sym, act_type="relu")
+    sym = mx.sym.FullyConnected(sym, num_hidden=4, name="fc3")
+    return mx.sym.SoftmaxOutput(sym, name="softmax")
+
+
+def _data(orig_rank):
+    import mxnet_tpu as mx
+
+    rs = np.random.RandomState(100 + orig_rank)
+    x = rs.rand(BATCHES * BATCH, 8).astype("float32")
+    y = rs.randint(0, 4, (BATCHES * BATCH,)).astype("float32")
+    return mx.io.NDArrayIter(x, y, batch_size=BATCH)
+
+
+def run_worker(args):
+    if os.environ.get("MXNET_CHAOS_VERBOSE"):
+        import logging
+        logging.basicConfig(
+            level=logging.INFO,
+            format="[w%(process)d] %(levelname)s %(message)s")
+    os.environ.setdefault("MXNET_TELEMETRY", "counters")
+    os.environ.setdefault("MXNET_KVSTORE_BUCKET_MB", "0.002")
+    os.environ.setdefault("MXNET_KVSTORE_UPDATE", "sharded")
+    import mxnet_tpu as mx
+    from mxnet_tpu import dist, telemetry
+
+    kv_type = "dist_tpu_sync"
+    mx.kv.create(kv_type)  # triggers dist.init under the launcher env
+    orig = dist.orig_rank() if dist.elastic_enabled() else 0
+    launch_world = int(os.environ.get("MXNET_TPU_NUM_WORKERS", "1"))
+    kill_rank = launch_world - 1
+    args.mode = os.environ.get("MXNET_CHAOS_MODE", "drain")
+
+    # sample the checkpoint.inflight gauge while training: the async write
+    # must OVERLAP the step (acceptance: observed > 0 mid-run)
+    peak = {"inflight": 0.0}
+    stop = threading.Event()
+
+    def sample():
+        g = telemetry.gauge("checkpoint.inflight")
+        while not stop.is_set():
+            v = g.value
+            if v:
+                peak["inflight"] = max(peak["inflight"], v)
+            time.sleep(0.0005)
+
+    sampler = threading.Thread(target=sample, daemon=True)
+    sampler.start()
+
+    seen = {"rounds": 0}
+
+    def batch_cb(param):
+        seen["rounds"] += 1
+        if (args.phase == "chaos" and orig == kill_rank
+                and seen["rounds"] == KILL_ROUND):
+            if args.mode == "crash":
+                # hard death: no drain, no pause proposal — the survivors
+                # must detect the broken collective, wait out the
+                # heartbeat staleness, and recover from the checkpoint
+                print("worker %d SIGKILLing itself at round %d"
+                      % (orig, seen["rounds"]), flush=True)
+                os.kill(os.getpid(), signal.SIGKILL)
+            print("worker %d SIGTERMing itself at round %d"
+                  % (orig, seen["rounds"]), flush=True)
+            os.kill(os.getpid(), signal.SIGTERM)
+
+    mod = mx.mod.Module(_mlp(), context=mx.cpu(), fused_step=False)
+    ctl = mod.fit(
+        _data(orig), num_epoch=EPOCHS, kvstore=kv_type,
+        optimizer="sgd",
+        optimizer_params=(("learning_rate", 0.05), ("momentum", 0.9),
+                          ("wd", 1e-4)),
+        batch_end_callback=batch_cb,
+        elastic={"checkpoint_dir": args.ckpt_dir,
+                 "checkpoint_period": CKPT_PERIOD,
+                 "reseed": "checkpoint",
+                 "resume": args.phase == "control"})
+    stop.set()
+    if ctl.evicted:
+        print("worker %d evicted cleanly at round %d" % (orig, ctl._round),
+              flush=True)
+        return 0
+
+    rank, world = dist.rank(), dist.num_workers()
+    gen = dist.generation()
+    if args.phase == "chaos":
+        assert world == launch_world - 1, \
+            "expected %d survivors, got %d" % (launch_world - 1, world)
+        assert gen == 1, "expected generation 1, got %d" % gen
+    arg_params, _ = mod.get_params()
+    if rank == 0:
+        out = os.path.join(args.workdir, "%s_final.npz" % args.phase)
+        np.savez(out, **{k: v.asnumpy() for k, v in arg_params.items()})
+        report = {
+            "phase": args.phase, "world": world, "generation": gen,
+            "rounds": ctl._round,
+            "resume_round": ctl._resume_epoch,
+            "peak_inflight": peak["inflight"],
+            "checkpoint_saves":
+                telemetry.counter("checkpoint.saves").value,
+            "recoveries": telemetry.counter("dist.recoveries").value,
+        }
+        with open(os.path.join(args.workdir,
+                               "%s_report.json" % args.phase), "w") as f:
+            json.dump(report, f)
+        print(json.dumps(report), flush=True)
+    return 0
+
+
+# --------------------------------------------------------------- orchestrate
+def _launch(n, phase, workdir, ckpt_dir, extra_env=None):
+    env = dict(os.environ)
+    env.setdefault("JAX_PLATFORMS", "cpu")
+    env.update(extra_env or {})
+    cmd = [sys.executable, os.path.join(os.path.dirname(__file__), "..",
+                                        "..", "tools", "launch.py"),
+           "-n", str(n), "--launcher", "local", "--cpu-devices", "1",
+           "--elastic",
+           sys.executable, os.path.abspath(__file__),
+           "--phase", phase, "--workdir", workdir, "--ckpt-dir", ckpt_dir]
+    t0 = time.time()
+    rc = subprocess.call(cmd, env=env)
+    print("[chaos] phase %s: rc=%d in %.1fs" % (phase, rc, time.time() - t0),
+          flush=True)
+    return rc
+
+
+def orchestrate(workdir, world=8, mode="drain"):
+    sys.path.insert(0, os.path.join(os.path.dirname(__file__), "..", ".."))
+    from mxnet_tpu import checkpoint as ckpt
+
+    os.makedirs(workdir, exist_ok=True)
+    chaos_ckpt = os.path.join(workdir, "ckpt")
+    extra_env = {"MXNET_CHAOS_MODE": mode}
+    if mode == "crash":
+        # a SIGKILLed worker is detected by heartbeat staleness, not a
+        # drain proposal — tighten the staleness window so the smoke
+        # doesn't sit through the 60 s production default
+        extra_env.update({"MXNET_ELASTIC_DEAD_TIMEOUT": "4",
+                          "MXNET_TPU_HEARTBEAT_INTERVAL": "0.5"})
+    rc = _launch(world, "chaos", workdir, chaos_ckpt, extra_env=extra_env)
+    assert rc == 0, "chaos phase failed rc=%d" % rc
+    report_path = os.path.join(workdir, "chaos_report.json")
+    assert os.path.exists(report_path), (
+        "chaos phase exited rc=0 but wrote no report — every worker took "
+        "the evicted path instead of re-forming (pause payload named the "
+        "survivors dead?)")
+    report = json.load(open(report_path))
+    assert report["world"] == world - 1 and report["generation"] == 1, report
+    assert report["recoveries"] >= 1, report
+    assert report["peak_inflight"] > 0, (
+        "checkpoint.inflight gauge never observed > 0 — the async write "
+        "did not overlap the step (report: %s)" % report)
+
+    # the survivors reseeded from the newest complete checkpoint with a
+    # launch-world manifest; give the control run EXACTLY that step
+    steps = [s for s in ckpt.list_steps(chaos_ckpt)
+             if (ckpt.load_manifest(chaos_ckpt, s) or {}).get("world")
+             == world]
+    assert steps, "no world-%d checkpoint left under %s" % (world, chaos_ckpt)
+    reseed_step = None
+    for s in reversed(steps):
+        m = ckpt.load_manifest(chaos_ckpt, s)
+        if m and ckpt._step_complete(chaos_ckpt, s, m):
+            reseed_step = s
+            break
+    assert reseed_step is not None, "no COMPLETE world-%d step" % world
+    control_ckpt = os.path.join(workdir, "ckpt-control")
+    shutil.rmtree(control_ckpt, ignore_errors=True)
+    os.makedirs(control_ckpt)
+    shutil.copytree(ckpt.step_dir(chaos_ckpt, reseed_step),
+                    ckpt.step_dir(control_ckpt, reseed_step))
+
+    rc = _launch(world - 1, "control", workdir, control_ckpt)
+    assert rc == 0, "control phase failed rc=%d" % rc
+
+    chaos = np.load(os.path.join(workdir, "chaos_final.npz"))
+    control = np.load(os.path.join(workdir, "control_final.npz"))
+    assert set(chaos.files) == set(control.files)
+    for k in chaos.files:
+        np.testing.assert_allclose(
+            chaos[k], control[k], atol=1e-6, rtol=0,
+            err_msg="post-recovery weight divergence on %r: the re-formed "
+                    "run does not match an uninterrupted %d-proc run" %
+                    (k, report["world"]))
+    print(json.dumps({"dist_elastic_chaos": "OK",
+                      "reseed_step": reseed_step,
+                      "peak_inflight": report["peak_inflight"],
+                      "survivor_world": report["world"]}), flush=True)
+
+
+def main():
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--orchestrate", metavar="WORKDIR", default=None)
+    ap.add_argument("--world", type=int, default=8,
+                    help="chaos-phase worker count (control runs world-1)")
+    ap.add_argument("--mode", choices=["drain", "crash"], default="drain",
+                    help="drain = worker SIGTERMs itself (pause proposal); "
+                         "crash = SIGKILL (survivors detect the broken "
+                         "collective + stale heartbeat)")
+    ap.add_argument("--phase", choices=["chaos", "control"], default=None)
+    ap.add_argument("--workdir", default=None)
+    ap.add_argument("--ckpt-dir", dest="ckpt_dir", default=None)
+    args = ap.parse_args()
+    if args.orchestrate:
+        orchestrate(args.orchestrate, world=args.world, mode=args.mode)
+        return
+    sys.exit(run_worker(args))
+
+
+if __name__ == "__main__":
+    main()

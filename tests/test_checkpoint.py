@@ -1,0 +1,453 @@
+"""Sharded async checkpointing (mxnet_tpu/checkpoint.py,
+docs/FAULT_TOLERANCE.md): atomic write helpers, torn-file armor, the
+manifest/shard completeness contract, re-flattening (the different-W
+resume seed), retention, the async writer's supersede/latch behavior, and
+the classic save_checkpoint/optimizer-state atomicity satellites."""
+import json
+import os
+import pickle
+import threading
+import time
+
+import numpy as np
+import pytest
+
+import mxnet_tpu as mx
+from mxnet_tpu import checkpoint as ckpt
+from mxnet_tpu import telemetry
+from mxnet_tpu.base import MXNetError
+
+
+@pytest.fixture
+def tm():
+    telemetry.reset()
+    telemetry.clear_events()
+    saved = telemetry.current_override()
+    telemetry.set_mode("counters")
+    yield telemetry
+    telemetry.set_mode(saved)
+    telemetry.reset()
+    telemetry.clear_events()
+
+
+# ------------------------------------------------------------ atomic writes
+def test_atomic_write_bytes_replaces_whole_file(tmp_path):
+    p = str(tmp_path / "f.bin")
+    ckpt.atomic_write_bytes(p, b"one")
+    ckpt.atomic_write_bytes(p, b"two")
+    assert open(p, "rb").read() == b"two"
+    assert not [f for f in os.listdir(tmp_path) if ".tmp." in f]
+
+
+def test_atomic_replace_keeps_old_file_on_error(tmp_path):
+    p = str(tmp_path / "f.bin")
+    ckpt.atomic_write_bytes(p, b"good")
+    with pytest.raises(RuntimeError):
+        with ckpt.atomic_replace(p) as tmp:
+            with open(tmp, "wb") as f:
+                f.write(b"half-writ")
+            raise RuntimeError("crash mid-save")
+    assert open(p, "rb").read() == b"good"
+    assert not [f for f in os.listdir(tmp_path) if ".tmp." in f]
+
+
+def test_load_ndarrays_checked_torn_file_names_path(tmp_path):
+    p = str(tmp_path / "torn.params")
+    good = str(tmp_path / "good.params")
+    mx.nd.save(good, {"w": mx.nd.ones((3,))})
+    blob = open(good, "rb").read()
+    with open(p, "wb") as f:
+        f.write(blob[: len(blob) // 2])  # torn mid-write
+    with pytest.raises(MXNetError, match="torn.params"):
+        ckpt.load_ndarrays_checked(p)
+
+
+def test_model_load_checkpoint_torn_params_structured(tmp_path):
+    """model.load_checkpoint of a torn params file raises a structured
+    error naming the path, not a raw deserialization error."""
+    from mxnet_tpu import model
+
+    prefix = str(tmp_path / "ck")
+    sym = mx.sym.SoftmaxOutput(mx.sym.FullyConnected(
+        mx.sym.Variable("data"), num_hidden=2, name="fc"), name="softmax")
+    model.save_checkpoint(prefix, 1, sym,
+                          {"fc_weight": mx.nd.ones((2, 4))}, {})
+    mx.nd.waitall()
+    path = prefix + "-0001.params"
+    blob = open(path, "rb").read()
+    with open(path, "wb") as f:
+        f.write(blob[: len(blob) // 2])
+    with pytest.raises(MXNetError, match="0001.params"):
+        model.load_checkpoint(prefix, 1)
+
+
+def test_module_optimizer_states_atomic_and_checked(tmp_path):
+    """Module.save_optimizer_states writes atomically; loading a torn
+    state file raises a structured error naming the path."""
+    sym = mx.sym.SoftmaxOutput(mx.sym.FullyConnected(
+        mx.sym.Variable("data"), num_hidden=2, name="fc"), name="softmax")
+    mod = mx.mod.Module(sym, context=mx.cpu(), fused_step=False)
+    it = mx.io.NDArrayIter(np.random.RandomState(0).rand(8, 4).astype("f"),
+                           np.zeros((8,), "f"), batch_size=4)
+    mod.fit(it, num_epoch=1, optimizer="sgd", kvstore="local",
+            optimizer_params=(("learning_rate", 0.1), ("momentum", 0.9)))
+    p = str(tmp_path / "opt.states")
+    mod.save_optimizer_states(p)
+    assert not [f for f in os.listdir(tmp_path) if ".tmp." in f]
+    with open(p, "wb") as f:
+        f.write(b"\x80\x04 torn!")
+    with pytest.raises(MXNetError, match="opt.states"):
+        mod.load_optimizer_states(p)
+
+
+# --------------------------------------------------- manifest / completeness
+def _write_fake_sharded_step(root, step, world=2, n_states=1, seed=0,
+                             extra_files=(), break_shard=None,
+                             skip_manifest=False):
+    """Handcraft a minimal sharded checkpoint step: one bucket, two keys
+    (key 1 split across nothing — single part), flat total divisible by
+    world. Returns the per-key host arrays the shards encode."""
+    rs = np.random.RandomState(seed)
+    k0, k1 = rs.rand(4).astype("f"), rs.rand(2, 3).astype("f")
+    flat_w = np.concatenate([k0, k1.reshape(-1)])  # total 10 % 2 == 0
+    states = [np.arange(10, dtype="f") * (i + 1) for i in range(n_states)]
+    d = ckpt.step_dir(root, step)
+    os.makedirs(d, exist_ok=True)
+    shard = 10 // world
+    for r in range(world):
+        arrays = {"b0.w": flat_w[r * shard:(r + 1) * shard]}
+        for i, s in enumerate(states):
+            arrays["b0.s%d" % i] = s[r * shard:(r + 1) * shard]
+        buf = ckpt._npz_bytes(arrays)
+        base = os.path.join(d, "shard-%05d-of-%05d" % (r, world))
+        data = buf if break_shard != r else buf[: len(buf) // 2]
+        ckpt.atomic_write_bytes(base + ".npz", data)
+        ckpt.atomic_write_bytes(base + ".json", json.dumps(
+            {"digest": ckpt._sha256(buf), "rank": r, "world": world,
+             "step": step, "plan_hash": "fakehash", "nbytes": len(buf)}
+        ).encode())
+    manifest = {
+        "format": ckpt.FORMAT_VERSION, "kind": "sharded", "step": step,
+        "world": world, "plan_hash": "fakehash",
+        "plan": {"buckets": [{
+            "index": 0, "dtype": "float32",
+            "slots": [[0, 0, 4, [4], "float32", 0, 0, 1],
+                      [1, 4, 6, [2, 3], "float32", 0, 0, 1]]}]},
+        "optimizer": {"kind": "sgd", "n_states": n_states,
+                      "hyper": {}, "class": "SGD"},
+        "update_counts": [[0, 7], [1, 7]], "num_update": 7,
+        "files": sorted(extra_files), "meta": {}, "written_at": time.time(),
+    }
+    if not skip_manifest:
+        ckpt.atomic_write_bytes(os.path.join(d, ckpt.MANIFEST_NAME),
+                                json.dumps(manifest).encode())
+    return {0: k0, 1: k1}, states
+
+
+def test_latest_complete_skips_incomplete_steps(tmp_path):
+    root = str(tmp_path)
+    _write_fake_sharded_step(root, 10)
+    _write_fake_sharded_step(root, 20, skip_manifest=True)   # no commit mark
+    got = ckpt.latest_complete(root)
+    assert got is not None and got[0] == 10
+
+
+def test_latest_complete_rejects_missing_shard(tmp_path):
+    root = str(tmp_path)
+    _write_fake_sharded_step(root, 10)
+    _write_fake_sharded_step(root, 20)
+    os.unlink(os.path.join(ckpt.step_dir(root, 20),
+                           "shard-00001-of-00002.npz"))
+    assert ckpt.latest_complete(root)[0] == 10
+
+
+def test_read_flat_buckets_and_per_key_states_roundtrip(tmp_path):
+    root = str(tmp_path)
+    keys, states = _write_fake_sharded_step(root, 5, n_states=2)
+    step, manifest = ckpt.latest_complete(root)
+    flats = ckpt.read_flat_buckets(root, step, manifest)
+    np.testing.assert_array_equal(flats[0]["states"][0], states[0])
+    per_key = ckpt.per_key_states(manifest, flats)
+    assert set(per_key) == {0, 1}
+    assert per_key[1][1].shape == (2, 3)  # state slot 1 of key 1
+    weights = ckpt.per_key_states(manifest, flats, weights=True)
+    np.testing.assert_array_equal(weights[0], keys[0])
+    np.testing.assert_array_equal(weights[1], keys[1])
+
+
+def test_torn_shard_fails_digest_with_structured_error(tmp_path):
+    root = str(tmp_path)
+    _write_fake_sharded_step(root, 5, break_shard=1)
+    manifest = ckpt.load_manifest(root, 5)
+    with pytest.raises(MXNetError, match="digest|corrupt"):
+        ckpt.read_local_shard(root, 5, manifest, 1)
+    # a reader asking for the newest COMPLETE step never sees the torn one
+    assert ckpt.latest_complete(root) is None
+
+
+def test_read_sharded_pointer(tmp_path):
+    p = str(tmp_path / "opt.states")
+    ckpt.atomic_write_bytes(p, json.dumps(
+        {"format": "mxtpu-sharded-states", "dir": "/x", "step": 3}).encode())
+    got = ckpt.read_sharded_pointer(p)
+    assert got["step"] == 3 and got["dir"] == "/x"
+    ckpt.atomic_write_bytes(p, pickle.dumps({"classic": "blob"}))
+    assert ckpt.read_sharded_pointer(p) is None
+    assert ckpt.read_sharded_pointer(str(tmp_path / "absent")) is None
+
+
+# ------------------------------------------------------------ async writer
+def test_checkpointer_async_write_and_wait(tmp_path, tm):
+    w = ckpt.Checkpointer(str(tmp_path), async_=True)
+    job = w.save_replicated(3, {"w": np.ones((4,), "f")},
+                            meta={"epoch": 0}, block=False)
+    w.wait()
+    assert job.error is None
+    step, manifest = ckpt.latest_complete(str(tmp_path))
+    assert step == 3 and manifest["kind"] == "replicated"
+    blob = ckpt._load_npz_checked(
+        os.path.join(ckpt.step_dir(str(tmp_path), 3), "weights.npz"))
+    np.testing.assert_array_equal(blob["w"], np.ones((4,), "f"))
+
+
+def test_checkpointer_supersede_drops_queued_job(tmp_path, tm):
+    """A newer save supersedes a QUEUED (not-yet-started) one: only the
+    newest matters under failure recovery, so the stale write is dropped
+    (checkpoint.drops) instead of wasting the I/O budget."""
+    w = ckpt.Checkpointer(str(tmp_path), async_=True)
+    gate = threading.Event()
+    w._submit(gate.wait, step=1, block=False)   # writer busy until released
+    w.save_replicated(2, {"w": np.zeros((2,), "f")}, block=False)
+    w.save_replicated(3, {"w": np.ones((2,), "f")}, block=False)  # drops 2
+    gate.set()
+    w.wait()
+    assert telemetry.counter("checkpoint.drops").value == 1
+    steps = ckpt.list_steps(str(tmp_path))
+    assert 3 in steps and 2 not in steps
+
+
+def test_checkpointer_failure_latches_to_next_save(tmp_path):
+    w = ckpt.Checkpointer(str(tmp_path), async_=True)
+
+    def boom():
+        raise RuntimeError("disk on fire")
+
+    w._submit(boom, step=1, block=False)
+    deadline = time.time() + 10
+    while w._error is None and time.time() < deadline:
+        time.sleep(0.01)
+    with pytest.raises(MXNetError, match="disk on fire"):
+        w.save_replicated(2, {"w": np.zeros((2,), "f")}, block=False)
+    # the latch clears once raised; the next save goes through
+    w.save_replicated(3, {"w": np.zeros((2,), "f")}, block=True)
+    assert ckpt.latest_complete(str(tmp_path))[0] == 3
+
+
+def test_checkpointer_close_stops_thread_even_on_latched_failure(tmp_path):
+    """close() must stop the writer thread when the final drain re-raises
+    a latched write failure (it used to leak one daemon thread per failed
+    fit)."""
+    w = ckpt.Checkpointer(str(tmp_path), async_=True)
+
+    def boom():
+        raise RuntimeError("disk on fire")
+
+    w._submit(boom, step=1, block=False)
+    deadline = time.time() + 10
+    while w._error is None and time.time() < deadline:
+        time.sleep(0.01)
+    t = w._thread
+    assert t is not None and t.is_alive()
+    with pytest.raises(MXNetError, match="disk on fire"):
+        w.close()
+    t.join(timeout=10)
+    assert not t.is_alive() and w._thread is None
+    # close() is restartable: a later save spins a fresh thread and lands
+    w.save_replicated(2, {"w": np.zeros((2,), "f")}, block=True)
+    assert ckpt.latest_complete(str(tmp_path))[0] == 2
+
+
+def test_checkpoint_inflight_gauge_set_while_queued(tmp_path, tm):
+    w = ckpt.Checkpointer(str(tmp_path), async_=True)
+    gate = threading.Event()
+    w._submit(gate.wait, step=1, block=False)
+    deadline = time.time() + 5
+    while telemetry.gauge("checkpoint.inflight").value in (None, 0) \
+            and time.time() < deadline:
+        time.sleep(0.005)
+    assert telemetry.gauge("checkpoint.inflight").value >= 1
+    gate.set()
+    w.wait()
+    assert telemetry.gauge("checkpoint.inflight").value == 0
+
+
+# --------------------------------------------------------------- retention
+def test_apply_retention_keeps_newest_complete_and_protected(tmp_path):
+    root = str(tmp_path)
+    for s in (1, 2, 3, 4):
+        _write_fake_sharded_step(root, s)
+    victims = ckpt.apply_retention(root, keep=2, protect_step=1)
+    assert sorted(victims) == [2]
+    assert sorted(ckpt.list_steps(root)) == [1, 3, 4]
+
+
+def test_prefix_retention_spares_newest_complete_manifest(tmp_path):
+    """keep-last-K for classic epoch checkpoints: the newest COMPLETE
+    epoch survives even outside the keep window, and a sharded .states
+    pointer's backing shard set is (a) checked for completeness and (b)
+    removed together with its epoch."""
+    prefix = str(tmp_path / "run")
+    shard_root = str(tmp_path / "run-0002.states.sharded")
+    _write_fake_sharded_step(shard_root, 7)
+    for ep in (1, 2, 3, 4):
+        ckpt.atomic_write_bytes("%s-%04d.params" % (prefix, ep), b"P")
+    ckpt.atomic_write_bytes("%s-0002.states" % prefix, json.dumps(
+        {"format": "mxtpu-sharded-states", "dir": shard_root,
+         "step": 7}).encode())
+    # epochs 3 and 4 have BROKEN sharded pointers -> incomplete
+    for ep in (3, 4):
+        ckpt.atomic_write_bytes("%s-%04d.states" % (prefix, ep), json.dumps(
+            {"format": "mxtpu-sharded-states",
+             "dir": str(tmp_path / "nope"), "step": 1}).encode())
+    victims = ckpt.prefix_retention(prefix, keep=1)
+    # epoch 2 is the newest COMPLETE (pointer target complete) -> spared;
+    # epochs 1 and 3 fall out of the window, 4 stays (last K)
+    assert sorted(victims) == [1, 3]
+    assert os.path.exists("%s-0002.params" % prefix)
+    assert os.path.exists(ckpt.step_dir(shard_root, 7))
+    victims = ckpt.prefix_retention(prefix, keep=0 or None)
+    assert victims == []  # keep=None -> unlimited, no deletions
+
+
+def test_prefix_retention_removes_sharded_backing_dir(tmp_path):
+    prefix = str(tmp_path / "run")
+    shard_root = str(tmp_path / "run-0001.states.sharded")
+    _write_fake_sharded_step(shard_root, 3)
+    for ep in (1, 2, 3):
+        ckpt.atomic_write_bytes("%s-%04d.params" % (prefix, ep), b"P")
+    ckpt.atomic_write_bytes("%s-0001.states" % prefix, json.dumps(
+        {"format": "mxtpu-sharded-states", "dir": shard_root,
+         "step": 3}).encode())
+    victims = ckpt.prefix_retention(prefix, keep=1)
+    assert 1 in victims
+    assert not os.path.exists(shard_root)
+
+
+def test_module_checkpoint_callback_retention(tmp_path):
+    """callback.module_checkpoint(keep=K) prunes old epochs as it saves."""
+    sym = mx.sym.SoftmaxOutput(mx.sym.FullyConnected(
+        mx.sym.Variable("data"), num_hidden=2, name="fc"), name="softmax")
+    mod = mx.mod.Module(sym, context=mx.cpu(), fused_step=False)
+    it = mx.io.NDArrayIter(np.random.RandomState(0).rand(8, 4).astype("f"),
+                           np.zeros((8,), "f"), batch_size=4)
+    cb = mx.callback.module_checkpoint(mod, str(tmp_path / "m"), keep=2)
+    mod.fit(it, num_epoch=5, optimizer="sgd", kvstore="local",
+            epoch_end_callback=cb)
+    import glob
+
+    left = sorted(glob.glob(str(tmp_path / "m-*.params")))
+    assert len(left) == 2 and left[-1].endswith("m-0005.params")
+
+
+def test_callback_negative_keep_disables_retention(tmp_path):
+    """An explicit non-positive keep= warns and disables retention (same
+    contract as MXNET_CHECKPOINT_KEEP) instead of mis-slicing epochs."""
+    from mxnet_tpu.callback import _apply_keep
+
+    prefix = str(tmp_path / "m")
+    for ep in (1, 2, 3):
+        with open("%s-%04d.params" % (prefix, ep), "wb") as f:
+            f.write(b"x")
+    _apply_keep(prefix, -1)
+    import glob
+
+    assert len(glob.glob(prefix + "-*.params")) == 3  # nothing deleted
+
+
+def test_checkpoint_keep_env_parsing(monkeypatch):
+    monkeypatch.delenv("MXNET_CHECKPOINT_KEEP", raising=False)
+    assert ckpt.checkpoint_keep() is None
+    monkeypatch.setenv("MXNET_CHECKPOINT_KEEP", "4")
+    assert ckpt.checkpoint_keep() == 4
+    monkeypatch.setenv("MXNET_CHECKPOINT_KEEP", "-1")
+    assert ckpt.checkpoint_keep() is None
+    monkeypatch.setenv("MXNET_CHECKPOINT_KEEP", "lots")
+    assert ckpt.checkpoint_keep() is None
+
+
+# ------------------------------------------------------- single-proc elastic
+def test_elastic_fit_single_process_checkpoints_and_resumes(tmp_path):
+    """fit(elastic=...) on a single process: periodic replicated
+    checkpoints land asynchronously with step metadata, and a second fit
+    resumes from the newest complete one (weights bit-equal at the
+    resume point, iterator fast-forwarded)."""
+    sym = mx.sym.SoftmaxOutput(mx.sym.FullyConnected(
+        mx.sym.Variable("data"), num_hidden=3, name="fc"), name="softmax")
+    rs = np.random.RandomState(0)
+    x = rs.rand(24, 4).astype("f")
+    y = rs.randint(0, 3, (24,)).astype("f")
+    root = str(tmp_path / "ck")
+
+    mod = mx.mod.Module(sym, context=mx.cpu(), fused_step=False)
+    ctl = mod.fit(mx.io.NDArrayIter(x, y, batch_size=4), num_epoch=2,
+                  optimizer="sgd", kvstore="local",
+                  optimizer_params=(("learning_rate", 0.05),
+                                    ("momentum", 0.9)),
+                  elastic={"checkpoint_dir": root, "checkpoint_period": 4})
+    assert not ctl.evicted and ctl._round == 12
+    step, manifest = ckpt.latest_complete(root)
+    assert step == 12 and manifest["meta"]["epoch"] == 1
+    w_full = {k: v.asnumpy() for k, v in mod.get_params()[0].items()}
+
+    # resumed run picks up at the recorded (epoch, nbatch) and matches
+    mod2 = mx.mod.Module(sym, context=mx.cpu(), fused_step=False)
+    ctl2 = mod2.fit(mx.io.NDArrayIter(x, y, batch_size=4), num_epoch=2,
+                    optimizer="sgd", kvstore="local",
+                    optimizer_params=(("learning_rate", 0.05),
+                                      ("momentum", 0.9)),
+                    elastic={"checkpoint_dir": root,
+                             "checkpoint_period": 0, "resume": True})
+    assert ctl2._round == 12
+    w_res = {k: v.asnumpy() for k, v in mod2.get_params()[0].items()}
+    for k in w_full:
+        np.testing.assert_array_equal(w_res[k], w_full[k])
+
+
+def test_elastic_fit_fused_spmd_saves_and_restores_optimizer_state(
+        tmp_path, monkeypatch):
+    """The fused SPMD step owns the optimizer state (no kv._updater):
+    elastic checkpointing must capture it via mod._spmd.get_states() and a
+    resume must restore momentum — a resumed run matches an uninterrupted
+    one instead of silently restarting momentum at zero."""
+    monkeypatch.setenv("MXNET_MODULE_FUSED_STEP", "1")
+    sym = mx.sym.SoftmaxOutput(mx.sym.FullyConnected(
+        mx.sym.Variable("data"), num_hidden=3, name="fc"), name="softmax")
+    rs = np.random.RandomState(0)
+    x = rs.rand(24, 4).astype("f")
+    y = rs.randint(0, 3, (24,)).astype("f")
+    BATCHES = 6
+
+    def fit(root, num_epoch, resume):
+        mx.random.seed(7)
+        mod = mx.mod.Module(sym, context=mx.cpu())  # fused_step default
+        mod.fit(mx.io.NDArrayIter(x, y, batch_size=4), num_epoch=num_epoch,
+                optimizer="sgd", kvstore="local",
+                optimizer_params=(("learning_rate", 0.1),
+                                  ("momentum", 0.9)),
+                elastic={"checkpoint_dir": root,
+                         "checkpoint_period": BATCHES, "resume": resume})
+        assert mod._spmd is not None, "fused path did not engage"
+        return {k: v.asnumpy() for k, v in mod.get_params()[0].items()}
+
+    root = str(tmp_path / "ck")
+    fit(root, 2, False)
+    step, _ = ckpt.latest_complete(root)
+    assert os.path.exists(os.path.join(ckpt.step_dir(root, step),
+                                       "states.bin")), \
+        "fused SPMD optimizer state missing from the checkpoint"
+    resumed = fit(root, 4, True)
+    reference = fit(str(tmp_path / "ck-ref"), 4, False)
+    for k in reference:
+        np.testing.assert_allclose(
+            resumed[k], reference[k], atol=1e-6, rtol=0,
+            err_msg="momentum lost across fused-SPMD resume on %s" % k)

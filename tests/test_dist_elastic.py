@@ -1,0 +1,468 @@
+"""Elastic fault tolerance (mxnet_tpu/dist.py elastic layer,
+docs/FAULT_TOLERANCE.md): num_dead_nodes edge cases (clock skew, grace
+boundary, dir races, transition counter), heartbeat drain, membership-plan
+validation (coordinator death / min-workers / self-eviction), and the
+2-process sharded optimizer-state save/load parity contract."""
+import json
+import os
+import subprocess
+import sys
+import time
+
+import numpy as np
+import pytest
+
+import mxnet_tpu as mx  # noqa: F401
+from mxnet_tpu import dist, telemetry
+from mxnet_tpu.base import EvictedError, MXNetError
+
+ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+@pytest.fixture
+def hb(tmp_path, monkeypatch):
+    """A heartbeat dir with 2 configured workers and a pinned job-start
+    anchor; yields (dir, touch(rank, age))."""
+    d = str(tmp_path / "hb")
+    os.makedirs(d)
+    monkeypatch.setenv("MXNET_TPU_HEARTBEAT_DIR", d)
+    monkeypatch.setenv("MXNET_TPU_NUM_WORKERS", "2")
+    monkeypatch.setattr(dist, "_start_time", time.time() - 3600)
+    monkeypatch.setattr(dist, "_last_dead", 0)
+    # the dir itself was just created; its mtime must not re-anchor the
+    # grace window forward of the pinned start
+    old = time.time() - 3600
+    os.utime(d, (old, old))
+
+    def touch(rank, age=0.0):
+        path = os.path.join(d, "worker-%d" % rank)
+        with open(path, "a"):
+            pass
+        t = time.time() - age
+        os.utime(path, (t, t))
+
+    return d, touch
+
+
+# ------------------------------------------------------------ num_dead_nodes
+def test_dead_nodes_future_mtime_is_alive(hb):
+    """Clock skew: a heartbeat file stamped in the FUTURE (NFS/skewed
+    writer) has negative age and must count alive, not dead."""
+    _, touch = hb
+    touch(0, age=-300.0)  # 5 minutes in the future
+    touch(1, age=0.0)
+    assert dist.num_dead_nodes(timeout=60) == 0
+
+
+def test_dead_nodes_exact_grace_boundary(hb, monkeypatch):
+    """A worker that never heartbeated is alive AT the grace boundary
+    (<=) and dead one instant past it. The clock is pinned so elapsed
+    is EXACTLY the grace, not grace + scan latency."""
+    _, touch = hb
+    touch(0, age=0.0)  # worker 1 never wrote a file
+    now = time.time()
+    monkeypatch.setattr(time, "time", lambda: now)
+    monkeypatch.setattr(dist, "_start_time", now - 30.0)
+    assert dist.num_dead_nodes(timeout=60, startup_grace=30.0) == 0
+    monkeypatch.setattr(dist, "_start_time", now - 30.001)
+    assert dist.num_dead_nodes(timeout=60, startup_grace=30.0) == 1
+
+
+def test_dead_nodes_dir_removed_mid_scan(hb, monkeypatch):
+    """The launcher tears the heartbeat dir down at job end — a scan
+    racing that returns 0 dead instead of raising/false-positive."""
+    d, touch = hb
+    touch(0)
+    touch(1)
+    assert dist.num_dead_nodes(timeout=60) == 0
+    import shutil
+
+    shutil.rmtree(d)
+    assert dist.num_dead_nodes(timeout=60) == 0
+    # ...and a dir that vanishes BETWEEN getmtime calls: the per-file
+    # OSError path counts the missing file dead only past grace
+    os.makedirs(d)
+    os.utime(d, (time.time() - 3600,) * 2)
+    touch(0)
+    real_getmtime = os.path.getmtime
+
+    def racing_getmtime(path):
+        if path.endswith("worker-1"):
+            raise OSError("vanished mid-scan")
+        return real_getmtime(path)
+
+    monkeypatch.setattr(os.path, "getmtime", racing_getmtime)
+    assert dist.num_dead_nodes(timeout=60) == 1  # past grace: counts dead
+    assert dist.num_dead_nodes(timeout=60, startup_grace=10 ** 9) == 0
+
+
+def test_dead_alive_dead_transition_counter(hb):
+    """The transition counter ticks on every dead-count CHANGE —
+    dead->alive->dead is 2 changes after the first death, 3 total."""
+    _, touch = hb
+    telemetry.reset()
+    saved = telemetry.current_override()
+    telemetry.set_mode("counters")
+    try:
+        touch(0)
+        touch(1, age=300.0)                     # stale -> dead
+        assert dist.num_dead_nodes(timeout=60) == 1
+        touch(1, age=0.0)                       # back alive
+        assert dist.num_dead_nodes(timeout=60) == 0
+        touch(1, age=300.0)                     # dead again
+        assert dist.num_dead_nodes(timeout=60) == 1
+        assert dist.num_dead_nodes(timeout=60) == 1  # no change, no tick
+        assert telemetry.counter(
+            "dist.dead_node_transitions").value == 3
+    finally:
+        telemetry.set_mode(saved)
+        telemetry.reset()
+
+
+# ------------------------------------------------------------- drain protocol
+def test_stop_heartbeat_removes_file(hb, monkeypatch):
+    d, _ = hb
+    monkeypatch.setenv("MXNET_TPU_WORKER_ID", "0")
+    monkeypatch.setattr(dist, "_initialized", True)
+    monkeypatch.setattr(dist, "_heartbeat_thread", None)
+    monkeypatch.setattr(dist, "_heartbeat_stop", None)
+    monkeypatch.setenv("MXNET_TPU_HEARTBEAT_INTERVAL", "0.05")
+    dist._start_heartbeat(0)
+    assert dist.is_heartbeating()
+    deadline = time.time() + 5
+    path = os.path.join(d, "worker-0")
+    while not os.path.exists(path) and time.time() < deadline:
+        time.sleep(0.01)
+    assert os.path.exists(path)
+    dist.stop_heartbeat(remove=True)
+    assert not dist.is_heartbeating()
+    assert not os.path.exists(path)
+
+
+# ------------------------------------------------------------- plan validation
+@pytest.fixture
+def elastic_world(monkeypatch):
+    """Fake a 4-worker elastic membership (no coordination service needed
+    for the pure plan logic)."""
+    monkeypatch.setattr(dist, "_elastic", True)
+    monkeypatch.setattr(dist, "_members", [0, 1, 2, 3])
+    monkeypatch.setattr(dist, "_orig_rank", 1)
+    monkeypatch.setattr(dist, "_orig_world", 4)
+    monkeypatch.setattr(dist, "_generation", 0)
+
+
+def test_plan_reform_survivor_set(elastic_world):
+    plan = dist.plan_reform(dead=[3])
+    assert plan == {"generation": 1, "members": [0, 1, 2], "dead": [3],
+                    "rank": 1, "world": 3}
+
+
+def test_plan_reform_coordinator_death_unrecoverable(elastic_world):
+    with pytest.raises(MXNetError, match="coordinator"):
+        dist.plan_reform(dead=[0, 3])
+
+
+def test_plan_reform_min_workers(elastic_world, monkeypatch):
+    monkeypatch.setenv("MXNET_ELASTIC_MIN_WORKERS", "3")
+    with pytest.raises(MXNetError, match="MIN_WORKERS"):
+        dist.plan_reform(dead=[2, 3])
+
+
+def test_plan_reform_nothing_dead_raises(elastic_world):
+    with pytest.raises(MXNetError, match="no dead"):
+        dist.plan_reform(dead=[])
+
+
+def test_plan_from_pause_evicts_self(elastic_world):
+    with pytest.raises(EvictedError):
+        dist.plan_from_pause({"generation": 1, "dead": [1],
+                              "pause_at": 5, "proposer": 1})
+
+
+def test_plan_from_pause_generation_mismatch(elastic_world):
+    with pytest.raises(MXNetError, match="generation"):
+        dist.plan_from_pause({"generation": 7, "dead": [3],
+                              "pause_at": 5, "proposer": 0})
+
+
+def test_evicted_error_is_mxnet_error():
+    assert issubclass(EvictedError, MXNetError)
+
+
+def test_elastic_enabled_env(monkeypatch):
+    monkeypatch.delenv("MXNET_ELASTIC", raising=False)
+    assert not dist.elastic_enabled()
+    monkeypatch.setenv("MXNET_ELASTIC", "1")
+    assert dist.elastic_enabled()
+    monkeypatch.setenv("MXNET_ELASTIC", "off")
+    assert not dist.elastic_enabled()
+
+
+# --------------------------------------------- pause KV protocol (subprocess)
+PAUSE_PROBE = r"""
+import os, sys, json
+os.environ["JAX_PLATFORMS"] = "cpu"
+os.environ["MXNET_ELASTIC"] = "1"
+os.environ["MXNET_TPU_COORDINATOR"] = "127.0.0.1:%(port)d"
+os.environ["MXNET_TPU_NUM_WORKERS"] = "1"
+os.environ["MXNET_TPU_WORKER_ID"] = "0"
+sys.path.insert(0, %(root)r)
+from mxnet_tpu import dist
+dist.init()
+assert dist.poll_pause() is None
+p1 = dist.propose_pause([0], round_no=10, margin=2)
+assert p1["pause_at"] == 12 and p1["dead"] == [0], p1
+# first-write-wins: a second proposal adopts the FIRST payload
+p2 = dist.propose_pause([0], round_no=99)
+assert p2 == p1, (p1, p2)
+seen = dist.poll_pause()
+assert seen == p1, seen
+print("PAUSE_PROTO_OK")
+"""
+
+
+def test_pause_kv_protocol_first_write_wins(tmp_path):
+    """propose/poll over a real coordination service (1-proc, subprocess
+    so the pytest process's jax state stays clean): first-write-wins,
+    poll is non-blocking, payload round-trips."""
+    import socket
+
+    with socket.socket() as s:
+        s.bind(("", 0))
+        port = s.getsockname()[1]
+    script = tmp_path / "pause_probe.py"
+    script.write_text(PAUSE_PROBE % {"port": port, "root": ROOT})
+    r = subprocess.run([sys.executable, str(script)], capture_output=True,
+                       text=True, timeout=120)
+    assert "PAUSE_PROTO_OK" in r.stdout, (r.stdout + r.stderr)[-800:]
+
+
+# ------------------------------------- 2-proc sharded state save/load parity
+SHARDED_WORKER = r"""
+import os, sys, json
+import numpy as np
+sys.path.insert(0, %(root)r)
+os.environ.setdefault("MXNET_KVSTORE_BUCKET_MB", "0.001")
+os.environ["MXNET_KVSTORE_UPDATE"] = "sharded"
+import mxnet_tpu as mx
+
+SHAPES = [(40, 4), (40,), (16, 40), (16,)]
+workdir = sys.argv[1]
+
+def run(n_rounds, kv=None, start=0):
+    if kv is None:
+        kv = mx.kv.create("dist_tpu_sync")
+        opt = mx.optimizer.SGD(learning_rate=0.1, momentum=0.9, wd=1e-4,
+                               rescale_grad=1.0 / 8)
+        kv.set_optimizer(opt)
+        rs = np.random.RandomState(5)
+        for i, s in enumerate(SHAPES):
+            kv.init(i, mx.nd.array(rs.rand(*s).astype("float32")))
+    rank = kv.rank
+    outs = {i: mx.nd.zeros(s) for i, s in enumerate(SHAPES)}
+    for step in range(start, start + n_rounds):
+        rs = np.random.RandomState(1000 + step)
+        for i in reversed(range(len(SHAPES))):
+            g = rs.rand(*SHAPES[i]).astype("float32") - 0.5
+            kv.push(i, mx.nd.array(g * (rank + 1)), priority=-i)
+        for i in range(len(SHAPES)):
+            kv.pull(i, out=outs[i], priority=-i)
+    kv._barrier()
+    return kv, {i: o.asnumpy() for i, o in outs.items()}
+
+# (a) continuous 6 rounds -> reference weights
+kv, ref = run(6)
+state_file = os.path.join(workdir, "opt.states")
+
+# (b) 3 rounds, save, RELOAD into the same engine (same-W shard-direct:
+#     plan hash matches -> preload path, momentum bit-parity), 3 more
+kv2 = mx.kv.create("dist_tpu_sync")
+opt2 = mx.optimizer.SGD(learning_rate=0.1, momentum=0.9, wd=1e-4,
+                        rescale_grad=1.0 / 8)
+kv2.set_optimizer(opt2)
+rs = np.random.RandomState(5)
+for i, s in enumerate(SHAPES):
+    kv2.init(i, mx.nd.array(rs.rand(*s).astype("float32")))
+kv2, mid = run(3, kv=kv2)
+if kv2.rank == 0:
+    ws = {i: kv2._store[i].asnumpy() for i in range(len(SHAPES))}
+kv2.save_optimizer_states(state_file)
+kv2._barrier()
+assert os.path.exists(state_file)
+from mxnet_tpu import checkpoint as ckpt
+assert ckpt.read_sharded_pointer(state_file) is not None, \
+    "sharded save must write a pointer file"
+kv2.load_optimizer_states(state_file)          # same-W shard-direct
+kv2, direct = run(3, kv=kv2, start=3)
+for i in ref:
+    np.testing.assert_array_equal(direct[i], ref[i])  # BIT parity
+
+# (c) fresh store with a DIFFERENT bucket plan -> re-flatten path
+os.environ["MXNET_KVSTORE_BUCKET_MB"] = "0.0005"
+kv3 = mx.kv.create("dist_tpu_sync")
+opt3 = mx.optimizer.SGD(learning_rate=0.1, momentum=0.9, wd=1e-4,
+                        rescale_grad=1.0 / 8)
+kv3.set_optimizer(opt3)
+rs = np.random.RandomState(5)
+for i, s in enumerate(SHAPES):
+    kv3.init(i, mx.nd.array(rs.rand(*s).astype("float32")))
+# replay rounds 0-2 to rebuild the weights at the save point, then load
+# the step-3 states (different plan hash -> re-flattened per-key states)
+kv3, _ = run(3, kv=kv3)
+kv3.load_optimizer_states(state_file)
+kv3, reflat = run(3, kv=kv3, start=3)
+for i in ref:
+    np.testing.assert_allclose(reflat[i], ref[i], atol=1e-6, rtol=0)
+
+# (d) optimizer-kind guard: loading sgd states into adam raises
+kv4 = mx.kv.create("dist_tpu_sync")
+kv4.set_optimizer(mx.optimizer.Adam(learning_rate=0.01))
+for i, s in enumerate(SHAPES):
+    kv4.init(i, mx.nd.array(np.zeros(s, "float32")))
+try:
+    kv4.load_optimizer_states(state_file)
+    raise AssertionError("kind mismatch must raise")
+except mx.base.MXNetError as e:
+    assert "not portable" in str(e), e
+kv4._barrier()
+print("SHARDED_STATES_OK rank", kv2.rank)
+"""
+
+
+@pytest.mark.slow
+def test_sharded_optimizer_states_2proc_parity(tmp_path):
+    """Acceptance: sharded-mode save/load no longer raises — same-W
+    resume is momentum-BIT-parity (shard-direct preload), different-plan
+    resume matches within fp32 tolerance (re-flatten), and cross-kind
+    loads raise the structured portability error. 2 processes under the
+    local launcher."""
+    script = tmp_path / "sharded_worker.py"
+    script.write_text(SHARDED_WORKER % {"root": ROOT})
+    env = dict(os.environ)
+    env.update({"JAX_PLATFORMS": "cpu"})
+    r = subprocess.run(
+        [sys.executable, os.path.join(ROOT, "tools", "launch.py"),
+         "-n", "2", "--launcher", "local", "--cpu-devices", "1",
+         sys.executable, str(script), str(tmp_path)],
+        capture_output=True, text=True, timeout=420, env=env, cwd=ROOT)
+    assert r.returncode == 0 and "SHARDED_STATES_OK" in r.stdout, \
+        (r.stdout + r.stderr)[-2000:]
+
+
+# --------------------------------------- same-W fit(resume=True) bit parity
+RESUME_WORKER = r"""
+import os, sys
+import numpy as np
+sys.path.insert(0, %(root)r)
+os.environ.setdefault("MXNET_KVSTORE_BUCKET_MB", "0.002")
+os.environ["MXNET_KVSTORE_UPDATE"] = "sharded"
+import mxnet_tpu as mx
+from mxnet_tpu import dist
+
+workdir = sys.argv[1]
+BATCH, BATCHES = 8, 6
+
+def mlp():
+    s = mx.sym.Variable("data")
+    s = mx.sym.FullyConnected(s, num_hidden=24, name="fc1")
+    s = mx.sym.Activation(s, act_type="relu")
+    s = mx.sym.FullyConnected(s, num_hidden=4, name="fc2")
+    return mx.sym.SoftmaxOutput(s, name="softmax")
+
+mx.kv.create("dist_tpu_sync")
+rank = int(os.environ.get("MXNET_TPU_WORKER_ID", "0"))
+rs = np.random.RandomState(100 + rank)
+x = rs.rand(BATCHES * BATCH, 8).astype("float32")
+y = rs.randint(0, 4, (BATCHES * BATCH,)).astype("float32")
+
+def fit(ckpt_dir, num_epoch, resume, period):
+    mx.random.seed(7)  # identical init across the three runs
+    it = mx.io.NDArrayIter(x, y, batch_size=BATCH)
+    mod = mx.mod.Module(mlp(), context=mx.cpu(), fused_step=False)
+    mod.fit(it, num_epoch=num_epoch, kvstore="dist_tpu_sync",
+            optimizer="sgd",
+            optimizer_params=(("learning_rate", 0.05), ("momentum", 0.9)),
+            elastic={"checkpoint_dir": ckpt_dir, "checkpoint_period": period,
+                     "resume": resume})
+    a, _ = mod.get_params()
+    return {k: v.asnumpy() for k, v in a.items()}
+
+# A: 4 epochs, a sharded checkpoint at every epoch end (period = rounds per
+#    epoch) -- the final save IS the final state
+dir_a = os.path.join(workdir, "ckpt-a")
+fit(dir_a, 4, False, BATCHES)
+# B: fresh module, same W + same plan -> load_sharded_checkpoint takes the
+#    shard-direct-from-flats branch; train 2 more epochs
+got_b = fit(dir_a, 6, True, BATCHES)
+# C: uninterrupted 6-epoch reference
+got_c = fit(os.path.join(workdir, "ckpt-c"), 6, False, BATCHES)
+for k in got_c:
+    np.testing.assert_array_equal(got_b[k], got_c[k])  # BIT parity
+print("RESUME_PARITY_OK rank", rank)
+
+# shard-direct-from-flats branch: a kv with a COMMITTED plan matching the
+# manifest loads via the already-assembled flat buckets (no second read of
+# our own shard file) -- the preloaded slices must bit-match the shard file
+from mxnet_tpu import checkpoint as ckpt
+step, manifest = ckpt.latest_complete(dir_a)
+kv = mx.kv.create("dist_tpu_sync")
+kv.set_optimizer(mx.optimizer.SGD(learning_rate=0.05, momentum=0.9))
+shapes = [(24, 8), (24,), (4, 24), (4,)]   # the mlp's params, in push order
+for i, s in enumerate(shapes):
+    kv.init(i, mx.nd.zeros(s))
+outs = {i: mx.nd.zeros(s) for i, s in enumerate(shapes)}
+for i in reversed(range(len(shapes))):
+    kv.push(i, mx.nd.ones(shapes[i]), priority=-i)
+for i in range(len(shapes)):
+    kv.pull(i, out=outs[i], priority=-i)
+kv._barrier()
+eng = kv._bucket_engine
+assert eng.plan is not None and eng.plan.hash == manifest["plan_hash"], \
+    (eng.plan and eng.plan.hash, manifest["plan_hash"])
+step2, _w = kv.load_sharded_checkpoint(dir_a)
+assert step2 == step
+local = ckpt.read_local_shard(dir_a, step, manifest, kv.rank)
+n_states = manifest["optimizer"]["n_states"]
+for b in manifest["plan"]["buckets"]:
+    idx = int(b["index"])
+    for i in range(n_states):
+        np.testing.assert_array_equal(
+            np.asarray(eng._preloaded_shards[idx][i]),
+            local["b%%d.s%%d" %% (idx, i)])
+kv._barrier()
+print("FLATS_SLICE_OK rank", kv.rank)
+"""
+
+
+@pytest.mark.slow
+def test_same_world_fit_resume_bit_parity(tmp_path):
+    """``fit(elastic=..., resume=True)`` at the SAME world size + bucket
+    plan takes ``load_sharded_checkpoint``'s shard-direct branch (flat
+    shards sliced from the already-verified assembled buckets) and must be
+    momentum-bit-parity: resumed training matches an uninterrupted run
+    bit-for-bit. 2 processes under the local launcher."""
+    script = tmp_path / "resume_worker.py"
+    script.write_text(RESUME_WORKER % {"root": ROOT})
+    env = dict(os.environ)
+    env.update({"JAX_PLATFORMS": "cpu"})
+    r = subprocess.run(
+        [sys.executable, os.path.join(ROOT, "tools", "launch.py"),
+         "-n", "2", "--launcher", "local", "--cpu-devices", "1",
+         sys.executable, str(script), str(tmp_path)],
+        capture_output=True, text=True, timeout=420, env=env, cwd=ROOT)
+    assert r.returncode == 0 and "RESUME_PARITY_OK" in r.stdout \
+        and "FLATS_SLICE_OK" in r.stdout, (r.stdout + r.stderr)[-2000:]
+
+
+@pytest.mark.slow
+def test_elastic_chaos_smoke_small(tmp_path):
+    """3-proc end-to-end drain/re-form/reseed parity (the 8-proc version
+    runs in tools/ci_check.sh)."""
+    r = subprocess.run(
+        [sys.executable,
+         os.path.join(ROOT, "tests", "nightly", "dist_elastic_chaos.py"),
+         "--orchestrate", str(tmp_path / "chaos"), "--world", "3"],
+        capture_output=True, text=True, timeout=420,
+        env={**os.environ, "JAX_PLATFORMS": "cpu"}, cwd=ROOT)
+    assert r.returncode == 0 and "dist_elastic_chaos" in r.stdout, \
+        (r.stdout + r.stderr)[-2000:]

@@ -532,3 +532,62 @@ def test_serve_bench_check_smoke():
     rec = json.loads([l for l in r.stdout.splitlines()
                       if l.startswith("{")][-1])
     assert rec["qps"] > 0 and rec["retraces_post_warmup"] == 0
+
+
+# ------------------------------------------------- batcher failure latch
+def test_batcher_death_latches_and_fails_fast(tm):
+    """A dead batcher thread must not strand its callers: the in-flight
+    batch's futures fail, the engine latches, and later ``submit()`` /
+    ``start()`` raise promptly instead of hanging forever (the
+    PrefetchingIter._shutdown latch pattern)."""
+    tm.set_mode("counters")
+    cache = PersistentExecutableCache(_mlp_net(), _mlp_params(), {},
+                                      cache_dir=None)
+    eng = InferenceEngine(cache, {"data": (8,)}, buckets=(2,),
+                          max_delay_ms=1)
+    eng.start()
+    try:
+        def boom(batch):
+            # non-Exception: escapes the per-batch handler and kills the
+            # thread — exactly the case that used to hang every future
+            raise KeyboardInterrupt("simulated batcher death")
+
+        eng._dispatch = boom
+        f1 = eng.submit({"data": np.zeros((2, 8), "float32")})
+        with pytest.raises((MXNetError, KeyboardInterrupt)):
+            f1.result(timeout=10)
+        eng._thread.join(timeout=10)
+        t0 = time.time()
+        with pytest.raises(MXNetError, match="latched|died"):
+            eng.submit({"data": np.zeros((1, 8), "float32")})
+        assert time.time() - t0 < 5, "submit after batcher death must " \
+            "fail promptly, not hang"
+        with pytest.raises(MXNetError, match="latched|died"):
+            eng.start()
+        assert telemetry.counter("serving.batcher_deaths").value == 1
+    finally:
+        eng._started = False  # thread already dead; skip close()'s join
+
+
+def test_latch_fails_pending_queued_futures():
+    """Requests still sitting in the queue when the batcher dies get their
+    futures failed immediately — no waiter left behind."""
+    cache = PersistentExecutableCache(_mlp_net(), _mlp_params(), {},
+                                      cache_dir=None)
+    eng = InferenceEngine(cache, {"data": (8,)}, buckets=(8,),
+                          max_delay_ms=5000)
+    eng.start()
+    try:
+        # 1 row into an 8-bucket: the batcher holds it in the queue while
+        # waiting out the 5s admission deadline
+        fut = eng.submit({"data": np.zeros((1, 8), "float32")})
+        deadline = time.time() + 5
+        while not eng._queue and time.time() < deadline:
+            time.sleep(0.005)
+        eng._latch_failure(RuntimeError("simulated death"))
+        with pytest.raises(MXNetError, match="died"):
+            fut.result(timeout=5)
+        with pytest.raises(MXNetError, match="died"):
+            eng.submit({"data": np.zeros((1, 8), "float32")})
+    finally:
+        eng._started = False

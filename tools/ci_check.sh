@@ -1,7 +1,8 @@
 #!/usr/bin/env bash
 # CI entrypoint: static analysis first, then the fused conv+BN machinery
 # smoke, then the telemetry trace smoke, then the 8-process kvstore
-# bucket/overlap smoke, then the serving smoke, then the tier-1 test suite.
+# bucket/overlap smoke, then the serving smoke, then the elastic
+# fault-tolerance chaos smoke, then the tier-1 test suite.
 #
 # Step 1 dogfoods the graphlint subsystem on every bundled model (the
 # acceptance gate: every model must lint with zero error-severity
@@ -22,11 +23,19 @@
 # engine smoke (tools/serve_bench.py --check): QPS/p99 under a tiny
 # open-loop load with zero post-warmup retraces, for both the bucketed
 # engine and the transformer KV-cache decode path (docs/SERVING.md).
-# Step 7 is the repo's tier-1 pytest command (ROADMAP.md).
+# Step 7 runs the elastic fault-tolerance chaos smoke
+# (tests/nightly/dist_elastic_chaos.py --orchestrate): an 8-process
+# Module.fit in sharded-update mode with periodic async checkpoints, one
+# worker killed mid-run — the survivors must re-form to 7, reseed from the
+# sharded checkpoint, resume, and reach weight parity with an uninterrupted
+# 7-process control run; it also asserts checkpoint.inflight was observed
+# > 0 mid-fit, i.e. the async write really overlapped the step
+# (docs/FAULT_TOLERANCE.md).
+# Step 8 is the repo's tier-1 pytest command (ROADMAP.md).
 set -uo pipefail
 cd "$(dirname "$0")/.."
 
-echo "== [1/7] graphlint: all bundled models (plain + sharding-plan sweep) =="
+echo "== [1/8] graphlint: all bundled models (plain + sharding-plan sweep) =="
 JAX_PLATFORMS=cpu python tools/graphlint --all-models --min-severity warning \
     || { echo "graphlint FAILED"; exit 1; }
 # the same zoo under an abstract dp=8,model=2 mesh: the GL4xx sharding-plan
@@ -53,7 +62,7 @@ print("mesh sweep OK: %d models, peak-HBM %.3f..%.3f GiB/device"
 PYEOF
 rm -f "$MESH_SWEEP"
 
-echo "== [2/7] source lint (ruff/pyflakes if available) =="
+echo "== [2/8] source lint (ruff/pyflakes if available) =="
 if command -v ruff >/dev/null 2>&1; then
     ruff check mxnet_tpu/ || { echo "ruff FAILED"; exit 1; }
 elif python -c 'import pyflakes' >/dev/null 2>&1; then
@@ -62,7 +71,7 @@ else
     echo "(neither ruff nor pyflakes installed; compile-check runs in pytest)"
 fi
 
-echo "== [3/7] fused conv+BN: interpret-mode autotune smoke + bwd parity subset =="
+echo "== [3/8] fused conv+BN: interpret-mode autotune smoke + bwd parity subset =="
 FUSED_TABLE="$(mktemp /tmp/fused_conv_bn_table_ci.XXXXXX.py)"
 JAX_PLATFORMS=cpu python tools/fused_stats_bench.py --interpret --emit-table \
     --table-out "$FUSED_TABLE" \
@@ -83,7 +92,7 @@ JAX_PLATFORMS=cpu python -m pytest tests/test_pallas_conv_bn_bwd.py -q \
     -m 'not slow' -p no:cacheprovider \
     || { echo "bwd parity subset FAILED"; exit 1; }
 
-echo "== [4/7] telemetry: trace-on fit smoke + mxtrace schema gate =="
+echo "== [4/8] telemetry: trace-on fit smoke + mxtrace schema gate =="
 TRACE_DIR="$(mktemp -d /tmp/mxtrace_ci.XXXXXX)"
 JAX_PLATFORMS=cpu MXNET_DEFAULT_CONTEXT=cpu MXNET_TELEMETRY=trace \
 python - "$TRACE_DIR" <<'PYEOF' || { echo "telemetry fit smoke FAILED"; rm -rf "$TRACE_DIR"; exit 1; }
@@ -124,7 +133,7 @@ python tools/mxtrace "$TRACE_DIR/profile.json" --check \
     || { echo "mxtrace --check FAILED"; rm -rf "$TRACE_DIR"; exit 1; }
 rm -rf "$TRACE_DIR"
 
-echo "== [5/7] kvstore: 8-process bucket/overlap smoke (docs/PERF.md §11) =="
+echo "== [5/8] kvstore: 8-process bucket/overlap smoke (docs/PERF.md §11) =="
 # functional leg: overlap counters fire during Module.fit on the per-key
 # priority path, and sharded-update weights bit-match replicated (atol 1e-6)
 JAX_PLATFORMS=cpu MXNET_DEFAULT_CONTEXT=cpu \
@@ -145,7 +154,7 @@ JAX_PLATFORMS=cpu MXNET_DEFAULT_CONTEXT=cpu MXNET_KVSTORE_BUCKET_MB=16 \
     "${BW_CMD[@]}" || { echo "kvstore bandwidth smoke FAILED"; exit 1; }
 }
 
-echo "== [6/7] serving: serve_bench smoke (docs/SERVING.md) =="
+echo "== [6/8] serving: serve_bench smoke (docs/SERVING.md) =="
 # tiny-model CPU serving smoke: sustained QPS > 0, finite p99, ZERO
 # post-warmup retraces/compiles (the sealed executable-cache contract,
 # gated via the GL201-203 guard + executor compile/cache-hit telemetry),
@@ -158,7 +167,19 @@ python tools/serve_bench.py --model transformer-decode --qps 16 \
     --duration 1 --rows 2 --check \
     || { echo "serve_bench kv-decode smoke FAILED"; exit 1; }
 
-echo "== [7/7] tier-1 tests =="
+echo "== [7/8] elastic: 8-proc chaos smoke (docs/FAULT_TOLERANCE.md) =="
+# kill 1 of 8 workers mid-fit: survivors pause, re-form to 7, reseed from
+# the sharded async checkpoint, resume — and must reach weight parity with
+# an uninterrupted 7-proc control run; checkpoint.inflight must have been
+# observed > 0 mid-fit (the async write overlaps the step)
+CHAOS_DIR="$(mktemp -d /tmp/dist_elastic_chaos.XXXXXX)"
+JAX_PLATFORMS=cpu MXNET_DEFAULT_CONTEXT=cpu \
+python tests/nightly/dist_elastic_chaos.py --orchestrate "$CHAOS_DIR" \
+    --world 8 \
+    || { echo "elastic chaos smoke FAILED"; rm -rf "$CHAOS_DIR"; exit 1; }
+rm -rf "$CHAOS_DIR"
+
+echo "== [8/8] tier-1 tests =="
 rm -f /tmp/_t1.log
 timeout -k 10 870 env JAX_PLATFORMS=cpu python -m pytest tests/ -q -m 'not slow' \
     --continue-on-collection-errors -p no:cacheprovider -p no:xdist -p no:randomly \

@@ -42,9 +42,14 @@ def _worker_env(base, args, coordinator, rank, hb_dir=None):
     env["MXNET_TPU_COORDINATOR"] = coordinator
     env["MXNET_TPU_NUM_WORKERS"] = str(args.num_workers)
     env["MXNET_TPU_WORKER_ID"] = str(rank)
+    if getattr(args, "elastic", False):
+        env["MXNET_ELASTIC"] = "1"
     if hb_dir:
         env["MXNET_TPU_HEARTBEAT_DIR"] = hb_dir
-        env["MXNET_TPU_HEARTBEAT_INTERVAL"] = str(args.heartbeat_interval)
+        if args.heartbeat_interval is not None:
+            env["MXNET_TPU_HEARTBEAT_INTERVAL"] = str(args.heartbeat_interval)
+        else:
+            env.setdefault("MXNET_TPU_HEARTBEAT_INTERVAL", "5")
     if args.cpu_devices:
         flags = env.get("XLA_FLAGS", "")
         env["XLA_FLAGS"] = (
@@ -92,7 +97,7 @@ def _terminate(procs, grace=10):
             pass
 
 
-def _wait_all(procs, hb_dir=None, hb_timeout=0):
+def _wait_all(procs, hb_dir=None, hb_timeout=0, elastic=False):
     """Wait for every worker. Failure detection (reference: ps-lite
     heartbeats behind KVStore::get_num_dead_node, kvstore.h:234-244 /
     kvstore_dist.h:158-167): a nonzero exit, OR a stale heartbeat from a
@@ -100,32 +105,66 @@ def _wait_all(procs, hb_dir=None, hb_timeout=0):
     whose runtime stopped beating — NOT a live-but-deadlocked collective,
     whose heartbeat thread keeps running; that case needs job-level
     timeouts), terminates the whole job with SIGTERM-then-SIGKILL — the
-    caller decides whether to restart from the last checkpoint."""
+    caller decides whether to restart from the last checkpoint.
+
+    ``elastic=True`` (docs/FAULT_TOLERANCE.md) inverts the policy for
+    non-coordinator workers: their death or stale heartbeat is the
+    SURVIVORS' business (pause → re-form → resume), so the launcher keeps
+    waiting instead of tearing the job down. Only worker 0's failure —
+    its process hosts the coordination service, nothing survives it — or
+    every worker failing still kills the job."""
     import time
 
     code = 0
     live = dict(enumerate(procs))  # rank -> proc (Popen order is rank order)
     failed = False
+    any_ok = False
     while live:
         for r, p in list(live.items()):
             rc = p.poll()
             if rc is None:
                 continue
             del live[r]
-            if rc != 0:
+            if rc == 0:
+                any_ok = True
+            else:
+                if elastic and r != 0:
+                    sys.stderr.write(
+                        "launch: worker %d exited rc=%d — elastic job, "
+                        "survivors re-form without it\n" % (r, rc))
+                    # forgiven below iff anyone succeeds AND the job never
+                    # hit a terminal failure (coordinator death)
+                    code = code or rc
+                    continue
                 code = code or rc
                 failed = True
         if not failed and hb_dir and hb_timeout > 0 and live:
             stale = _stale_worker(hb_dir, sorted(live), hb_timeout)
-            if stale is not None:
+            if stale is not None and (not elastic or stale == 0):
                 sys.stderr.write(
                     "launch: worker %d heartbeat stale > %gs — declaring the "
                     "job dead\n" % (stale, hb_timeout))
                 code = 124
                 failed = True
+            elif stale is not None:
+                # elastic: the survivors already class this worker dead
+                # (same staleness signal) and re-form without it — but its
+                # frozen PROCESS must still be reaped or `live` never
+                # empties and the launcher hangs after the job finishes
+                sys.stderr.write(
+                    "launch: worker %d heartbeat stale > %gs — elastic "
+                    "job, reaping the frozen process; survivors re-form "
+                    "without it\n" % (stale, hb_timeout))
+                _terminate([live[stale]])
         if failed and live:
             _terminate(list(live.values()))
         time.sleep(0.2)
+    if elastic and any_ok and not failed:
+        # the job succeeded if the final generation finished, even though
+        # evicted workers exited nonzero along the way — but a TERMINAL
+        # failure (coordinator death, stale-coordinator watchdog) stays a
+        # failure no matter how many workers exited clean before it
+        return 0
     return code
 
 
@@ -139,14 +178,17 @@ def launch_local(args, command):
     attempts = 0
     while True:
         coordinator = "127.0.0.1:%d" % _free_port()
+        # elastic jobs need the heartbeat dir unconditionally: it is the
+        # workers' OWN failure detector, not just the launcher's
         hb_dir = tempfile.mkdtemp(prefix="mxtpu-hb-") \
-            if args.heartbeat_timeout > 0 else None
+            if (args.heartbeat_timeout > 0 or args.elastic) else None
         procs = []
         try:
             for rank in range(args.num_workers):
                 env = _worker_env(os.environ, args, coordinator, rank, hb_dir)
                 procs.append(subprocess.Popen(command, env=env))
-            code = _wait_all(procs, hb_dir, args.heartbeat_timeout)
+            code = _wait_all(procs, hb_dir, args.heartbeat_timeout,
+                             elastic=args.elastic)
         finally:
             # every old worker must be DEAD before cleanup/relaunch: a
             # straggler could race the next attempt's checkpoint resume (and
@@ -205,6 +247,12 @@ def main():
     parser.add_argument("--cpu-devices", type=int, default=0,
                         help="give each worker this many virtual CPU devices "
                              "(multi-host testing without TPU hardware)")
+    parser.add_argument("--elastic", action="store_true",
+                        help="(local) run the job elastically "
+                             "(MXNET_ELASTIC=1): a non-coordinator worker's "
+                             "death pauses and re-forms the job over the "
+                             "survivors instead of killing it "
+                             "(docs/FAULT_TOLERANCE.md)")
     parser.add_argument("--auto-restart", type=int, default=0,
                         help="(local) relaunch the whole job up to this many "
                              "times after a worker dies or hangs; workers "
@@ -214,8 +262,10 @@ def main():
                              "worker's heartbeat file is older than this "
                              "many seconds — catches frozen/stopped worker "
                              "processes (0 disables)")
-    parser.add_argument("--heartbeat-interval", type=float, default=5.0,
-                        help="how often workers touch their heartbeat file")
+    parser.add_argument("--heartbeat-interval", type=float, default=None,
+                        help="how often workers touch their heartbeat file "
+                             "(default: inherit MXNET_TPU_HEARTBEAT_INTERVAL "
+                             "from the environment, else 5)")
     parser.add_argument("command", nargs=argparse.REMAINDER,
                         help="the training command to run on every worker")
     args = parser.parse_args()
