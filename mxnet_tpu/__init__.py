@@ -88,6 +88,9 @@ def __getattr__(name):
         "viz": ".visualization",
         "profiler": ".profiler",
         "telemetry": ".telemetry",
+        "faultinject": ".faultinject",
+        "serving": ".serving",
+        "checkpoint": ".checkpoint",
         "recordio": ".recordio",
         "image": ".image",
         "img": ".image",

@@ -11,7 +11,8 @@ from __future__ import annotations
 
 import numpy as np
 
-__all__ = ["MXNetError", "EvictedError", "string_types", "numeric_types"]
+__all__ = ["MXNetError", "EvictedError", "string_types", "numeric_types",
+           "anomaly_guard_mode"]
 
 
 class MXNetError(Exception):
@@ -29,6 +30,33 @@ class EvictedError(MXNetError):
 
 string_types = (str,)
 numeric_types = (float, int, np.generic)
+
+_warned_anomaly_modes = set()
+
+
+def anomaly_guard_mode():
+    """MXNET_ANOMALY_GUARD (docs/RESILIENCE.md): post-backward NaN/Inf
+    gradient guard in the training loop. Returns None (off, the default),
+    ``"skip"`` (drop the anomalous step: no weight/optimizer/aux update,
+    count it, warn with the first offending key) or ``"raise"`` (throw a
+    structured MXNetError naming the key — state is left UN-updated either
+    way, so a caught raise can lower the lr and continue). Unrecognized
+    values warn once and stay off."""
+    import os
+
+    raw = os.environ.get("MXNET_ANOMALY_GUARD", "0").strip().lower()
+    if raw in ("", "0", "off", "false", "none", "no"):
+        return None
+    if raw in ("skip", "raise"):
+        return raw
+    if raw not in _warned_anomaly_modes:
+        _warned_anomaly_modes.add(raw)
+        import logging
+
+        logging.getLogger("mxnet_tpu").warning(
+            "MXNET_ANOMALY_GUARD=%r is not one of 0|skip|raise; the "
+            "anomaly guard stays OFF", raw)
+    return None
 
 # dtype code table, numerically compatible with the reference's
 # _DTYPE_NP_TO_MX / _DTYPE_MX_TO_NP (python/mxnet/ndarray.py:36-52) so that

@@ -50,12 +50,14 @@ tampered file fails its digest/deserialization check with a structured
 """
 from __future__ import annotations
 
+import errno
 import glob
 import hashlib
 import io as _io
 import json
 import logging
 import os
+import random
 import re
 import shutil
 import threading
@@ -64,6 +66,7 @@ import time
 import numpy as np
 
 from .base import MXNetError
+from . import faultinject as _fi
 from . import telemetry as _tm
 
 __all__ = [
@@ -113,15 +116,53 @@ def checkpoint_async():
         "0", "off", "false")
 
 
+def checkpoint_retries():
+    """MXNET_CHECKPOINT_RETRIES — how many times the writer retries a
+    TRANSIENT I/O failure (EIO/ENOSPC/EAGAIN, or an injected fault at the
+    ``checkpoint.write`` site) before latching it. Default 3; 0 disables."""
+    raw = os.environ.get("MXNET_CHECKPOINT_RETRIES", "3")
+    try:
+        return max(0, int(raw))
+    except ValueError:
+        log.warning("MXNET_CHECKPOINT_RETRIES=%r is not an int; using 3", raw)
+        return 3
+
+
+_TRANSIENT_ERRNOS = (errno.EIO, errno.ENOSPC, errno.EAGAIN)
+
+
+def _transient_write_error(exc):
+    """Retry-worthy? Disk-level transients (EIO torn write, ENOSPC until
+    retention frees space, EAGAIN) and injected faults; permission errors,
+    serialization bugs etc. latch immediately."""
+    if isinstance(exc, _fi.FaultInjected):
+        return True
+    return isinstance(exc, OSError) and exc.errno in _TRANSIENT_ERRNOS
+
+
 # -------------------------------------------------------------- atomic writes
 def atomic_write_bytes(path, data: bytes):
     """Write ``data`` to ``path`` atomically (temp + os.replace): readers see
-    the old file or the new file, never a torn one."""
+    the old file or the new file, never a torn one.
+
+    Fault-injection site ``checkpoint.write`` (docs/RESILIENCE.md):
+    ``raise``/``delay_ms``/``hang`` fire at entry; a ``torn_write`` plan
+    persists only a prefix of the payload INTO THE TEMP FILE and raises
+    ``OSError(EIO)`` — the crash/ENOSPC-mid-write shape. The final path is
+    never torn (the replace doesn't happen), which is exactly the
+    atomicity contract the injector must not be allowed to break."""
+    _fi.fire("checkpoint.write")
+    keep = _fi.torn_fraction("checkpoint.write")
     tmp = "%s.tmp.%d" % (path, os.getpid())
     with open(tmp, "wb") as f:
-        f.write(data)
+        f.write(data if keep is None else data[:int(len(data) * keep)])
         f.flush()
         os.fsync(f.fileno())
+    if keep is not None:
+        raise OSError(
+            errno.EIO, "faultinject: torn write of %r (persisted %d of %d "
+            "bytes into the temp file, then failed)"
+            % (path, int(len(data) * keep), len(data)))
     os.replace(tmp, path)
 
 
@@ -504,8 +545,32 @@ class Checkpointer:
                 self._active = job
                 self._set_inflight_locked()
             try:
-                with _tm.span("checkpoint.write", step=job.step):
-                    job.fn()
+                # transient I/O (EIO/ENOSPC/EAGAIN, injected faults) is
+                # retried with capped jittered backoff before latching —
+                # the write bodies are idempotent (temp + os.replace), so
+                # a re-run never compounds a partial attempt
+                retries = checkpoint_retries()
+                attempt = 0
+                while True:
+                    try:
+                        with _tm.span("checkpoint.write", step=job.step,
+                                      attempt=attempt):
+                            job.fn()
+                        break
+                    except BaseException as exc:
+                        if attempt >= retries \
+                                or not _transient_write_error(exc):
+                            raise
+                        attempt += 1
+                        if _tm.enabled():
+                            _tm.counter("checkpoint.retries").inc()
+                        delay = min(1.0, 0.05 * (2 ** attempt)) \
+                            * (0.5 + random.random())
+                        log.warning(
+                            "checkpoint write for step %s hit a transient "
+                            "I/O error (%s); retry %d/%d in %.0fms",
+                            job.step, exc, attempt, retries, delay * 1000)
+                        time.sleep(delay)
             except BaseException as exc:  # latched; next save/wait raises
                 log.error("checkpoint write for step %s FAILED: %s",
                           job.step, exc)

@@ -208,12 +208,20 @@ def _start_heartbeat(process_id):
     stop = threading.Event()
 
     def beat():
+        from . import faultinject as _fi
+
         while _initialized and not stop.is_set():
             try:
+                # injection site dist.heartbeat (docs/RESILIENCE.md): a
+                # `raise` skips this beat (one missed heartbeat), a
+                # delay/hang stalls the thread so the file goes stale —
+                # the exact signal the launcher watchdog and the elastic
+                # dead-node scan act on
+                _fi.fire("dist.heartbeat")
                 os.makedirs(hb_dir, exist_ok=True)
                 with open(path, "a"):
                     os.utime(path, None)
-            except OSError:
+            except (OSError, _fi.FaultInjected):
                 pass
             stop.wait(interval)
 

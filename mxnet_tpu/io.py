@@ -304,10 +304,19 @@ class PrefetchingIter(DataIter):
 
     # ------------------------------------------------------------ pump plumbing
     def _pump(self, child, q, stop):
+        from . import faultinject as _fi
+
         end_token = PrefetchingIter._END
         try:
             while not stop.is_set():
                 try:
+                    # injection site io.prefetch (docs/RESILIENCE.md): a
+                    # `raise` rides the existing error channel below and
+                    # surfaces to the consumer as the epoch's failure; a
+                    # delay/hang starves the training loop (visible as
+                    # io.prefetch_wait) and, past shutdown_timeout, trips
+                    # the wedge latch
+                    _fi.fire("io.prefetch")
                     batch = child.next()
                 except StopIteration:
                     break

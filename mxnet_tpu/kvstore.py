@@ -635,9 +635,19 @@ class _Collective:
         self._sum_rows = _make_sum(None)
 
     def make_global_rows(self, row):
-        """Zero-copy (W, n) global array from this process's (1, n) row."""
+        """Zero-copy (W, n) global array from this process's (1, n) row.
+
+        Injection site ``dist.collective`` (docs/RESILIENCE.md): every
+        kvstore allreduce/reduce-scatter assembles its global array here,
+        so one seam covers the whole collective surface — a `raise` makes
+        this worker's collective fail exactly the way a dead peer's
+        transport error does (the elastic recovery trigger), a delay
+        models a straggler."""
         import jax
 
+        from . import faultinject as _fi
+
+        _fi.fire("dist.collective")
         return jax.make_array_from_single_device_arrays(
             (self.n_workers,) + tuple(row.shape[1:]), self.row_sharding,
             [row])

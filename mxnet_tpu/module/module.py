@@ -13,7 +13,8 @@ import numpy as np
 
 from .. import ndarray as nd
 from .. import optimizer as opt
-from ..base import MXNetError
+from .. import telemetry as _tm
+from ..base import MXNetError, anomaly_guard_mode
 from ..context import Context, cpu, current_context
 from ..initializer import InitDesc, Uniform
 from ..ndarray import NDArray, zeros
@@ -72,6 +73,7 @@ class Module(BaseModule):
         self._updater = None
         self._exec_group = None
         self._preload_opt_states = None
+        self._skipped_steps = 0  # anomaly-guard skips on the legacy path
 
     # ------------------------------------------------------------ properties
     @property
@@ -375,6 +377,29 @@ class Module(BaseModule):
             return
         self._exec_group.forward_backward(data_batch)
 
+    @property
+    def skipped_steps(self):
+        """Steps dropped by the NaN/Inf anomaly guard
+        (``MXNET_ANOMALY_GUARD=skip``, docs/RESILIENCE.md) — fused-SPMD
+        skips live on the trainer, legacy-path skips here."""
+        if self._spmd is not None:
+            return self._spmd.trainer.skipped_steps
+        return self._skipped_steps
+
+    def _first_nonfinite_grad(self):
+        """The first param (symbol order) with a NaN/Inf gradient on any
+        device, or None. Host-side check — the legacy per-device path's
+        gradients already live as materialized per-device buffers, so this
+        costs one device→host read per grad (opt-in via
+        MXNET_ANOMALY_GUARD; the fused-SPMD path checks on device)."""
+        for name, grads in zip(self._param_names, self._exec_group.grad_arrays):
+            for g in grads:
+                if g is None:
+                    continue
+                if not np.isfinite(g.asnumpy()).all():
+                    return name
+        return None
+
     def update(self):
         """(reference: module.py update → model.py _update_params[_on_kvstore])"""
         assert self.binded and self.params_initialized and self.optimizer_initialized
@@ -389,6 +414,47 @@ class Module(BaseModule):
                     "fused_step=False (or MXNET_MODULE_FUSED_STEP=0) for the "
                     "manual forward/backward/update loop")
             return  # the optimizer already ran inside the fused step
+        guard = anomaly_guard_mode()
+        if guard is not None and self._kvstore is not None \
+                and "dist" in self._kvstore.type:
+            # a rank-LOCAL skip/raise would desynchronize the gradient
+            # collective (peers enter the push this worker skips). The
+            # fused-SPMD path decides inside one SPMD program, so every
+            # rank agrees — that is the supported dist configuration.
+            if not getattr(self, "_warned_guard_dist", False):
+                self._warned_guard_dist = True
+                self.logger.warning(
+                    "MXNET_ANOMALY_GUARD is ignored on the legacy "
+                    "per-device path with a dist kvstore: a rank-local "
+                    "skip would desync the collective. Use the fused SPMD "
+                    "step (the default for dist) for a guarded dist run.")
+            guard = None
+        if guard is not None:
+            bad = self._first_nonfinite_grad()
+            if bad is not None:
+                # grad_req='add' ACCUMULATES across steps: leaving NaN in
+                # those buffers would make every later step non-finite too
+                # (NaN + x = NaN) — zero them so the dropped/raised step
+                # doesn't poison the rest of the run
+                for name, grads in zip(self._param_names,
+                                       self._exec_group.grad_arrays):
+                    if self._exec_group.grad_req.get(name) == "add":
+                        for g in grads:
+                            if g is not None:
+                                g[:] = 0
+                if guard == "raise":
+                    raise MXNetError(
+                        "anomaly guard: non-finite (NaN/Inf) gradient for "
+                        "parameter %r — step NOT applied "
+                        "(MXNET_ANOMALY_GUARD=raise)" % bad)
+                self._skipped_steps += 1
+                if _tm.enabled():
+                    _tm.counter("trainer.skipped_steps").inc()
+                self.logger.warning(
+                    "anomaly guard: dropping this update — non-finite "
+                    "gradient, first offending key %r (%d step(s) skipped "
+                    "so far)", bad, self._skipped_steps)
+                return
         self._params_dirty = True
         if self._update_on_kvstore:
             from ..kvstore_helper import update_params_on_kvstore

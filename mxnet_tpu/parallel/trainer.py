@@ -107,6 +107,11 @@ class SPMDTrainer:
         self._seed = 0
         self._base_key = None
         self._spans_cache = None
+        # NaN/Inf anomaly guard (MXNET_ANOMALY_GUARD, docs/RESILIENCE.md):
+        # mode is read when the step compiles; skipped_steps counts dropped
+        # updates in skip mode
+        self._anomaly_mode = None
+        self.skipped_steps = 0
 
     # ----------------------------------------------------------- shared state
     @property
@@ -244,6 +249,11 @@ class SPMDTrainer:
             else:
                 fwd = jax.checkpoint(fwd, static_argnums=())
 
+        from ..base import anomaly_guard_mode
+
+        guard = anomaly_guard_mode() if param_names else None
+        self._anomaly_mode = guard
+
         def step(params, aux, opt_state, inputs, base_key, lr):
             # derive the per-step key on device from the optimizer counter —
             # no host→device key transfer inside the training loop
@@ -265,7 +275,25 @@ class SPMDTrainer:
                     grads[k] = jnp.zeros_like(params[k])
             new_params, new_opt = opt_apply(params, grads, opt_state, lr=lr)
             new_aux_d = dict(zip(aux_names, new_aux))
-            return new_params, new_aux_d, new_opt, outs
+            if guard is None:
+                return new_params, new_aux_d, new_opt, outs
+            # anomaly guard: one all-finite bit per gradient, fused into
+            # the step — if ANY is false the whole update (params, aux,
+            # optimizer state incl. its counter) selects the OLD values,
+            # so a dropped step is a true no-op on device. The per-key
+            # vector goes back to the host so step() can name the first
+            # offending key (key order: sorted, matching step()).
+            finite_vec = jnp.stack(
+                [jnp.all(jnp.isfinite(grads[k])) for k in sorted(grads)])
+            ok = jnp.all(finite_vec)
+
+            def _sel(new, old):
+                return jax.tree_util.tree_map(
+                    lambda a, b: jnp.where(ok, a, b), new, old)
+
+            return (_sel(new_params, params),
+                    _sel(new_aux_d, dict(zip(aux_names, aux_tuple))),
+                    _sel(new_opt, opt_state), outs, finite_vec)
 
         return jax.jit(step, donate_argnums=(0, 1, 2))
 
@@ -316,10 +344,43 @@ class SPMDTrainer:
             if lr is None:
                 lr = self._opt_static_lr  # may stay None → apply() uses its own lr
             self._step_count += 1
-            self.params, self.aux, self.opt_state, outs = self._step_fn(
+            res = self._step_fn(
                 self.params, self.aux, self.opt_state, placed, self._base_key,
                 None if lr is None else jnp.asarray(lr, "float32"))
+            if self._anomaly_mode is None:
+                self.params, self.aux, self.opt_state, outs = res
+            else:
+                self.params, self.aux, self.opt_state, outs, finite = res
+                self._check_anomaly(finite)
         return outs
+
+    def _check_anomaly(self, finite_vec):
+        """Host half of the anomaly guard: the device side already
+        where-selected the old state if any gradient was non-finite; here
+        the per-key vector is read back (this synchronizes the step — the
+        guard trades async dispatch for the check, docs/RESILIENCE.md) to
+        count the skip or raise naming the first offending key."""
+        from .. import telemetry as _tm
+
+        fv = np.asarray(finite_vec)
+        if fv.all():
+            return
+        bad = sorted(self.params)[int(np.argmin(fv))]
+        if self._anomaly_mode == "raise":
+            raise MXNetError(
+                "anomaly guard: non-finite (NaN/Inf) gradient for "
+                "parameter %r at step %d — the fused step left params/"
+                "optimizer state UN-updated (MXNET_ANOMALY_GUARD=raise)"
+                % (bad, self._step_count))
+        self.skipped_steps += 1
+        if _tm.enabled():
+            _tm.counter("trainer.skipped_steps").inc()
+        import logging
+
+        logging.getLogger("mxnet_tpu").warning(
+            "anomaly guard: dropped step %d — non-finite gradient, first "
+            "offending key %r (%d step(s) skipped so far)",
+            self._step_count, bad, self.skipped_steps)
 
     def _place_batch(self, data, label=None):
         """Lay one batch out on the mesh per the sharding rules (shared by

@@ -25,8 +25,10 @@ KV buffer (models/transformer.py serving symbols).
 from __future__ import annotations
 
 from .cache import PersistentExecutableCache
-from .engine import InferenceEngine, ServeFuture
+from .engine import (InferenceEngine, ServeFuture, ServeDeadlineError,
+                     ServeOverloadError, ServeClosedError)
 from .kv_decode import KVCacheDecoder
 
 __all__ = ["PersistentExecutableCache", "InferenceEngine", "ServeFuture",
+           "ServeDeadlineError", "ServeOverloadError", "ServeClosedError",
            "KVCacheDecoder"]

@@ -347,6 +347,84 @@ class PersistentExecutableCache:
         return [{n: tuple(s) for n, s in b.items()}
                 for b in rec.get("buckets", [])]
 
+    # ------------------------------------------------------------ hot swap
+    @staticmethod
+    def _swap_value(name, value, target, what):
+        """Validate ONE incoming swap value against its target buffer:
+        shape must match exactly and the value must be materializable in
+        the target's dtype. Both checks (and the cast) happen here, in the
+        validation phase, so the later write loop cannot raise halfway and
+        leave a mixed old/new weight set."""
+        host = np.asarray(getattr(value, "asnumpy", lambda: value)())
+        want = tuple(getattr(target, "shape", None) or np.shape(target))
+        if tuple(host.shape) != want:
+            raise MXNetError(
+                "serving: swap_params shape mismatch for %r: got %s, %s "
+                "has %s — a reshape would retrace; reload refused"
+                % (name, tuple(host.shape), what, want))
+        dtype = getattr(target, "dtype", None) or np.asarray(target).dtype
+        try:
+            return np.asarray(host, dtype=dtype)
+        except (TypeError, ValueError) as exc:
+            raise MXNetError(
+                "serving: swap_params value for %r is not castable to the "
+                "bound dtype %s (%s) — reload refused"
+                % (name, np.dtype(dtype).name, exc)) from exc
+
+    def swap_params(self, arg_params, aux_params=None):
+        """Hitless weight swap (docs/RESILIENCE.md): overwrite the SHARED
+        param/aux buffers every bucket executor reads, in place. Shapes
+        must match exactly (values are cast to the bound dtype) — a shape
+        or unknown-key mismatch raises BEFORE anything is written, so a
+        failed swap leaves the old weights fully intact. Same
+        shapes/dtypes means the executables' jit signatures are untouched:
+        ZERO retraces. jax arrays are immutable, so the in-place NDArray
+        assignment allocates fresh device buffers — an in-flight batch
+        still materializing against the old buffers is double-buffered by
+        construction. Keys absent from ``arg_params`` keep their current
+        values (partial swaps are legal)."""
+        with self._lock:
+            input_names = set(self.input_names)
+            updates = []
+            for store, incoming, what in (
+                    (self._shared_args, arg_params or {}, "argument"),
+                    (self._shared_aux, aux_params or {}, "aux state")):
+                for n, v in incoming.items():
+                    if n in input_names:
+                        raise MXNetError(
+                            "serving: swap_params(%r) names a model INPUT, "
+                            "not a parameter" % n)
+                    cur = (store or {}).get(n)
+                    if cur is None:
+                        # not bound yet (pre-warmup swap): stage into the
+                        # source dicts so the first bind picks it up below
+                        src = self._arg_params if what == "argument" \
+                            else self._aux_params
+                        if n not in src:
+                            raise MXNetError(
+                                "serving: swap_params got unknown %s %r "
+                                "(loaded params: %s...)"
+                                % (what, n, sorted(src)[:8]))
+                        host = self._swap_value(n, v, src[n],
+                                                "the loaded checkpoint")
+                        updates.append((None, host, n, what))
+                        continue
+                    updates.append((cur,
+                                    self._swap_value(n, v, cur,
+                                                     "the loaded model"),
+                                    n, what))
+            # validation passed for EVERY key — now write (all or nothing)
+            for cur, host, n, what in updates:
+                if cur is None:
+                    (self._arg_params if what == "argument"
+                     else self._aux_params)[n] = host
+                else:
+                    cur[:] = host
+                    # keep the source dict consistent for any later bind
+                    (self._arg_params if what == "argument"
+                     else self._aux_params)[n] = host
+        return len(updates)
+
     # ------------------------------------------------------------- running
     def run(self, inputs: Dict[str, np.ndarray]):
         """One batch through the bucket executable matching the inputs'

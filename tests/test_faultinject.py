@@ -1,0 +1,211 @@
+"""Fault-injection subsystem (mxnet_tpu/faultinject.py,
+docs/RESILIENCE.md): env parsing, the four kinds, determinism of the
+seeded decision streams, the scoped context-manager API, zero-overhead
+no-op path, and per-site counters."""
+import errno
+import os
+import time
+
+import numpy as np
+import pytest
+
+import mxnet_tpu as mx  # noqa: F401
+from mxnet_tpu import faultinject as fi
+from mxnet_tpu import telemetry
+from mxnet_tpu.base import MXNetError
+
+
+@pytest.fixture(autouse=True)
+def _clean_env():
+    saved = os.environ.pop(fi.ENV_FAULTINJECT, None)
+    fi.refresh()
+    fi.reset_stats()
+    yield
+    if saved is None:
+        os.environ.pop(fi.ENV_FAULTINJECT, None)
+    else:
+        os.environ[fi.ENV_FAULTINJECT] = saved
+    fi.refresh()
+    fi.reset_stats()
+
+
+# ------------------------------------------------------------- no-op path
+def test_unset_is_noop_and_allocation_free():
+    for _ in range(100):
+        fi.fire("serving.dispatch")
+        assert fi.torn_fraction("checkpoint.write") is None
+    assert fi.stats() == {}
+    # the parse cache was never populated: the fast path bailed before it
+    assert fi._env_cache == (None, {})
+
+
+def test_empty_and_malformed_entries_do_not_raise():
+    os.environ[fi.ENV_FAULTINJECT] = \
+        "bogus,only:two,a:b:c:d,site:raise:2.0:1,,x:raise:notafloat:1"
+    fi.refresh()
+    fi.fire("site")  # every entry malformed -> no plans, no exception
+    assert fi.stats() == {}
+
+
+# ---------------------------------------------------------------- parsing
+def test_env_plan_fires_and_counts():
+    os.environ[fi.ENV_FAULTINJECT] = "my.site:raise:1.0:5"
+    fi.refresh()
+    with pytest.raises(fi.FaultInjected) as ei:
+        fi.fire("my.site")
+    assert ei.value.site == "my.site"
+    assert isinstance(ei.value, MXNetError)
+    fi.fire("other.site")  # plans are per-site
+    assert fi.stats() == {"my.site:raise": 1}
+
+
+def test_env_multiple_plans_and_arg():
+    os.environ[fi.ENV_FAULTINJECT] = \
+        "a.site:delay_ms:1.0:1:30,b.site:raise:1.0:2"
+    fi.refresh()
+    t0 = time.perf_counter()
+    fi.fire("a.site")
+    assert time.perf_counter() - t0 >= 0.025
+    with pytest.raises(fi.FaultInjected):
+        fi.fire("b.site")
+
+
+def test_raise_with_errno_arg_is_a_real_oserror():
+    with fi.inject("s", "raise", prob=1.0, seed=0, arg="ENOSPC"):
+        with pytest.raises(OSError) as ei:
+            fi.fire("s")
+    assert ei.value.errno == errno.ENOSPC
+
+
+def test_hang_kind_sleeps_arg_seconds():
+    with fi.inject("s", "hang", prob=1.0, seed=0, arg=0.05, times=1):
+        t0 = time.perf_counter()
+        fi.fire("s")
+        assert time.perf_counter() - t0 >= 0.04
+
+
+# ------------------------------------------------------------ determinism
+def _sequence(n):
+    """Which of n fire() calls raise, for the current env config."""
+    fired = []
+    for i in range(n):
+        try:
+            fi.fire("det.site")
+        except fi.FaultInjected:
+            fired.append(i)
+    return fired
+
+
+def test_same_seed_same_injected_event_sequence():
+    os.environ[fi.ENV_FAULTINJECT] = "det.site:raise:0.3:1234"
+    fi.refresh()
+    first = _sequence(200)
+    fi.refresh()  # fresh RNG stream, same seed
+    second = _sequence(200)
+    assert first == second
+    assert 20 < len(first) < 100  # prob 0.3 actually drew
+
+
+def test_different_seed_different_sequence():
+    os.environ[fi.ENV_FAULTINJECT] = "det.site:raise:0.3:1234"
+    fi.refresh()
+    first = _sequence(200)
+    os.environ[fi.ENV_FAULTINJECT] = "det.site:raise:0.3:99"
+    fi.refresh()
+    assert _sequence(200) != first
+
+
+def test_context_manager_determinism():
+    seqs = []
+    for _ in range(2):
+        fired = []
+        with fi.inject("c.site", "raise", prob=0.5, seed=7):
+            for i in range(100):
+                try:
+                    fi.fire("c.site")
+                except fi.FaultInjected:
+                    fired.append(i)
+        seqs.append(fired)
+    assert seqs[0] == seqs[1]
+
+
+# -------------------------------------------------------- context manager
+def test_inject_times_cap_and_scope():
+    with fi.inject("t.site", "raise", prob=1.0, seed=0, times=2) as plan:
+        for _ in range(2):
+            with pytest.raises(fi.FaultInjected):
+                fi.fire("t.site")
+        fi.fire("t.site")  # capped: no more fires
+        assert plan.fired == 2
+    fi.fire("t.site")  # out of scope: clean
+    assert fi.stats() == {"t.site:raise": 2}
+
+
+def test_inject_nests_and_overlays_env():
+    os.environ[fi.ENV_FAULTINJECT] = "n.site:delay_ms:1.0:1:5"
+    fi.refresh()
+    with fi.inject("n.site", "raise", prob=1.0, seed=0, times=1):
+        with pytest.raises(fi.FaultInjected):
+            fi.fire("n.site")  # ctx plan evaluates before the env plan
+    t0 = time.perf_counter()
+    fi.fire("n.site")  # env delay plan still live after the ctx exits
+    assert time.perf_counter() - t0 >= 0.004
+    counts = fi.stats()
+    assert counts["n.site:raise"] == 1 and counts["n.site:delay_ms"] >= 1
+
+
+# -------------------------------------------------------------- torn_write
+def test_torn_write_truncates_and_raises_eio(tmp_path):
+    from mxnet_tpu.checkpoint import atomic_write_bytes
+
+    path = str(tmp_path / "blob.bin")
+    atomic_write_bytes(path, b"x" * 100)
+    with fi.inject("checkpoint.write", "torn_write", prob=1.0, seed=0,
+                   arg=0.25, times=1):
+        with pytest.raises(OSError) as ei:
+            atomic_write_bytes(path, b"y" * 100)
+    assert ei.value.errno == errno.EIO
+    # the FINAL file is untouched (atomicity survives the injector)...
+    with open(path, "rb") as f:
+        assert f.read() == b"x" * 100
+    # ...and the torn prefix landed in the temp file
+    torn = [p for p in os.listdir(str(tmp_path)) if ".tmp." in p]
+    assert torn and os.path.getsize(str(tmp_path / torn[0])) == 25
+
+
+def test_torn_fraction_none_at_other_sites():
+    with fi.inject("checkpoint.write", "torn_write", prob=1.0, seed=0):
+        assert fi.torn_fraction("io.prefetch") is None
+        # and fire() at the torn site does nothing (torn is write-only)
+        fi.fire("checkpoint.write")
+        assert "checkpoint.write:raise" not in fi.stats()
+
+
+# --------------------------------------------------------------- counters
+def test_telemetry_counters_per_site(tm_counters=None):
+    telemetry.reset()
+    saved = telemetry.current_override()
+    try:
+        telemetry.set_mode("counters")
+        with fi.inject("cnt.site", "raise", prob=1.0, seed=0, times=3):
+            for _ in range(3):
+                with pytest.raises(fi.FaultInjected):
+                    fi.fire("cnt.site")
+        c = telemetry.counters()
+        assert c["faultinject.fired"] == 3
+        assert c["faultinject.cnt.site.raise"] == 3
+    finally:
+        telemetry.set_mode(saved)
+        telemetry.reset()
+
+
+def test_prefetch_site_surfaces_to_consumer():
+    from mxnet_tpu.io import NDArrayIter, PrefetchingIter
+
+    base = NDArrayIter(np.zeros((8, 3), "float32"),
+                       np.zeros((8,), "float32"), batch_size=4)
+    with fi.inject("io.prefetch", "raise", prob=1.0, seed=0, times=1):
+        it = PrefetchingIter(base)
+        with pytest.raises(fi.FaultInjected):
+            for _ in it:
+                pass

@@ -1,0 +1,482 @@
+"""Serving resilience layer + NaN-guarded training + checkpoint retry
+(docs/RESILIENCE.md): deadline-expired-while-queued is never dispatched,
+shed requests carry retry-after, hitless reload loses zero requests and
+causes zero retraces, the dispatch retry path recovers from a single
+injected failure, anomaly guard skip/raise/off on both training paths,
+and the checkpoint writer's transient-I/O retry."""
+import os
+import time
+
+import numpy as np
+import pytest
+
+import mxnet_tpu as mx
+from mxnet_tpu import faultinject as fi
+from mxnet_tpu import telemetry
+from mxnet_tpu.base import MXNetError
+from mxnet_tpu.serving import (InferenceEngine, PersistentExecutableCache,
+                               ServeClosedError, ServeDeadlineError,
+                               ServeOverloadError)
+from mxnet_tpu.serving.engine import ServeFuture
+
+
+@pytest.fixture(autouse=True)
+def _clean():
+    telemetry.reset()
+    saved = telemetry.current_override()
+    telemetry.set_mode("counters")
+    fi.reset_stats()
+    yield
+    telemetry.set_mode(saved)
+    telemetry.reset()
+    fi.reset_stats()
+
+
+def _mlp_net():
+    net = mx.sym.FullyConnected(mx.sym.Variable("data"), num_hidden=5,
+                                name="fc")
+    return mx.sym.SoftmaxOutput(net, name="softmax")
+
+
+def _mlp_params(seed=0):
+    rs = np.random.RandomState(seed)
+    return {"fc_weight": rs.randn(5, 8).astype("float32"),
+            "fc_bias": rs.randn(5).astype("float32")}
+
+
+def _engine(**kw):
+    params = kw.pop("params", None) or _mlp_params()
+    cache = PersistentExecutableCache(_mlp_net(), params, {}, cache_dir=None)
+    kw.setdefault("buckets", (1, 2, 4))
+    return InferenceEngine(cache, {"data": (8,)}, **kw)
+
+
+def _x(rows=1, fill=1.0):
+    return {"data": np.full((rows, 8), fill, "float32")}
+
+
+# ------------------------------------------------------------- deadlines
+def test_deadline_expired_while_queued_is_never_dispatched():
+    eng = _engine(name="dl").start()
+    eng.infer(_x())  # burn-in
+    c0 = telemetry.counters()
+    with fi.inject("serving.dispatch", "delay_ms", prob=1.0, seed=1,
+                   arg=250, times=1):
+        f1 = eng.submit(_x())           # occupies the batcher ~250ms
+        time.sleep(0.03)                # ensure f1 is in flight, not batched
+        f2 = eng.submit(_x(), deadline_ms=40)
+        with pytest.raises(ServeDeadlineError) as ei:
+            f2.result(timeout=5)
+        f1.result(timeout=5)
+    assert ei.value.queued_ms >= 40
+    c1 = telemetry.counters()
+    # exactly ONE batch dispatched (f1's); the expired request never rode
+    assert c1["serving.batches"] - c0.get("serving.batches", 0) == 1
+    assert c1["serving.deadline_expired"] - \
+        c0.get("serving.deadline_expired", 0) == 1
+    eng.close()
+
+
+def test_deadline_overrun_in_flight_still_delivers():
+    eng = _engine(name="dlov").start()
+    eng.infer(_x())
+    with fi.inject("serving.dispatch", "delay_ms", prob=1.0, seed=1,
+                   arg=120, times=1):
+        f = eng.submit(_x(), deadline_ms=30)  # taken immediately, overruns
+        out = f.result(timeout=5)             # ...but still delivers
+    assert out[0].shape == (1, 5)
+    assert telemetry.counters().get("serving.deadline_overrun", 0) >= 1
+    eng.close()
+
+
+def test_expired_request_fails_even_with_idle_queue():
+    """The batcher purge must not wait for the next arrival."""
+    eng = _engine(name="dlidle").start()
+    eng.infer(_x())
+    with fi.inject("serving.dispatch", "delay_ms", prob=1.0, seed=1,
+                   arg=200, times=1):
+        eng.submit(_x())
+        time.sleep(0.03)
+        f = eng.submit(_x(), deadline_ms=30)
+    t0 = time.perf_counter()
+    with pytest.raises(ServeDeadlineError):
+        f.result(timeout=5)
+    assert time.perf_counter() - t0 < 2.0
+    eng.close()
+
+
+# -------------------------------------------------------------- shedding
+def test_shed_carries_retry_after():
+    eng = _engine(name="shed").start()
+    eng.infer(_x())
+    with fi.inject("serving.dispatch", "delay_ms", prob=1.0, seed=2,
+                   arg=150, times=1):
+        fa = eng.submit(_x())
+        time.sleep(0.02)
+        fb = eng.submit(_x())  # keeps the queue non-empty
+        # a storm just set the observed queue wait very high
+        with eng._cond:
+            eng._ewma_wait_s = 0.5
+            eng._ewma_t = time.perf_counter()
+        with pytest.raises(ServeOverloadError) as ei:
+            eng.submit(_x(), deadline_ms=20)
+        fa.result(5), fb.result(5)
+    assert ei.value.retry_after_ms >= 20
+    c = telemetry.counters()
+    assert c["serving.shed"] == 1
+    h = eng.health()
+    assert h["recent_sheds"] == 1 and h["state"] == "degraded"
+    assert h["shed_rate"] > 0
+    eng.close()
+
+
+def test_empty_queue_floors_the_estimate():
+    """A stale storm estimate must not shed into an idle engine."""
+    eng = _engine(name="shedidle").start()
+    eng.infer(_x())
+    with eng._cond:
+        eng._ewma_wait_s = 5.0
+        eng._ewma_t = time.perf_counter()
+    out = eng.submit(_x(), deadline_ms=100).result(5)  # admitted
+    assert out[0].shape == (1, 5)
+    eng.close()
+
+
+def test_shed_disabled_via_knob():
+    eng = _engine(name="shedoff", shed="0").start()
+    eng.infer(_x())
+    with fi.inject("serving.dispatch", "delay_ms", prob=1.0, seed=2,
+                   arg=100, times=1):
+        fa = eng.submit(_x())
+        time.sleep(0.02)
+        fb = eng.submit(_x())
+        with eng._cond:
+            eng._ewma_wait_s = 0.5
+            eng._ewma_t = time.perf_counter()
+        f = eng.submit(_x(), deadline_ms=1)  # admitted: shedding is off
+        fa.result(5), fb.result(5)
+    with pytest.raises(ServeDeadlineError):
+        f.result(5)  # ...and then expires in queue instead
+    eng.close()
+
+
+# -------------------------------------------------------- dispatch retry
+def test_dispatch_retry_recovers_from_single_injected_failure():
+    eng = _engine(name="retry").start()
+    eng.infer(_x())
+    with fi.inject("serving.dispatch", "raise", prob=1.0, seed=3,
+                   times=1) as plan:
+        out = eng.infer(_x(), timeout=10)
+    assert plan.fired == 1
+    assert out[0].shape == (1, 5)
+    c = telemetry.counters()
+    assert c["serving.dispatch_retries"] == 1
+    assert c.get("serving.dispatch_failures", 0) == 0
+    assert eng.health()["state"] == "degraded"  # fault in the window
+    eng.close()
+
+
+def test_dispatch_retry_exhausted_fails_but_engine_survives():
+    eng = _engine(name="retryx").start()
+    eng.infer(_x())
+    with fi.inject("serving.dispatch", "raise", prob=1.0, seed=3):
+        with pytest.raises(fi.FaultInjected):
+            eng.infer(_x(), timeout=10)
+    # both attempts burned; the engine itself is NOT latched
+    out = eng.infer(_x(), timeout=10)
+    assert out[0].shape == (1, 5)
+    c = telemetry.counters()
+    assert c["serving.dispatch_retries"] == 1
+    assert c["serving.dispatch_failures"] == 1
+    eng.close()
+
+
+def test_health_recovers_after_window():
+    eng = _engine(name="heal", health_window_s=0.3).start()
+    eng.infer(_x())
+    with fi.inject("serving.dispatch", "raise", prob=1.0, seed=3, times=1):
+        eng.infer(_x(), timeout=10)
+    assert eng.health()["state"] == "degraded"
+    time.sleep(0.35)
+    assert eng.health()["state"] == "healthy"
+    eng.close()
+
+
+# ---------------------------------------------------------------- reload
+def test_reload_mid_load_zero_losses_zero_retraces():
+    params = _mlp_params()
+    eng = _engine(name="reload", params=params).start()
+    eng.infer(_x())
+    c0 = telemetry.counters()
+    before = eng.infer(_x())[0]
+    futs = [eng.submit(_x()) for _ in range(6)]
+    rfut = eng.reload({k: (v * 2.0).astype("float32")
+                       for k, v in params.items()})
+    futs += [eng.submit(_x()) for _ in range(6)]
+    for f in futs:
+        assert f.result(timeout=10)[0].shape == (1, 5)  # zero dropped
+    assert rfut.result(timeout=10) is True
+    after = eng.infer(_x())[0]
+    assert not np.allclose(before, after)  # new weights actually serve
+    c1 = telemetry.counters()
+    assert c1.get("executor.retrace", 0) == c0.get("executor.retrace", 0)
+    assert c1.get("executor.compile", 0) == c0.get("executor.compile", 0)
+    assert c1["serving.reloads"] == 1
+    assert eng.health()["reloads"] == 1
+    eng.close()
+
+
+def test_reload_is_a_fifo_barrier():
+    """Requests submitted before the reload compute on the OLD weights,
+    requests after it on the NEW ones — even when all of them are queued
+    behind one slow dispatch."""
+    params = _mlp_params()
+    eng = _engine(name="barrier", params=params, max_delay_ms=0.0).start()
+    eng.infer(_x())
+    old = eng.infer(_x())[0]
+    with fi.inject("serving.dispatch", "delay_ms", prob=1.0, seed=5,
+                   arg=100, times=1):
+        blocker = eng.submit(_x())
+        time.sleep(0.03)
+        pre = eng.submit(_x())
+        rfut = eng.reload({k: (v * 2.0).astype("float32")
+                           for k, v in params.items()})
+        post = eng.submit(_x())
+    assert np.allclose(pre.result(10)[0], old)
+    assert rfut.result(10)
+    assert not np.allclose(post.result(10)[0], old)
+    blocker.result(10)
+    eng.close()
+
+
+def test_reload_uncastable_value_rejected_before_any_write():
+    """Validation must be all-or-nothing: a bad SECOND key cannot leave
+    the first key already swapped (mixed old/new weights)."""
+    params = _mlp_params()
+    eng = _engine(name="mixedreload", params=params).start()
+    eng.infer(_x())
+    before = eng.infer(_x())[0]
+    bad = np.empty((5,), dtype=object)
+    bad[:] = "not a number"
+    with pytest.raises(MXNetError, match="not castable"):
+        eng.reload({"fc_weight": (params["fc_weight"] * 2.0),
+                    "fc_bias": bad}).result(10)
+    # NEITHER key was written — old weights serve unchanged
+    assert np.allclose(eng.infer(_x())[0], before)
+    eng.close()
+
+
+def test_reload_bad_shape_rejected_serving_continues():
+    eng = _engine(name="badreload").start()
+    eng.infer(_x())
+    before = eng.infer(_x())[0]
+    with pytest.raises(MXNetError, match="shape mismatch"):
+        eng.reload({"fc_weight": np.zeros((7, 8), "float32")}).result(10)
+    with pytest.raises(MXNetError, match="unknown"):
+        eng.reload({"nope": np.zeros((1,), "float32")}).result(10)
+    # old weights intact, engine serving
+    assert np.allclose(eng.infer(_x())[0], before)
+    eng.close()
+
+
+# ------------------------------------------------- shutdown + latch paths
+def test_close_no_drain_fails_queued_with_shutdown_error():
+    eng = _engine(name="closefast").start()
+    eng.infer(_x())
+    with fi.inject("serving.dispatch", "delay_ms", prob=1.0, seed=4,
+                   arg=250, times=1):
+        inflight = eng.submit(_x())
+        time.sleep(0.03)
+        queued = eng.submit(_x())
+        eng.close(drain=False)
+    with pytest.raises(ServeClosedError):
+        queued.result(timeout=5)
+    inflight.result(timeout=5)  # the in-flight batch still completes
+
+
+def test_result_on_latched_engine_raises_immediately():
+    eng = _engine(name="latch").start()
+    eng.infer(_x())
+    with fi.inject("serving.batcher", "raise", prob=1.0, seed=5, times=1):
+        # wake the batcher so its next loop iteration hits the injection
+        try:
+            eng.infer(_x(), timeout=5)
+        except MXNetError:
+            pass
+        deadline = time.time() + 5
+        while eng._fatal is None and time.time() < deadline:
+            time.sleep(0.01)
+    assert eng._fatal is not None
+    # a future bound to the latched engine resolves instantly, even with
+    # NO timeout — the case that used to block forever
+    f = ServeFuture(eng)
+    t0 = time.perf_counter()
+    with pytest.raises(MXNetError, match="latched"):
+        f.result()
+    assert time.perf_counter() - t0 < 1.0
+    with pytest.raises(MXNetError, match="latched"):
+        eng.submit(_x())
+    assert eng.health()["state"] == "latched"
+    assert telemetry.counters()["serving.batcher_deaths"] == 1
+
+
+# ------------------------------------------------------- checkpoint retry
+def test_checkpoint_retry_then_success(tmp_path):
+    from mxnet_tpu.checkpoint import Checkpointer, latest_complete
+
+    ck = Checkpointer(str(tmp_path))
+    with fi.inject("checkpoint.write", "torn_write", prob=1.0, seed=9,
+                   times=1) as plan:
+        ck.save_replicated(1, {"w": np.arange(4.0)}, block=True)
+    assert plan.fired == 1
+    got = latest_complete(str(tmp_path))
+    assert got is not None and got[0] == 1
+    assert telemetry.counters()["checkpoint.retries"] == 1
+    ck.close()
+
+
+def test_checkpoint_retry_exhausted_latches(tmp_path, monkeypatch):
+    from mxnet_tpu.checkpoint import Checkpointer, latest_complete
+
+    monkeypatch.setenv("MXNET_CHECKPOINT_RETRIES", "2")
+    ck = Checkpointer(str(tmp_path))
+    with fi.inject("checkpoint.write", "raise", prob=1.0, seed=9):
+        with pytest.raises(MXNetError, match="checkpoint write failed|"
+                                             "async checkpoint"):
+            ck.save_replicated(1, {"w": np.arange(4.0)}, block=True)
+    assert telemetry.counters()["checkpoint.retries"] == 2
+    assert latest_complete(str(tmp_path)) is None
+    # the latch cleared on raise; a clean save works again
+    ck.save_replicated(2, {"w": np.arange(4.0)}, block=True)
+    assert latest_complete(str(tmp_path))[0] == 2
+    ck.close()
+
+
+def test_checkpoint_nontransient_error_latches_without_retry(tmp_path,
+                                                             monkeypatch):
+    from mxnet_tpu.checkpoint import Checkpointer
+
+    ck = Checkpointer(str(tmp_path))
+    with fi.inject("checkpoint.write", "raise", prob=1.0, seed=1,
+                   arg="EACCES", times=1):
+        with pytest.raises(MXNetError):
+            ck.save_replicated(1, {"w": np.arange(4.0)}, block=True)
+    assert telemetry.counters().get("checkpoint.retries", 0) == 0
+    ck.close()
+
+
+def test_checkpoint_retries_env_parse(monkeypatch):
+    from mxnet_tpu.checkpoint import checkpoint_retries
+
+    assert checkpoint_retries() == 3
+    monkeypatch.setenv("MXNET_CHECKPOINT_RETRIES", "5")
+    assert checkpoint_retries() == 5
+    monkeypatch.setenv("MXNET_CHECKPOINT_RETRIES", "-2")
+    assert checkpoint_retries() == 0
+    monkeypatch.setenv("MXNET_CHECKPOINT_RETRIES", "junk")
+    assert checkpoint_retries() == 3
+
+
+# ----------------------------------------------------------- anomaly guard
+class _Batch:
+    def __init__(self, data, label):
+        self.data, self.label = data, label
+
+
+def _fit_module(fused, monkeypatch):
+    if fused:
+        monkeypatch.setenv("MXNET_MODULE_FUSED_STEP", "1")
+    net = mx.sym.SoftmaxOutput(
+        mx.sym.FullyConnected(mx.sym.Variable("data"), num_hidden=3,
+                              name="fc"), name="softmax")
+    mod = mx.mod.Module(net, context=mx.cpu(), fused_step=fused)
+    mod.bind(data_shapes=[("data", (4, 6))],
+             label_shapes=[("softmax_label", (4,))])
+    mod.init_params()
+    mod.init_optimizer(optimizer="sgd",
+                       optimizer_params=(("learning_rate", 0.1),))
+    return mod
+
+
+def _step(mod, nan=False):
+    x = np.ones((4, 6), "float32")
+    if nan:
+        x[0, 0] = np.nan
+    mod.forward_backward(_Batch([mx.nd.array(x)],
+                                [mx.nd.array(np.zeros((4,), "float32"))]))
+    mod.update()
+
+
+@pytest.mark.parametrize("fused", [False, True])
+def test_anomaly_guard_skip(fused, monkeypatch):
+    monkeypatch.setenv("MXNET_ANOMALY_GUARD", "skip")
+    mod = _fit_module(fused, monkeypatch)
+    _step(mod)  # clean step applies
+    w0 = mod.get_params()[0]["fc_weight"].asnumpy().copy()
+    _step(mod, nan=True)  # anomalous step drops
+    w1 = mod.get_params()[0]["fc_weight"].asnumpy()
+    assert np.array_equal(w0, w1)
+    assert mod.skipped_steps == 1
+    assert telemetry.counters()["trainer.skipped_steps"] == 1
+    _step(mod)  # training resumes, weights stay finite
+    w2 = mod.get_params()[0]["fc_weight"].asnumpy()
+    assert not np.array_equal(w1, w2) and np.isfinite(w2).all()
+
+
+@pytest.mark.parametrize("fused", [False, True])
+def test_anomaly_guard_raise_names_key(fused, monkeypatch):
+    monkeypatch.setenv("MXNET_ANOMALY_GUARD", "raise")
+    mod = _fit_module(fused, monkeypatch)
+    with pytest.raises(MXNetError, match="non-finite.*fc_"):
+        _step(mod, nan=True)
+    # state was left un-updated: a clean step still works and stays finite
+    monkeypatch.setenv("MXNET_ANOMALY_GUARD", "skip")
+    if not fused:  # legacy path re-reads the env per update
+        _step(mod)
+        assert np.isfinite(
+            mod.get_params()[0]["fc_weight"].asnumpy()).all()
+
+
+@pytest.mark.parametrize("fused", [False, True])
+def test_anomaly_guard_off_propagates(fused, monkeypatch):
+    monkeypatch.delenv("MXNET_ANOMALY_GUARD", raising=False)
+    mod = _fit_module(fused, monkeypatch)
+    _step(mod, nan=True)
+    assert not np.isfinite(
+        mod.get_params()[0]["fc_weight"].asnumpy()).all()
+    assert mod.skipped_steps == 0
+
+
+def test_anomaly_guard_skip_clears_accumulated_grads(monkeypatch):
+    """grad_req='add' accumulates across steps: a skipped step must zero
+    the poisoned buffers or every later step inherits the NaN."""
+    monkeypatch.setenv("MXNET_ANOMALY_GUARD", "skip")
+    net = mx.sym.SoftmaxOutput(
+        mx.sym.FullyConnected(mx.sym.Variable("data"), num_hidden=3,
+                              name="fc"), name="softmax")
+    mod = mx.mod.Module(net, context=mx.cpu(), fused_step=False)
+    mod.bind(data_shapes=[("data", (4, 6))],
+             label_shapes=[("softmax_label", (4,))], grad_req="add")
+    mod.init_params()
+    mod.init_optimizer(optimizer="sgd",
+                       optimizer_params=(("learning_rate", 0.1),))
+    _step(mod, nan=True)
+    assert mod.skipped_steps == 1
+    w1 = mod.get_params()[0]["fc_weight"].asnumpy().copy()
+    _step(mod)  # clean step: the cleared buffers accumulate fresh grads
+    w2 = mod.get_params()[0]["fc_weight"].asnumpy()
+    assert mod.skipped_steps == 1  # no further skips
+    assert not np.array_equal(w1, w2) and np.isfinite(w2).all()
+
+
+def test_anomaly_guard_mode_parse(monkeypatch):
+    from mxnet_tpu.base import anomaly_guard_mode
+
+    monkeypatch.delenv("MXNET_ANOMALY_GUARD", raising=False)
+    assert anomaly_guard_mode() is None
+    monkeypatch.setenv("MXNET_ANOMALY_GUARD", "skip")
+    assert anomaly_guard_mode() == "skip"
+    monkeypatch.setenv("MXNET_ANOMALY_GUARD", "RAISE")
+    assert anomaly_guard_mode() == "raise"
+    monkeypatch.setenv("MXNET_ANOMALY_GUARD", "bogus")
+    assert anomaly_guard_mode() is None

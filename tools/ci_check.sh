@@ -22,7 +22,10 @@
 # bucketed allreduce bandwidth floor (docs/PERF.md §11). Step 6 runs the serving
 # engine smoke (tools/serve_bench.py --check): QPS/p99 under a tiny
 # open-loop load with zero post-warmup retraces, for both the bucketed
-# engine and the transformer KV-cache decode path (docs/SERVING.md).
+# engine and the transformer KV-cache decode path (docs/SERVING.md), plus
+# the serving CHAOS smoke (--chaos): deterministic fault injection on the
+# dispatch path + a mid-run hitless weight reload, gated on zero hung
+# futures, zero retraces, and recovery to `healthy` (docs/RESILIENCE.md).
 # Step 7 runs the elastic fault-tolerance chaos smoke
 # (tests/nightly/dist_elastic_chaos.py --orchestrate): an 8-process
 # Module.fit in sharded-update mode with periodic async checkpoints, one
@@ -166,6 +169,16 @@ JAX_PLATFORMS=cpu MXNET_DEFAULT_CONTEXT=cpu \
 python tools/serve_bench.py --model transformer-decode --qps 16 \
     --duration 1 --rows 2 --check \
     || { echo "serve_bench kv-decode smoke FAILED"; exit 1; }
+# serving chaos smoke (docs/RESILIENCE.md): open-loop load with seeded
+# dispatch raises + delays injected (mxnet_tpu/faultinject.py) and one
+# mid-run hitless reload(); the gate asserts zero hung futures (every
+# request reaches a terminal state), zero post-warmup retraces/compiles,
+# the reload applied, p99 of completed requests in bound, and the engine
+# back to `healthy` once injection stops
+JAX_PLATFORMS=cpu MXNET_DEFAULT_CONTEXT=cpu \
+python tools/serve_bench.py --model mlp --chaos --qps 150 --duration 2 \
+    --check \
+    || { echo "serve_bench chaos smoke FAILED"; exit 1; }
 
 echo "== [7/8] elastic: 8-proc chaos smoke (docs/FAULT_TOLERANCE.md) =="
 # kill 1 of 8 workers mid-fit: survivors pause, re-form to 7, reseed from

@@ -23,8 +23,19 @@ instead: per-token decode-step latency and tokens/s over batched streams
 (prefill bucket + single-token decode executable, zero retraces across
 positions).
 
+``--chaos`` is the serving resilience smoke (docs/RESILIENCE.md): the same
+open-loop load, but with deterministic fault injection live on the
+dispatch path (``serving.dispatch`` raise + delay plans,
+mxnet_tpu/faultinject.py) and one hitless ``reload()`` fired mid-run. The
+gate (with ``--check``) asserts ZERO hung futures (every request resolves
+with a terminal state: completed | shed | deadline-failed |
+injected-fault-after-retry), zero post-warmup retraces/compiles, the
+reload applied, p99 of *completed* requests within ``--p99-bound-ms``, and
+the engine back to ``healthy`` once injection stops.
+
     python tools/serve_bench.py --model mlp --qps 200 --duration 3 --json
     python tools/serve_bench.py --model lenet --compare-batch1 --check
+    python tools/serve_bench.py --model mlp --chaos --qps 150 --duration 2 --check
 """
 from __future__ import annotations
 
@@ -248,6 +259,168 @@ def bench_decode(args):
     }
 
 
+def bench_chaos(args):
+    """Open-loop load under injected dispatch faults + one mid-run hitless
+    reload; classifies every request's terminal state."""
+    import mxnet_tpu  # noqa: F401  (package import before submodules)
+    from mxnet_tpu import faultinject as fi
+    from mxnet_tpu.serving import (InferenceEngine,
+                                   PersistentExecutableCache,
+                                   ServeDeadlineError, ServeOverloadError)
+
+    net, arg_params, aux_params, item = _build_model(args.model)
+    buckets = tuple(int(b) for b in args.buckets.split(","))
+    cache = PersistentExecutableCache(net, arg_params, aux_params,
+                                      cache_dir=args.cache_dir,
+                                      model_key=args.model + "-chaos")
+    eng = InferenceEngine(cache, {"data": item}, buckets=buckets,
+                          max_delay_ms=args.max_delay_ms,
+                          name=args.model + "-chaos",
+                          deadline_ms=args.chaos_deadline_ms,
+                          health_window_s=1.0)
+    eng.start()
+    eng.infer({"data": np.zeros((args.rows,) + item, "float32")})  # burn-in
+    c_warm = _counters()
+    fi.reset_stats()
+    # the weights the mid-run reload swaps in (same shapes — zero retraces)
+    new_params = {k: (v * 1.05 + 0.01).astype("float32")
+                  for k, v in arg_params.items()}
+
+    rs = np.random.RandomState(1)
+    payloads = [rs.rand(args.rows, *item).astype("float32")
+                for _ in range(8)]
+    futs = []          # (t_submit, future or terminal-class string)
+    reload_fut = None
+    start = time.perf_counter()
+    interval = 1.0 / args.qps
+    n = 0
+    half = args.duration / 2.0
+    with fi.inject("serving.dispatch", "raise", prob=args.chaos_fail_prob,
+                   seed=7), \
+         fi.inject("serving.dispatch", "delay_ms",
+                   prob=args.chaos_delay_prob, seed=11,
+                   arg=args.chaos_delay_ms):
+        while True:
+            now = time.perf_counter()
+            if now - start >= args.duration:
+                break
+            if reload_fut is None and now - start >= half:
+                reload_fut = eng.reload(new_params)
+            target = start + n * interval
+            if target > now:
+                time.sleep(target - now)
+            t0 = time.perf_counter()
+            try:
+                futs.append((t0, eng.submit({"data": payloads[n % 8]})))
+            except ServeOverloadError:
+                futs.append((t0, "shed"))
+            except Exception:
+                futs.append((t0, "rejected"))  # backpressure etc.
+            n += 1
+    elapsed = time.perf_counter() - start
+
+    counts = {"completed": 0, "shed": 0, "deadline": 0, "fault": 0,
+              "rejected": 0, "hung": 0}
+    lat = []
+    for t0, f in futs:
+        if isinstance(f, str):
+            counts[f] += 1
+            continue
+        try:
+            f.result(timeout=60.0)
+            counts["completed"] += 1
+            lat.append((f.done_at - t0) * 1000.0)
+        except ServeDeadlineError:
+            counts["deadline"] += 1
+        except ServeOverloadError:
+            counts["shed"] += 1
+        except Exception:
+            # terminal only if the future actually resolved; an unresolved
+            # future after 60s is a HUNG request — the one chaos outcome
+            # that must never happen
+            counts["fault" if f.done() else "hung"] += 1
+    reload_ok = False
+    if reload_fut is not None:
+        try:
+            reload_ok = bool(reload_fut.result(timeout=30.0))
+        except Exception:
+            reload_ok = False
+
+    # injection is over (context exited): a short clean run, then let the
+    # recent-fault window drain — the engine must report healthy again
+    for _ in range(10):
+        eng.infer({"data": payloads[0]}, timeout=30.0)
+    time.sleep(eng.health_window_s + 0.2)
+    health = eng.health()
+    c_end = _counters()
+    fired = fi.stats()
+    p50, p99 = _percentiles(lat)
+    eng.close()
+    return {
+        "mode": "chaos",
+        "model": args.model,
+        "buckets": list(buckets),
+        "offered_qps": args.qps,
+        "duration_s": args.duration,
+        "requests": n,
+        "elapsed_s": round(elapsed, 3),
+        "resolved": counts,
+        "qps": round(counts["completed"] / elapsed, 2) if elapsed else 0.0,
+        "p50_ms": None if p50 is None else round(p50, 3),
+        "p99_ms": None if p99 is None else round(p99, 3),
+        "reload_applied": reload_ok,
+        "health_after": health,
+        "injected": fired,
+        "dispatch_retries": c_end.get("serving.dispatch_retries", 0)
+        - c_warm.get("serving.dispatch_retries", 0),
+        "deadline_expired": c_end.get("serving.deadline_expired", 0)
+        - c_warm.get("serving.deadline_expired", 0),
+        "retraces_post_warmup": c_end.get("executor.retrace", 0)
+        - c_warm.get("executor.retrace", 0),
+        "compiles_post_warmup": c_end.get("executor.compile", 0)
+        - c_warm.get("executor.compile", 0),
+        "p99_bound_ms": args.p99_bound_ms,
+    }
+
+
+def _check_chaos(res):
+    ok = True
+
+    def _fail(msg):
+        nonlocal ok
+        ok = False
+        sys.stderr.write("serve_bench --chaos --check FAILED: %s\n" % msg)
+
+    counts = res["resolved"]
+    if counts["hung"]:
+        _fail("%d request(s) HUNG (future unresolved after 60s)"
+              % counts["hung"])
+    terminal = sum(counts.values())
+    if terminal != res["requests"]:
+        _fail("resolved %d of %d offered requests" % (terminal,
+                                                      res["requests"]))
+    if not counts["completed"]:
+        _fail("no request completed under chaos")
+    if res["retraces_post_warmup"]:
+        _fail("post-warmup retraces: %d" % res["retraces_post_warmup"])
+    if res["compiles_post_warmup"]:
+        _fail("post-warmup compiles: %d" % res["compiles_post_warmup"])
+    if not res["reload_applied"]:
+        _fail("mid-run reload() did not apply")
+    if res["health_after"].get("state") != "healthy":
+        _fail("engine did not return to healthy after injection stopped: "
+              "%s" % res["health_after"])
+    if not any(k.startswith("serving.dispatch:") for k in res["injected"]):
+        _fail("no faults were actually injected: %s" % res["injected"])
+    if not res["dispatch_retries"]:
+        _fail("the dispatch retry path never fired under injected faults")
+    p99 = res.get("p99_ms")
+    if p99 is None or not math.isfinite(p99) or p99 > res["p99_bound_ms"]:
+        _fail("p99 of completed requests %r ms outside bound %r ms"
+              % (p99, res["p99_bound_ms"]))
+    return ok
+
+
 def _check(res, trace_families):
     ok = True
 
@@ -296,10 +469,25 @@ def main(argv=None):
     ap.add_argument("--quant", default=None, choices=[None, "off", "bf16",
                                                       "int8"],
                     help="sets MXNET_SERVE_QUANT for the run")
+    ap.add_argument("--chaos", action="store_true",
+                    help="serving resilience smoke: open-loop load with "
+                         "injected dispatch raises/delays + one mid-run "
+                         "hitless reload (docs/RESILIENCE.md)")
+    ap.add_argument("--chaos-fail-prob", type=float, default=0.1,
+                    help="per-dispatch injected-raise probability")
+    ap.add_argument("--chaos-delay-prob", type=float, default=0.2,
+                    help="per-dispatch injected-delay probability")
+    ap.add_argument("--chaos-delay-ms", type=float, default=15.0)
+    ap.add_argument("--chaos-deadline-ms", type=float, default=300.0,
+                    help="per-request deadline under chaos")
+    ap.add_argument("--p99-bound-ms", type=float, default=1500.0,
+                    help="chaos gate: p99 of COMPLETED requests must stay "
+                         "under this")
     ap.add_argument("--json", action="store_true")
     ap.add_argument("--check", action="store_true",
                     help="CI gate: assert qps>0, finite p99, zero "
-                         "post-warmup retraces/compiles, serving.* spans")
+                         "post-warmup retraces/compiles, serving.* spans "
+                         "(with --chaos: the resilience gate)")
     args = ap.parse_args(argv)
 
     if args.quant:
@@ -307,7 +495,12 @@ def main(argv=None):
     from mxnet_tpu import telemetry
 
     telemetry.set_mode("trace" if args.check else "counters")
-    if args.model == "transformer-decode":
+    if args.chaos:
+        if args.model == "transformer-decode":
+            ap.error("--chaos drives the bucketed engine; pick an "
+                     "ITEM_SHAPES model")
+        res = bench_chaos(args)
+    elif args.model == "transformer-decode":
         res = bench_decode(args)
     else:
         res = bench_engine(args)
@@ -315,8 +508,11 @@ def main(argv=None):
 
     ok = True
     if args.check:
-        families = {e[0] for e in telemetry.drain_events()}
-        ok = _check(res, families)
+        if args.chaos:
+            ok = _check_chaos(res)
+        else:
+            families = {e[0] for e in telemetry.drain_events()}
+            ok = _check(res, families)
         res["check"] = "ok" if ok else "FAILED"
     if args.json or args.check:
         print(json.dumps(res))
