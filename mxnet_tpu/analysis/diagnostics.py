@@ -81,6 +81,8 @@ CODES = {
               "convolution rejected by the conv+BN fusion planner"),
     "GL302": (Severity.INFO,
               "BatchNorm not folded into its consumers by the fusion planner"),
+    "GL303": (Severity.INFO,
+              "generic fusion-pattern site inventory / near-miss rejection"),
     # --- sharding-plan lint ------------------------------------------------
     "GL401": (Severity.WARNING,
               "parameter silently replicated: no dim divides the model axis"),

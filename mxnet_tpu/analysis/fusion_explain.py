@@ -1,13 +1,21 @@
-"""Fusion-eligibility explainer (GL301/GL302).
+"""Fusion-eligibility explainer (GL301/GL302/GL303).
 
-``fusion.plan`` silently skips every conv/BN it cannot rewrite onto the
-Pallas kernel stack — correct, but invisible: a model author who expected
-the fused path has no way to learn *which* predicate failed short of
-reading the planner. This pass re-runs the plan and reports, for every
-rejected Convolution (GL301) and every unfolded BatchNorm (GL302), the
-exact predicate, quoting ``fusion.conv_reject_reason`` /
-``fusion.bn_reject_reason`` for op-level predicates and re-deriving the
-consumer-structure predicates for fold rejections.
+``fusion.plan`` silently skips every subgraph it cannot rewrite — correct,
+but invisible: a model author who expected the fused path has no way to
+learn *which* predicate failed short of reading the planner. This pass
+re-runs the plan and reports, for every rejected Convolution (GL301) and
+every unfolded BatchNorm (GL302), the exact predicate, quoting
+``fusion.conv_reject_reason`` / ``fusion.bn_reject_reason`` for op-level
+predicates and re-deriving the consumer-structure predicates for fold
+rejections.
+
+GL303 covers the generic pattern engine (ops/fusion_patterns.py): for
+every node a pattern ALMOST rooted (a FullyConnected whose consumer is not
+a fusable Activation, a broadcast_add whose LayerNorm chain broke one link
+deep, ...) it quotes the pattern's ``reject_reason``; for every planned
+pattern root it reports the site inventory — the engage itself is a
+per-shape trace-time decision (the fusion_tune measured verdict, whose
+tuned-and-rejected reasons carry the measured fused-vs-baseline µs).
 
 All findings are INFO severity: an unfused graph is slower, not wrong.
 """
@@ -106,4 +114,39 @@ def fusion_explain(ctx: GraphContext):
                     fix_hint="a fold needs every consumer of the BN(+relu) "
                              "output to be the data input of a fusable conv",
                 ))
+    diags.extend(_explain_patterns(ctx, directives))
+    return diags
+
+
+def _explain_patterns(ctx: GraphContext, directives):
+    """GL303: NEAR-MISS rejections of the generic pattern engine — a node
+    that almost rooted a pattern (e.g. a FullyConnected whose fusable
+    Activation consumer is not its sole consumer) with the failed
+    predicate. Deliberately quiet: a node that simply isn't a pattern's
+    shape is not a finding (a clean model must lint clean), and the
+    planned-site inventory lives on ``Report.memory_plan["fusion"]`` and
+    the serving cache's ``fusion_sites()``, not here."""
+    from .. import fusion
+    from ..ops.fusion_patterns import get_patterns
+
+    diags = []
+    modes = fusion.enabled_patterns()
+    pctx = fusion._PlanCtx(
+        ctx.consumers, {id(n) for n, _ in ctx.symbol._outputs}, directives)
+    for node in ctx.topo:
+        if node.is_variable or directives.get(id(node)) is not None:
+            continue
+        for pat in get_patterns():
+            if modes.get(pat.name, "0") == "0":
+                continue
+            reason = pat.reject_reason(node, pctx)
+            if reason is not None:
+                diags.append(Diagnostic(
+                    "GL303",
+                    "not rooted by the %r pattern: %s" % (pat.name, reason),
+                    node=node.name, op=node.op,
+                    fix_hint="pattern matchers are structural; see "
+                             "ops/fusion_patterns.py for the contract",
+                ))
+                break
     return diags

@@ -195,6 +195,7 @@ def plan_memory(ctx: GraphContext):
 
     act_peak = max(peak, 0)
     total = base + act_peak
+    fusion_info = _fusion_byte_view(ctx, op_nodes, sizes, stash_all)
     plan = {
         "per_device": {
             "params": int(params),
@@ -215,7 +216,43 @@ def plan_memory(ctx: GraphContext):
         "budget_bytes": (int(ctx.budget_bytes)
                          if ctx.budget_bytes is not None else None),
     }
+    if fusion_info is not None:
+        plan["fusion"] = fusion_info
     return plan
+
+
+def _fusion_byte_view(ctx, op_nodes, sizes, stash_all):
+    """The fusion pattern engine's byte view of this graph: per-pattern
+    site counts and the interior (pattern-elided) bytes — activations that
+    never materialize when their site engages. Under the ``stash`` policy
+    those interiors would otherwise be HELD across the fwd→bwd transition,
+    so ``stash_elidable_bytes`` is the stash-watermark headroom (in bytes)
+    the engine can unlock there (0 under recompute/inference, where the
+    interiors are transient anyway); the prediction above stays the
+    conservative (unfused) upper bound. None when no pattern roots in
+    this graph."""
+    try:
+        from .. import fusion
+
+        directives = fusion.plan(
+            ctx.topo, output_ids={id(n) for n, _ in ctx.symbol._outputs})
+        sites, interior = {}, 0
+        for node in op_nodes:
+            d = directives.get(id(node))
+            if d is None:
+                continue
+            if d["kind"] == "pattern":
+                sites[d["pat"].name] = sites.get(d["pat"].name, 0) + 1
+            elif d["kind"] == "lazy":
+                interior += sizes.get((id(node), 0), 0)
+        if not sites:
+            return None
+        return {"pattern_sites": sites,
+                "interior_bytes": int(interior),
+                "stash_elidable_bytes":
+                    int(interior) if (ctx.train and stash_all) else 0}
+    except Exception:  # the refinement must never sink the prediction
+        return None
 
 
 @graph_pass("memory_plan")

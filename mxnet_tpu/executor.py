@@ -43,13 +43,16 @@ class _GraphProgram:
         self.symbol = symbol
         self.topo = symbol._topo()
         self.group2ctx = dict(group2ctx or {})
-        # conv+BN fusion plan (fusion.py): structural rewrite map onto the
-        # Pallas kernel stack; disabled under ctx-group placement (the fused
-        # subgraph would straddle a device boundary) and by env kill-switch
+        # fusion plan (fusion.py): structural rewrite map covering the
+        # conv+BN Pallas stack AND the generic pattern engine (attention,
+        # matmul+bias+act, norm+residual, elementwise chains — each gated
+        # per shape by the fusion_tune measured verdict); disabled under
+        # ctx-group placement (a fused subgraph would straddle a device
+        # boundary). plan() itself honors the MXNET_FUSED_CONV_BN /
+        # MXNET_FUSED_PATTERNS kill-switches and returns {} when all off.
         self._fusion_plan = {}
         self._infer_fusion = False
-        if fusion and not self.group2ctx and \
-                os.environ.get("MXNET_FUSED_CONV_BN", "auto") != "0":
+        if fusion and not self.group2ctx:
             from . import fusion as _fusion
 
             # graph-output node ids keep the planner from deferring (or
@@ -58,11 +61,14 @@ class _GraphProgram:
             # escape interpret() into the jit output pytree (Group symbols)
             self._fusion_plan = _fusion.plan(
                 self.topo, output_ids={id(n) for n, _ in symbol._outputs})
-            # grad-less/inference executions additionally need the plan
-            # declared ACTIVE for is_train=False (fusion.infer_default():
-            # forced env, on-device WINS match, or a quantized variant) —
-            # the default keeps CPU eval numerics byte-identical to the
-            # unfused op-by-op lowering
+            # grad-less/inference executions additionally need the CONV+BN
+            # side of the plan declared ACTIVE for is_train=False
+            # (fusion.infer_default(): forced env, on-device WINS match, or
+            # a quantized variant) — the default keeps CPU eval numerics
+            # byte-identical to the unfused op-by-op lowering. Generic
+            # pattern directives stay live at inference (their fallback IS
+            # the unfused lowering; per-pattern inference gating happens in
+            # fusion.gate_pattern_explain).
             self._infer_fusion = bool(self._fusion_plan) \
                 and _fusion.infer_default()
         # PlaceDevice-pass analogue (reference: graph_executor.cc:242
@@ -145,8 +151,7 @@ class _GraphProgram:
         """Run the graph on jax values. Returns (outputs, new_aux_tuple)."""
         import jax
 
-        fusion_on = bool(self._fusion_plan) \
-            and (is_train or self._infer_fusion)
+        fusion_on = bool(self._fusion_plan)
         if fusion_on:
             from . import fusion as _fusion
 
@@ -164,6 +169,13 @@ class _GraphProgram:
             n_aux = len(opdef.aux_names(parsed))
             ins = [vals[(id(inp), oi)] for inp, oi in node.inputs]
             directive = self._fusion_plan.get(id(node)) if fusion_on else None
+            if (directive is not None and not is_train
+                    and not self._infer_fusion
+                    and directive["kind"] in _fusion.CONV_BN_KINDS):
+                # inference with the conv+BN plan INACTIVE: those nodes run
+                # the plain op-by-op lowering (byte-identical eval); generic
+                # pattern directives stay live
+                directive = None
             if directive is not None:
                 outs, aux_out = _fusion.execute(
                     directive, node,

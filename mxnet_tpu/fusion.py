@@ -1,6 +1,9 @@
-"""Conv+BatchNorm fusion plan for the graph executor (round-5 perf work).
+"""Pattern-based subgraph fusion over the Symbol DAG.
 
-The reference reached vendor-kernel conv+BN throughput via cuDNN
+Two generations of machinery live here, one engine:
+
+**Conv+BN (the first migrated pattern, PR 2/round-5 perf work).** The
+reference reached vendor-kernel conv+BN throughput via cuDNN
 (/root/reference/src/operator/cudnn_convolution-inl.h with the CUDNN BN /
 fused-add epilogues of batch_norm.cu); the TPU translation is a graph pass
 that rewrites eligible subgraphs onto the Pallas kernel in
@@ -30,6 +33,18 @@ Autodiff: only the Pallas kernel is a custom_vjp; the per-channel BN math
 here (mean/var from sums, scale/shift, moving-stat updates) is plain traced
 JAX, so gradients for gamma/beta flow through ``scale32``/``shift32`` into
 the kernel's hand-written f32-accumulated prologue cotangents.
+
+**The generic pattern engine (this round).** ``ops/fusion_patterns.py``
+declares matchers + fused lowerings for matmul+bias+act, attention,
+norm+residual and elementwise chains; ``plan()`` roots each match in the
+directive map (interior nodes elide behind ``Lazy`` markers), and the
+per-(pattern, shape, dtype, device-kind) engage decision comes from the
+persistent measure-and-cache autotuner (``fusion_tune.py``) — TVM's
+measured-schedule discipline replacing the committed WINS table, which
+remains the conv+BN seed/fallback when tuning is disabled
+(``MXNET_FUSION_TUNE_DIR`` unset). ``MXNET_FUSED_PATTERNS`` selects and
+forces patterns (docs/ENV_VARS.md); every fallback path — gate declined,
+tuner rejected, lowering unavailable — is the bit-identical unfused graph.
 """
 from __future__ import annotations
 
@@ -45,7 +60,13 @@ from . import telemetry as _tm
 
 __all__ = ["plan", "execute", "resolve", "gate", "gate_explain", "bwd_mode",
            "conv_reject_reason", "bn_reject_reason", "infer_default",
-           "quant_mode"]
+           "quant_mode", "enabled_patterns", "gate_pattern_explain",
+           "CONV_BN_KINDS"]
+
+#: directive kinds owned by the conv+BN machinery — the executor masks these
+#: (only) on inference executions where ``infer_default()`` declined, keeping
+#: CPU eval numerics byte-identical to the unfused op-by-op lowering
+CONV_BN_KINDS = frozenset({"conv", "bn", "relu_fold", "resadd"})
 
 
 # --------------------------------------------------------------------- values
@@ -104,11 +125,36 @@ class PendingConv:
                           self.bwd)
 
 
+class Lazy:
+    """A pattern-interior node's not-yet-computed output. Carries the node
+    and its raw input values (possibly markers themselves); ``materialize()``
+    runs the ordinary opdef — the bit-identical unfused semantics — and
+    caches, so a marker consumed by both its pattern root (which fell back)
+    and nothing else still computes at most once."""
+
+    __slots__ = ("node", "ins", "_mat")
+
+    def __init__(self, node, ins):
+        self.node, self.ins = node, list(ins)
+        self._mat = None
+
+    def materialize(self):
+        if self._mat is None:
+            from .ops.registry import get_op
+
+            vals = [resolve(v) for v in self.ins]
+            outs, _ = get_op(self.node.op).apply(
+                self.node.parsed_attrs(), vals, aux=[], is_train=False,
+                rng=None)
+            self._mat = outs[0]
+        return self._mat
+
+
 def resolve(v):
     """Any op that is not fusion-aware sees a plain tensor."""
     if isinstance(v, WithStats):
         return v.c
-    if isinstance(v, Deferred):
+    if isinstance(v, (Deferred, Lazy)):
         return v.materialize()
     if isinstance(v, PendingConv):
         # defensive: plan() keeps graph-output convs out of the defer
@@ -213,17 +259,84 @@ def _bn_ok(node):
     return bn_reject_reason(node) is None
 
 
+def enabled_patterns(infer=False):
+    """Per-pattern mode map from ``MXNET_FUSED_PATTERNS``: name ->
+    ``"auto"`` (engage per measured verdict), ``"1"`` (force the first
+    candidate lowering), or ``"0"`` (off). Grammar: ``auto``/``all`` (every
+    pattern in auto, the default), ``0``/``off``/``none``, or a comma list
+    of names with optional forces (``attention,matmul_bias_act=1``) —
+    listed patterns get their mode, unlisted ones are off. The conv+BN
+    pattern is governed by its own ``MXNET_FUSED_CONV_BN[_BWD]`` knobs.
+
+    ``infer=True`` is the serving/grad-less gate: when
+    ``MXNET_FUSED_PATTERNS_INFER`` is set it overrides the training map on
+    inference executions only (same grammar), so a serving fleet can pin
+    its own pattern set — e.g. disable a pattern whose inference shapes
+    were never tuned — without touching training behavior."""
+    from .ops.fusion_patterns import pattern_names
+
+    names = pattern_names()
+    env = os.environ.get("MXNET_FUSED_PATTERNS", "auto").strip().lower()
+    if infer:
+        env = os.environ.get("MXNET_FUSED_PATTERNS_INFER",
+                             env).strip().lower() or env
+    if env in ("", "auto", "all", "1"):
+        return {n: "auto" for n in names}
+    if env in ("0", "off", "none"):
+        return {n: "0" for n in names}
+    modes = {n: "0" for n in names}
+    for item in env.split(","):
+        item = item.strip()
+        if not item:
+            continue
+        if item in ("auto", "all"):
+            modes = {n: "auto" for n in names}
+            continue
+        name, _, val = item.partition("=")
+        if name in modes:
+            modes[name] = val if val in ("0", "1") else "auto"
+        else:
+            global _warned_patterns_env
+            if not _warned_patterns_env:
+                _warned_patterns_env = True
+                import logging
+
+                logging.getLogger("mxnet_tpu").warning(
+                    "MXNET_FUSED_PATTERNS names unknown pattern %r "
+                    "(known: %s)", name, ", ".join(names))
+    return modes
+
+
+_warned_patterns_env = False
+
+
+class _PlanCtx:
+    """What pattern matchers may see of the graph: the consumer map, the
+    program-output ids, and the directives built so far (``claimed``)."""
+
+    __slots__ = ("consumers", "output_ids", "claimed")
+
+    def __init__(self, consumers, output_ids, claimed):
+        self.consumers, self.output_ids = consumers, output_ids
+        self.claimed = claimed
+
+
 def plan(topo, output_ids=()):
     """Build the fusion plan: id(node) -> directive dict. Structural only.
+
+    Two passes: the conv+BN rewrites (unless ``MXNET_FUSED_CONV_BN=0``),
+    then each enabled generic pattern (``enabled_patterns()``) in priority
+    order over the still-unclaimed nodes — a matched root gets a
+    ``pattern`` directive, its interior nodes ``lazy`` markers.
 
     ``output_ids`` are the ids of nodes whose outputs are PROGRAM outputs
     (executor passes them from the bound symbol). A graph-output node has an
     implicit extra consumer the ``consumers`` map cannot see: its value must
     materialize, so it is excluded from the prologue-fold rewrite (the fold
-    would save nothing) and from the residual-defer rewrite (a deferred
+    would save nothing), from the residual-defer rewrite (a deferred
     conv's ``PendingConv`` marker would otherwise escape ``interpret()`` as
     a program output and fail at jit trace time under
-    ``MXNET_FUSED_CONV_BN=1``)."""
+    ``MXNET_FUSED_CONV_BN=1``), and from every pattern interior."""
     output_ids = frozenset(output_ids)
     consumers = {}
     for node in topo:
@@ -232,6 +345,39 @@ def plan(topo, output_ids=()):
     order = {id(n): i for i, n in enumerate(topo)}
 
     directives = {}
+    if os.environ.get("MXNET_FUSED_CONV_BN", "auto") != "0":
+        _plan_conv_bn(topo, output_ids, consumers, order, directives)
+
+    # a pattern is PLANNED when either the training or the inference map
+    # enables it (the per-execution gate re-reads the right map); the plan
+    # is shared by both execution modes of a program
+    modes = enabled_patterns()
+    for name, mode in enabled_patterns(infer=True).items():
+        if modes.get(name, "0") == "0" and mode != "0":
+            modes[name] = mode
+    if any(v != "0" for v in modes.values()):
+        from .ops.fusion_patterns import get_patterns
+
+        ctx = _PlanCtx(consumers, output_ids, directives)
+        for pat in get_patterns():
+            if modes.get(pat.name, "0") == "0":
+                continue
+            for node in topo:
+                if node.is_variable or id(node) in directives:
+                    continue
+                m = pat.match(node, ctx)
+                if m is None:
+                    continue
+                directives[id(node)] = {"kind": "pattern", "pat": pat,
+                                        "meta": m.meta}
+                for n in m.interior:
+                    directives[id(n)] = {"kind": "lazy"}
+    return directives
+
+
+def _plan_conv_bn(topo, output_ids, consumers, order, directives):
+    """The conv+BN rewrite pass (prologue fold, stats reuse, residual
+    defer) — fills ``directives`` in place."""
     conv_nodes = {}
     for node in topo:
         if node.is_variable:
@@ -316,6 +462,87 @@ def _table_device_matches():
         return False
 
 
+def _conv_bn_key(kernel, stride, x_shape, w_shape, dtype, res):
+    import numpy as np
+
+    return "conv_bn|k%ds%d%s|%s%s;%s" % (
+        kernel[0], stride[0], "pr" if res else "p",
+        np.dtype(dtype).name, tuple(x_shape), tuple(w_shape))
+
+
+def _conv_bn_measure(kernel, stride, x_shape, w_shape, dtype, res):
+    """The PR 2 fwd+bwd autotune contract for one conv+BN site, as a
+    fusion_tune measurement: unfused (XLA conv + stats re-read) vs the
+    Pallas ``conv_block`` under each tileable backward policy. The winning
+    candidate name (``pallas:<policy>``) carries the backward mode
+    ``bwd_mode`` rides into ``conv_block(bwd=...)``."""
+    import functools
+
+    import numpy as np
+
+    from .fusion_tune import measure_candidates
+    from .ops.pallas_conv_bn import _stats_of
+
+    rs = np.random.RandomState(0)
+    dt = jnp.dtype(dtype)
+    itemsize = dt.itemsize
+    x = jnp.asarray(rs.randn(*x_shape), dt)
+    w = jnp.asarray(rs.randn(*w_shape) * 0.1, dt)
+    K = x_shape[1]
+    scale = jnp.asarray(rs.uniform(0.5, 1.5, (K,)), jnp.float32)
+    shift = jnp.asarray(rs.uniform(-0.2, 0.2, (K,)), jnp.float32)
+    args = [x, w, scale, shift]
+    if res:
+        Ho, Wo = strided_dims(x_shape[2], x_shape[3], stride)
+        args.append(jnp.asarray(
+            rs.randn(x_shape[0], w_shape[0], Ho, Wo) * 0.1, dt))
+
+    def baseline(x, w, scale, shift, r=None):
+        c = _xla_conv(x, w, scale, shift, r, kernel, stride, True)
+        s, q = _stats_of(c)
+        return (c, s, q)
+
+    def fused(x, w, scale, shift, r=None, bwd="xla"):
+        return conv_block(x, w, scale, shift, r, kernel, stride, True,
+                          True, bwd)
+
+    cands = []
+    for policy in ("xla", "recompute", "stash"):
+        if policy != "xla":
+            if (policy == "stash" and plan_blocks(
+                    x_shape, w_shape, stride, itemsize=itemsize,
+                    prologue=True, res=res, emit_xn=True) is None):
+                continue
+            if plan_bwd_blocks(x_shape, w_shape, stride, itemsize=itemsize,
+                               prologue=True, res=res,
+                               stash=(policy == "stash")) is None:
+                continue
+        cands.append(("pallas:" + policy,
+                      functools.partial(fused, bwd=policy)))
+    return measure_candidates(baseline, cands, tuple(args), train=True)
+
+
+def _conv_bn_verdict(kernel, stride, x_shape, w_shape, dtype, res):
+    """The measured verdict for this conv+BN site — cache hit, measure on
+    miss (tuning enabled), else None (committed WINS table decides)."""
+    from . import fusion_tune as _tune
+
+    if _tune.cache_dir() is None:
+        return None
+    key = _conv_bn_key(kernel, stride, x_shape, w_shape, dtype, res)
+    return _tune.verdict(key, lambda: _conv_bn_measure(
+        kernel, stride, x_shape, w_shape, dtype, res))
+
+
+def _conv_bn_peek(kernel, stride, x_shape, w_shape, dtype, res):
+    """Cache-only read of the conv+BN verdict (never measures) — the
+    ``bwd_mode`` consult, which must not tune from inside a policy query."""
+    from . import fusion_tune as _tune
+
+    return _tune.peek(_conv_bn_key(kernel, stride, x_shape, w_shape, dtype,
+                                   res))
+
+
 def gate_explain(kernel, stride, x_shape, w_shape, dtype, prologue,
                  res=False, train=True):
     """The per-shape engage decision WITH the predicate that made it:
@@ -341,6 +568,16 @@ def gate_explain(kernel, stride, x_shape, w_shape, dtype, prologue,
     if not prologue:
         return False, ("bare conv (no folded BN prologue): no measured "
                        "WINS contract, never engages in auto mode")
+    rec = _conv_bn_verdict(kernel, stride, x_shape, w_shape, dtype, res)
+    if rec is not None:
+        want = "engage" if train else "engage_fwd"
+        if rec.get(want):
+            times = _rec_best_times(rec)
+            return True, ("measured win (tuned: fused %.0fµs vs xla "
+                          "%.0fµs fwd+bwd)" % times if times else
+                          "measured win (tuned)")
+        return False, tuned_reject_note(rec)
+    # seed/fallback when tuning is disabled: the committed on-chip table
     if not _table_device_matches():
         return False, ("WINS table absent or measured on a different "
                        "device generation")
@@ -467,7 +704,18 @@ def _bwd_mode_impl(kernel, stride, x_shape, w_shape, dtype, prologue,
 
     if env in ("recompute", "stash"):
         return env if _tiles(env) else "xla"
-    if not prologue or not _table_device_matches():
+    if not prologue:
+        return "xla"
+    # measured verdict first (the forward gate already tuned this site —
+    # cache-only read here, a policy query must never trigger a measurement)
+    rec = _conv_bn_peek(kernel, stride, x_shape, w_shape, dtype, res)
+    if rec is not None and rec.get("engage"):
+        low = rec.get("lowering") or ""
+        policy = low.partition(":")[2]
+        if policy in ("recompute", "stash") and _tiles(policy):
+            return policy
+        return "xla"
+    if not _table_device_matches():
         return "xla"
     from .ops.fused_conv_bn_table import WINS
 
@@ -477,6 +725,134 @@ def _bwd_mode_impl(kernel, stride, x_shape, w_shape, dtype, prologue,
     if policy in ("recompute", "stash") and _tiles(policy):
         return policy
     return "xla"
+
+
+# ----------------------------------------------------- generic pattern gate
+def _tune_key(pat, meta, args):
+    from .ops.fusion_patterns import sig_of
+
+    variant = pat.key_variant(meta)
+    return "%s|%s|%s" % (pat.name, variant, sig_of(args))
+
+
+def _rec_best_times(rec):
+    """(fused_us, baseline_us) fwd+bwd totals from a tune record — the
+    engaged lowering's when one won, else the best measured candidate's —
+    for the explain strings GL302/GL303 quote. None when nothing timed."""
+    base = rec.get("base_fwd_us")
+    if base is None:
+        return None
+    base += rec.get("base_bwd_us") or 0.0
+    if rec.get("fused_fwd_us") is not None:
+        return (rec["fused_fwd_us"] + (rec.get("fused_bwd_us") or 0.0), base)
+    best = None
+    for row in (rec.get("measured") or {}).values():
+        if row.get("fwd_us") is None:
+            continue
+        t = row["fwd_us"] + (row.get("bwd_us") or 0.0)
+        best = t if best is None or t < best else best
+    return None if best is None else (best, base)
+
+
+def tuned_reject_note(rec):
+    """The measured-timings clause for a tuned-and-rejected site (feeds the
+    GL302 explainer and ``gate_pattern_explain`` reasons)."""
+    if "error" in rec:
+        return "tuned and failed to measure (%s)" % rec["error"]
+    times = _rec_best_times(rec)
+    if times is None:
+        return "tuned and rejected (no candidate lowering could be timed)"
+    return ("tuned and rejected (best fused %.0fµs vs baseline %.0fµs "
+            "fwd+bwd)" % times)
+
+
+def gate_pattern_explain(pat, meta, args, train=True):
+    """The per-site engage decision for a generic pattern WITH its
+    predicate: ``(engaged, (lowering_name, fn) | None, reason)``.
+
+    Predicate order: env mode (``MXNET_FUSED_PATTERNS``) → inference
+    eligibility → mesh (patterns engage single-device only; SPMD traces
+    keep the op's own dispatch, e.g. ring attention) → candidate lowerings
+    exist for these shapes → forced, else the measure-and-cache verdict
+    (``fusion_tune``): cache hit engages/rejects with the measured µs;
+    a miss MEASURES when tuning is enabled, else stays unfused."""
+    from . import fusion_tune as _tune
+
+    mode = enabled_patterns(infer=not train).get(pat.name, "0")
+    if mode == "0":
+        return False, None, ("pattern disabled (MXNET_FUSED_PATTERNS%s)"
+                             % ("" if train else "[_INFER]"))
+    if not train and not pat.inference:
+        return False, None, "pattern does not engage on inference executions"
+    if _mesh_kind()[0] != _MESH_NONE:
+        return False, None, ("multi-device mesh: generic patterns engage "
+                             "single-device only (the op's own SPMD "
+                             "dispatch applies)")
+    baseline, cands = pat.build(meta, args)
+    if not cands:
+        return False, None, ("no fused lowering for this site (shape does "
+                             "not tile / variant unsupported)")
+    if mode == "1":
+        return True, cands[0], "forced (MXNET_FUSED_PATTERNS)"
+    if not getattr(pat, "tunable", True):
+        return False, None, ("no lowering distinct from the baseline to "
+                             "measure (engage via MXNET_FUSED_PATTERNS="
+                             "%s=1)" % pat.name)
+    key = _tune_key(pat, meta, args)
+
+    def _measure():
+        # synthetic concrete inputs: the real args are tracers mid-trace
+        sargs = _tune.synth_like(args)
+        sbase, scands = pat.build(meta, sargs)
+        return _tune.measure_candidates(sbase, scands, sargs, train=True)
+
+    rec = _tune.verdict(key, _measure)
+    if rec is None:
+        return False, None, ("no measured verdict for this site (tuning "
+                             "disabled: set MXNET_FUSION_TUNE_DIR)")
+    want = "engage" if train else "engage_fwd"
+    low = rec.get("lowering") if train else (rec.get("lowering_fwd")
+                                             or rec.get("lowering"))
+    if rec.get(want) and low:
+        fn = dict(cands).get(low)
+        if fn is None:
+            return False, None, ("cached lowering %r is unavailable for "
+                                 "this site" % low)
+        times = _rec_best_times(rec)
+        reason = "measured win (%s)" % low if times is None else (
+            "measured win (%s: fused %.0fµs vs baseline %.0fµs fwd+bwd)"
+            % ((low,) + times))
+        return True, (low, fn), reason
+    return False, None, tuned_reject_note(rec)
+
+
+def _exec_pattern(directive, node, ins, is_train):
+    """Run one pattern-rooted node: engage the gated lowering, or fall back
+    to the bit-identical unfused root op over resolved inputs."""
+    pat, meta = directive["pat"], directive["meta"]
+    engaged, chosen, reason = False, None, None
+    try:
+        args = pat.externals(meta, ins, resolve)
+    except Exception:  # matcher/exec mismatch: unfused fallback
+        args, reason = None, "externals recovery failed (marker mismatch)"
+    if args is not None:
+        engaged, chosen, reason = gate_pattern_explain(
+            pat, meta, args, train=is_train)
+    if _tm.enabled():
+        _tm.counter("fusion.pattern_engaged.%s" % pat.name if engaged
+                    else "fusion.pattern_fallback.%s" % pat.name).inc()
+    if _tm.tracing():
+        _tm.event("fusion.pattern", op=node.name, pattern=pat.name,
+                  engaged=engaged, reason=reason,
+                  **({"lowering": chosen[0]} if chosen else {}))
+    if engaged:
+        return (chosen[1](*args),), ()
+    from .ops.registry import get_op
+
+    rins = [resolve(v) for v in ins]
+    outs, aux_out = get_op(node.op).apply(
+        node.parsed_attrs(), rins, aux=[], is_train=is_train, rng=None)
+    return tuple(outs), tuple(aux_out)
 
 
 # -------------------------------------------------------------------- execute
@@ -497,6 +873,10 @@ def execute(directive, node, ins, aux, is_train):
         return (_exec_conv(directive, node, ins),), ()
     if kind == "resadd":
         return (_exec_resadd(directive, ins),), ()
+    if kind == "lazy":
+        return (Lazy(node, ins),), ()
+    if kind == "pattern":
+        return _exec_pattern(directive, node, ins, is_train)
     raise AssertionError(kind)
 
 

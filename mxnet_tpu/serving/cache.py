@@ -91,6 +91,27 @@ def _shape_key(input_shapes):
                         for n, s in input_shapes.items()))
 
 
+def _plan_pattern_sites(exe):
+    """Static summary of one bound executor's fusion plan: generic-pattern
+    site counts, conv+BN directive count, and whether the conv+BN plan is
+    ACTIVE at inference — what a serving operator needs to know about the
+    fusion surface of a warmed bucket (per-site engage decisions land on
+    the ``fusion.pattern_*`` counters and trace events)."""
+    try:
+        plan = exe._prog._fusion_plan
+        sites, conv_bn = {}, 0
+        for d in plan.values():
+            if d["kind"] == "pattern":
+                name = d["pat"].name
+                sites[name] = sites.get(name, 0) + 1
+            elif d["kind"] != "lazy":
+                conv_bn += 1
+        return {"pattern_sites": sites, "conv_bn_directives": conv_bn,
+                "conv_bn_infer_active": bool(exe._prog._infer_fusion)}
+    except Exception:  # observability must never sink a warmup
+        return {}
+
+
 class PersistentExecutableCache:
     """One pre-compiled grad-less executor per input-shape bucket.
 
@@ -122,6 +143,15 @@ class PersistentExecutableCache:
         # evicts. None/0 = unbounded.
         self._max_exes = int(max_executables or 0) or None
         self._exes: "OrderedDict[tuple, object]" = OrderedDict()
+        # per-bucket fusion pattern-site summary (filled at compile time):
+        # which patterns the plan rooted in this model's graph, per-pattern
+        # site counts, and whether the conv+BN inference plan is active —
+        # the serving-side observability of the inference-mode gates.
+        # Guarded by its OWN lock: health() reads it, and the main _lock is
+        # held for the full duration of a warmup compile (+ autotune) — a
+        # liveness probe must never block on a compile.
+        self._fusion_sites: Dict[tuple, dict] = {}
+        self._sites_lock = threading.Lock()
         self._lock = threading.RLock()
         self._sealed = False
         digest = hashlib.sha1(
@@ -252,15 +282,23 @@ class PersistentExecutableCache:
                           shapes=str(dict(input_shapes))):
                 exe = self._bind(input_shapes)
                 # force the XLA compile NOW (bind only traces lazily):
-                # warmup pays it, the request path never does
+                # warmup pays it, the request path never does — this is
+                # also where the fusion pattern engine's per-site
+                # inference gates run (and, with MXNET_FUSION_TUNE_DIR
+                # set, where a cold site gets tuned: warmup pays the
+                # measurement, the request path reuses the verdict)
                 exe.forward(is_train=False)
                 np.asarray(exe.outputs[0].asnumpy())
+            with self._sites_lock:
+                self._fusion_sites[key] = _plan_pattern_sites(exe)
             if _tm.enabled():
                 _tm.counter("serving.executable_compile").inc()
             self._exes[key] = exe
             if self._max_exes and not self._sealed \
                     and len(self._exes) > self._max_exes:
                 old_key, _ = self._exes.popitem(last=False)
+                with self._sites_lock:
+                    self._fusion_sites.pop(old_key, None)
                 log.info("serving: evicted LRU executable %s from %r "
                          "(cap %d)", dict(old_key), self._model_key,
                          self._max_exes)
@@ -303,6 +341,15 @@ class PersistentExecutableCache:
     def seal(self):
         """Freeze the bucket set: from now on any lookup miss raises."""
         self._sealed = True
+
+    def fusion_sites(self):
+        """Per-bucket fusion pattern-site summaries (compile-time static
+        view; see ``_plan_pattern_sites``). Keys are the bucket shape keys
+        rendered as dicts. Non-blocking with respect to warmup compiles
+        (own lock — safe for health probes)."""
+        with self._sites_lock:
+            return {str(dict(k)): v
+                    for k, v in self._fusion_sites.items()}
 
     # --------------------------------------------------------- persistence
     def _manifest_path(self):

@@ -89,3 +89,61 @@ def test_onchip_artifact_pointer():
         rec = json.load(f)
     assert any(abs(r["img_s"] - art["img_s"]) < 1e-6
                for r in rec["resnet50_train"])
+
+
+def test_probe_attempt_cap(tmp_path, monkeypatch):
+    """MXNET_BENCH_PROBE_ATTEMPTS caps the retries even with window left —
+    the r05 degraded runs burned 4x180s; the cap is the budget now."""
+    counter = str(tmp_path / "attempts")
+    monkeypatch.setenv("MXTPU_BENCH_PROBE_CODE",
+                       _flaky_probe_code(counter, fail_times=10 ** 6))
+    monkeypatch.setenv("MXTPU_BENCH_PROBE_WINDOW", "600")
+    monkeypatch.setenv("MXNET_BENCH_PROBE_ATTEMPTS", "2")
+    monkeypatch.setattr(bench.time, "sleep", lambda s: None)
+    assert bench._probe_backend() is False
+    assert int(open(counter).read()) == 2
+
+
+def test_probe_conclusive_failure_stops_immediately(tmp_path, monkeypatch):
+    """A clean backend-absence error (jax raised, no tunnel hang) must end
+    the probe on attempt 1 — retrying cannot conjure a TPU."""
+    counter = str(tmp_path / "attempts")
+    code = (
+        "import os, sys\n"
+        "p = %r\n"
+        "n = int(open(p).read()) if os.path.exists(p) else 0\n"
+        "open(p, 'w').write(str(n + 1))\n"
+        "sys.stderr.write('RuntimeError: Unable to initialize backend "
+        "tpu')\n"
+        "sys.exit(1)\n" % counter
+    )
+    monkeypatch.setenv("MXTPU_BENCH_PROBE_CODE", code)
+    monkeypatch.setenv("MXTPU_BENCH_PROBE_WINDOW", "600")
+    monkeypatch.setenv("MXNET_BENCH_PROBE_ATTEMPTS", "5")
+    monkeypatch.setattr(bench.time, "sleep", lambda s: None)
+    assert bench._probe_backend() is False
+    assert int(open(counter).read()) == 1
+
+
+def test_probe_timeout_env_alias(tmp_path, monkeypatch):
+    """MXNET_BENCH_PROBE_TIMEOUT_S takes precedence over the legacy
+    MXTPU_BENCH_PROBE_TIMEOUT name."""
+    counter = str(tmp_path / "attempts")
+    code = (
+        "import os, time\n"
+        "p = %r\n"
+        "n = int(open(p).read()) if os.path.exists(p) else 0\n"
+        "open(p, 'w').write(str(n + 1))\n"
+        "if n < 1:\n"
+        "    time.sleep(60)\n"
+        "print('tpu')\n" % counter
+    )
+    monkeypatch.setenv("MXTPU_BENCH_PROBE_CODE", code)
+    monkeypatch.setenv("MXTPU_BENCH_PROBE_WINDOW", "600")
+    monkeypatch.setenv("MXTPU_BENCH_PROBE_TIMEOUT", "500")  # legacy: slow
+    # the new name wins; 6 s covers interpreter startup while keeping the
+    # deliberate first-attempt hang cheap for the suite
+    monkeypatch.setenv("MXNET_BENCH_PROBE_TIMEOUT_S", "6")
+    monkeypatch.setattr(bench.time, "sleep", lambda s: None)
+    assert bench._probe_backend() is True
+    assert int(open(counter).read()) >= 2

@@ -249,6 +249,23 @@ def _gl405_clean():
             {"shapes": {"data": (8, 512)}, "mesh": mesh, "rules": rules})
 
 
+def _gl303_broken():
+    # NEAR miss: the FullyConnected has a fusable relu consumer but also a
+    # second consumer, so the matmul_bias_act pattern cannot root
+    d = mx.sym.Variable("data")
+    fc = mx.sym.FullyConnected(data=d, num_hidden=8, name="fc_shared")
+    relu = mx.sym.Activation(data=fc, act_type="relu", name="relu")
+    return relu + fc, {"shapes": {"data": (4, 16)}}
+
+
+def _gl303_clean():
+    # sole fusable consumer: the pattern roots, nothing to report
+    d = mx.sym.Variable("data")
+    fc = mx.sym.FullyConnected(data=d, num_hidden=8, name="fc")
+    return (mx.sym.Activation(data=fc, act_type="relu", name="relu"),
+            {"shapes": {"data": (4, 16)}})
+
+
 # --- GL5xx: memory planner (no mesh needed: plans replicated) --------------
 def _gl501_broken():
     d = mx.sym.Variable("data")
@@ -288,6 +305,7 @@ GRAPH_CODE_CASES = {
     "GL203": (_gl203_broken, _gl203_clean),
     "GL301": (_gl301_broken, _gl301_clean),
     "GL302": (_gl302_broken, _gl302_clean),
+    "GL303": (_gl303_broken, _gl303_clean),
     "GL401": (_gl401_broken, _gl401_clean),
     "GL402": (_gl402_broken, _gl402_clean),
     "GL403": (_gl403_broken, _gl403_clean),
