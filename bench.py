@@ -772,6 +772,53 @@ def _bench_serving():
     return out
 
 
+def _bench_autoplan():
+    """Auto-parallel planner leg (docs/PARALLEL_PLANNER.md): the plan the
+    cost model picks for the transformer at 8 abstract devices (predicted
+    comm bytes, chosen vs naive all-dp), plus a REAL 2-process CPU fit
+    (tests/nightly/autoplan_measure.py) comparing the predicted grad-sync
+    bytes against the measured ``kvstore.bytes.*`` counters — the planner's
+    claim to a scoreboard number is only as good as that ratio."""
+    root = os.path.dirname(os.path.abspath(__file__))
+    from mxnet_tpu import models
+    from mxnet_tpu.parallel import autoplan
+
+    plan = autoplan.plan_parallel(
+        models.get_symbol("transformer"),
+        {"data": (2, 64), "softmax_label": (2, 64)},
+        types={"data": "int32"}, devices=8, label="transformer")
+    rec = {
+        "transformer_mesh": dict(plan.mesh),
+        "transformer_pipeline_stages": plan.pipeline_stages,
+        "predicted_comm_bytes": plan.predicted["comm_bytes"],
+        "naive_comm_bytes": plan.naive["comm_bytes"],
+        "comm_vs_naive": round(
+            plan.predicted["comm_bytes"] / max(1, plan.naive["comm_bytes"]),
+            4),
+        "predicted_peak_bytes": plan.predicted["peak_bytes"],
+        "sharded_params": sum(1 for v in plan.param_specs.values() if any(v)),
+    }
+    env = dict(os.environ)
+    env.setdefault("JAX_PLATFORMS", "cpu")
+    r = subprocess.run(
+        [sys.executable, os.path.join(root, "tools", "launch.py"),
+         "-n", "2", "--launcher", "local", "--cpu-devices", "1",
+         sys.executable,
+         os.path.join(root, "tests", "nightly", "autoplan_measure.py")],
+        capture_output=True, text=True, timeout=420, env=env, cwd=root)
+    measured = None
+    for line in r.stdout.splitlines():
+        if line.startswith("AUTOPLAN_MEASURE {"):
+            measured = json.loads(line[len("AUTOPLAN_MEASURE "):])
+    if measured is None:
+        raise RuntimeError("2-proc measure produced no row (rc=%d): %s"
+                           % (r.returncode,
+                              (r.stderr or r.stdout).strip()[-300:]))
+    rec["measured_2proc"] = measured
+    rec["within_2x"] = bool(0.5 <= measured["ratio"] <= 2.0)
+    return rec
+
+
 def main():
     degraded = False
     # nothing to probe when the platform is already pinned to CPU
@@ -816,6 +863,10 @@ def main():
         fusion_patterns = _bench_fusion_patterns()
     except Exception as exc:  # nor may the pattern-engine leg
         fusion_patterns = {"error": "%s: %s" % (type(exc).__name__, exc)}
+    try:
+        autoplan_leg = _bench_autoplan()
+    except Exception as exc:  # nor may the planner leg
+        autoplan_leg = {"error": "%s: %s" % (type(exc).__name__, exc)}
 
     result = {
         "metric": "resnet50_train_throughput",
@@ -888,6 +939,7 @@ def main():
     result["serving"] = serving
     result["checkpoint"] = ckpt
     result["fusion_patterns"] = fusion_patterns
+    result["autoplan"] = autoplan_leg
     print(json.dumps(result))
 
 

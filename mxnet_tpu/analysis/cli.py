@@ -149,6 +149,91 @@ def _format_peak_table(peaks) -> str:
     return "\n".join(out)
 
 
+def _format_plan_table(rows) -> str:
+    """The --autoplan --all-models summary: one plan row per target."""
+    from .shard_lint import fmt_bytes
+
+    table = [("model", "mesh", "pp", "comm/step", "peak/device", "verdict")]
+    for label, plan, err in rows:
+        if plan is None:
+            table.append((label, "-", "-", "-", "-", "ERROR: %s" % err))
+            continue
+        mesh = ",".join("%s=%d" % kv for kv in plan.mesh.items())
+        table.append((
+            label, mesh,
+            str(plan.pipeline_stages) if plan.pipeline_stages > 1 else "-",
+            fmt_bytes(plan.predicted.get("comm_bytes", 0)),
+            fmt_bytes(plan.predicted.get("peak_bytes", 0)),
+            "ok" if plan.feasible else "INFEASIBLE"))
+    widths = [max(len(r[i]) for r in table) for i in range(len(table[0]))]
+    out = ["== autoplan summary =="]
+    for r in table:
+        out.append("  ".join(c.ljust(w) for c, w in zip(r, widths)).rstrip())
+    return "\n".join(out)
+
+
+def _run_autoplan(args, targets, shapes, types, devices) -> int:
+    """The --autoplan mode: plan every target, dump the ParallelPlans.
+
+    Exit 0 when every target got a plan — feasible OR infeasible-with-a-
+    structured-reason (the CI gate's contract); 1 when the planner itself
+    failed on any target; 2 on load failures."""
+    from ..parallel import autoplan
+
+    rows = []
+    load_failed = plan_failed = False
+    for target in targets:
+        try:
+            label, sym, sh, ty = _load_target(
+                target, shapes, types, not args.no_default_shapes)
+        except Exception as exc:
+            print("graphlint: cannot load %r: %s: %s"
+                  % (target, type(exc).__name__, exc), file=sys.stderr)
+            rows.append((target, None, "load: %s" % exc))
+            load_failed = True
+            continue
+        try:
+            plan = autoplan.plan_parallel(
+                sym, sh, types=ty, devices=devices,
+                budget_gb=args.budget_gb, bwd=args.bwd, label=label)
+        except autoplan.PlanError as exc:
+            rows.append((label, None, str(exc)))
+            plan_failed = True
+            continue
+        rows.append((label, plan, None))
+
+    if args.format == "json":
+        payload = []
+        for label, plan, err in rows:
+            entry = {"target": label, "devices": devices}
+            if plan is None:
+                entry["plan_error"] = err
+            else:
+                entry["autoplan"] = plan.to_dict()
+            payload.append(entry)
+        print(json.dumps(payload, indent=2))
+    else:
+        for label, plan, err in rows:
+            print("== autoplan: %s (%d devices) ==" % (label, devices))
+            if plan is None:
+                print("  planner failed: %s" % err)
+                continue
+            print("  " + plan.summary())
+            if not plan.feasible:
+                print("  reason: %s" % plan.reason)
+            if plan.stage_cuts:
+                print("  stage cuts: %s" % ", ".join(plan.stage_cuts))
+            for rej in plan.rejected[:4]:
+                mesh = ",".join("%s=%d" % kv for kv in rej["mesh"].items())
+                print("  rejected mesh[%s]: %s" % (mesh, rej["why"]))
+            print()
+        if len(rows) > 1:
+            print(_format_plan_table(rows))
+    if load_failed:
+        return 2
+    return 1 if plan_failed else 0
+
+
 def main(argv=None) -> int:
     ap = argparse.ArgumentParser(
         prog="graphlint",
@@ -173,6 +258,17 @@ def main(argv=None) -> int:
                          "(GL4xx) and per-device memory planning, e.g. "
                          "dp=8,model=2 — first axis is the batch axis, "
                          "'model' (or the second axis) the tensor axis")
+    ap.add_argument("--autoplan", action="store_true",
+                    help="run the cost-model auto-parallel planner "
+                         "(parallel.autoplan) instead of the lint passes: "
+                         "search dp x tp x pp over --mesh-devices devices "
+                         "and dump the winning ParallelPlan per target "
+                         "(docs/PARALLEL_PLANNER.md). An infeasible plan "
+                         "with a structured reason is a valid outcome "
+                         "(exit 0); only a planner failure exits 1")
+    ap.add_argument("--mesh-devices", type=int, default=None, metavar="N",
+                    help="device count the --autoplan search factorizes "
+                         "(defaults to the --mesh product when given)")
     ap.add_argument("--budget-gb", type=float, default=None,
                     help="peak-HBM budget per device in GiB, the unit the "
                          "peak tables print (GL501); default: the "
@@ -225,6 +321,16 @@ def main(argv=None) -> int:
         except ValueError as exc:
             print("graphlint: %s" % exc, file=sys.stderr)
             return 2
+
+    if args.autoplan:
+        devices = args.mesh_devices
+        if devices is None and mesh is not None:
+            devices = mesh.size
+        if devices is None or devices < 1:
+            print("graphlint: --autoplan needs --mesh-devices N (or --mesh)",
+                  file=sys.stderr)
+            return 2
+        return _run_autoplan(args, targets, shapes, types, devices)
 
     from . import lint
 

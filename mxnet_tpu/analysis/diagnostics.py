@@ -171,6 +171,11 @@ class Report:
         self.target = target
         self.diagnostics: List[Diagnostic] = []
         self.memory_plan: Optional[dict] = None
+        # UNCAPPED GL402 reshard total (bytes moved per device per forward)
+        # — the per-edge diagnostic list is capped at 8 for humans, but a
+        # machine consumer (parallel.autoplan, JSON) must never see a
+        # truncated total. None when the shard_lint pass did not run.
+        self.reshard_total_bytes: Optional[int] = None
 
     def add(self, diag: Diagnostic):
         self.diagnostics.append(diag)
@@ -229,4 +234,6 @@ class Report:
         }
         if self.memory_plan is not None:
             payload["memory_plan"] = self.memory_plan
+        if self.reshard_total_bytes is not None:
+            payload["reshard_total_bytes"] = self.reshard_total_bytes
         return json.dumps(payload, indent=2)

@@ -129,6 +129,11 @@ class GraphContext:
         self.train = bool(train)
         self.entry_spec: Dict[Tuple[int, int], tuple] = {}
         self.memory_plan = None
+        # filled by shard_lint when a mesh is set: the UNCAPPED GL402 totals
+        # (the diagnostic list stays capped for humans; planners/JSON
+        # consumers read these)
+        self.reshard_total_bytes: Optional[int] = None
+        self.reshard_edges: List[dict] = []
 
     # ---------------------------------------------------------------- helpers
     def node_label(self, node) -> str:
@@ -207,4 +212,5 @@ def run_graph_passes(symbol, shape_hints=None, type_hints=None,
                 fix_hint="report this as a graphlint bug; other passes ran",
             ))
     report.memory_plan = ctx.memory_plan
+    report.reshard_total_bytes = ctx.reshard_total_bytes
     return report

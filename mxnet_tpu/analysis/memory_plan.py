@@ -296,7 +296,10 @@ def memory_plan_lint(ctx: GraphContext):
                ", ".join("%s=%s" % (n, fmt_bytes(b))
                          for n, b in plan["peak_live"][:4]) or "nothing"),
             node=plan["peak_node"],
-            fix_hint="%s component dominates: %s" % (comp, hints[comp]),
+            fix_hint="%s component dominates: %s — or let the auto-parallel "
+                     "planner search dp×tp×pp plans under this "
+                     "budget for you: MXNET_AUTOPLAN=1 (trainer) / "
+                     "graphlint --autoplan (CLI)" % (comp, hints[comp]),
         ))
     # the largest single ACTIVATION at the peak (the synthetic
     # <cotangents>/<recomputed> lumps are not one tensor a policy can fix)

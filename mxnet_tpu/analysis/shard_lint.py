@@ -504,6 +504,15 @@ def shard_plan_lint(ctx: GraphContext):
 
     # ---- GL402: per-edge reshard diagnostics (largest first, capped) -----
     edges.sort(key=lambda e: -e[-1])
+    # Machine-readable, UNCAPPED view for the auto-parallel planner and JSON
+    # consumers: the human diagnostics below stay capped at _EDGE_CAP, but a
+    # cost model fed a truncated total would under-price bad plans.
+    ctx.reshard_total_bytes = int(sum(m for *_, m in edges))
+    ctx.reshard_edges = [
+        {"consumer": node.name, "op": node.op, "producer": inp.name,
+         "dims": list(dims), "factor": int(f), "spec": spec_str,
+         "bytes_per_device": int(moved)}
+        for node, inp, dims, why, f, spec_str, moved in edges]
     for node, inp, dims, why, f, spec_str, moved in edges[:_EDGE_CAP]:
         diags.append(Diagnostic(
             "GL402",

@@ -9,7 +9,8 @@ from .bucketing_module import BucketingModule
 from .sequential_module import SequentialModule
 from .python_module import PythonModule, PythonLossModule
 from .elastic import ElasticFit
+from .executor_group import PipelineExecutorGroup
 
 __all__ = ["BaseModule", "BatchEndParam", "Module", "BucketingModule",
            "SequentialModule", "PythonModule", "PythonLossModule",
-           "ElasticFit"]
+           "ElasticFit", "PipelineExecutorGroup"]

@@ -29,7 +29,8 @@ __all__ = ["SPMDStepAdapter"]
 
 
 class SPMDStepAdapter:
-    def __init__(self, module, mesh, fn_opt, lr_of_step, shared=None):
+    def __init__(self, module, mesh, fn_opt, lr_of_step, shared=None,
+                 rules=None):
         from ..parallel.trainer import SPMDTrainer
 
         self._lr_of_step = lr_of_step
@@ -42,6 +43,7 @@ class SPMDStepAdapter:
             data_names=tuple(self._data_names),
             label_names=tuple(self._label_names),
             optimizer=fn_opt,
+            rules=rules,
         )
         self._optimizer = module._optimizer
         self._outputs = None
@@ -245,12 +247,99 @@ def try_create(module, kvstore_obj):
             return rejected("context has no mappable jax device (%s)" % exc)
         if len({id(d) for d in devices}) != len(devices):
             return rejected("duplicate devices in context list")
-    if module._exec_group.batch_size % len(module._context):
-        return rejected("batch size %d does not split evenly over %d devices"
-                        % (module._exec_group.batch_size, len(module._context)))
+    mesh, rules = None, None
+    from ..parallel.autoplan import autoplan_enabled
 
-    mesh = make_mesh((len(devices),), ("data",), devices)
-    return SPMDStepAdapter(module, mesh, (init, apply), lr_of_step)
+    if autoplan_enabled():
+        # MXNET_AUTOPLAN=1: the cost-model planner picks the mesh shape and
+        # the per-param PartitionSpecs (docs/PARALLEL_PLANNER.md). Explicit
+        # user specs always win — this path only runs for the adapter's own
+        # default mesh; a caller constructing SPMDTrainer(rules=...) is
+        # never overridden. Runs BEFORE the batch-divisibility guard: a
+        # model-parallel plan (dp < devices) legitimately serves batches the
+        # all-data mesh cannot split.
+        mesh, rules = _autoplan_mesh(module, devices)
+    if mesh is None:
+        if module._exec_group.batch_size % len(module._context):
+            return rejected(
+                "batch size %d does not split evenly over %d devices"
+                % (module._exec_group.batch_size, len(module._context)))
+        mesh = make_mesh((len(devices),), ("data",), devices)
+    else:
+        # the planned mesh (single-process only — _autoplan_mesh rejects
+        # dist) splits the batch over its data axis alone, so only dp must
+        # divide the batch; a tp-heavy plan legitimately serves batch
+        # sizes the all-data mesh cannot
+        dp = dict(mesh.shape).get("data", 1)
+        if module._exec_group.batch_size % dp:
+            return rejected(
+                "batch size %d does not split evenly over the planned "
+                "data axis (dp=%d)"
+                % (module._exec_group.batch_size, dp))
+    return SPMDStepAdapter(module, mesh, (init, apply), lr_of_step,
+                           rules=rules)
+
+
+def _autoplan_mesh(module, devices):
+    """Ask the auto-parallel planner for this module's mesh + sharding
+    rules. Returns (None, None) — with a logged reason — on ANY failure or
+    infeasibility: autoplan must never take down a job that would run fine
+    on the default all-data mesh."""
+    import jax
+
+    from ..parallel import autoplan
+    from ..parallel.mesh import make_mesh
+    from ..parallel.sharding import ShardingRules
+
+    if jax.process_count() > 1:
+        # Unsupported for now, deliberately: the module's bind shapes are
+        # per-process LOCAL batches while the mesh covers GLOBAL devices,
+        # so the planner would price peaks/reshards at 1/P of reality —
+        # and a tp-heavy winner with dp < P would glue DIFFERENT local
+        # rows into one "replicated" global batch (silently wrong
+        # gradients). Single-process meshes only until the planner is
+        # taught global batch assembly.
+        logging.warning(
+            "MXNET_AUTOPLAN=1: multi-process (dist) jobs are not planned "
+            "yet — using the default all-data mesh")
+        return None, None
+
+    shapes, types = {}, {}
+    for desc in list(module._data_shapes or []) + list(
+            module._label_shapes or []):
+        name, shape = desc[0], desc[1]
+        shapes[name] = tuple(shape)
+        dt = getattr(desc, "dtype", None)
+        if dt is not None:
+            types[name] = np.dtype(dt)
+    try:
+        plan = autoplan.plan_parallel(module._symbol, shapes, types=types,
+                                      devices=len(devices))
+    except Exception as exc:
+        # PlanError or anything the analysis passes throw on an exotic
+        # graph: the documented contract is that autoplan NEVER takes down
+        # a job that runs fine on the default mesh
+        logging.warning("MXNET_AUTOPLAN=1: planner failed (%s: %s) — using "
+                        "the default all-data mesh",
+                        type(exc).__name__, exc)
+        return None, None
+    if not plan.feasible:
+        logging.warning("MXNET_AUTOPLAN=1: no feasible plan (%s) — using "
+                        "the default all-data mesh", plan.reason)
+        return None, None
+    if plan.pipeline_stages > 1:
+        logging.warning(
+            "MXNET_AUTOPLAN=1: the winning plan needs %d pipeline stages "
+            "and the fused SPMD step cannot pipeline — train through "
+            "module.PipelineExecutorGroup instead "
+            "(docs/PARALLEL_PLANNER.md). Falling back to the default mesh.",
+            plan.pipeline_stages)
+        return None, None
+    logging.info("MXNET_AUTOPLAN=1: %s", plan.summary())
+    mesh = make_mesh(dict(plan.mesh), devices=devices)
+    rules = ShardingRules(mesh, data_axis="data", model_axis="model",
+                          param_rule=plan.param_rule())
+    return mesh, rules
 
 
 def derive(module, shared_adapter):
@@ -277,9 +366,12 @@ def derive(module, shared_adapter):
             len(module._context))
         return None
     try:
+        # the donor's rules travel with its mesh: an autoplanned donor laid
+        # params out per its plan, and the bucket trainer shares that state
         return SPMDStepAdapter(
             module, shared_adapter.trainer.mesh, shared_adapter._fn_opt,
-            shared_adapter._lr_of_step, shared=shared_adapter)
+            shared_adapter._lr_of_step, shared=shared_adapter,
+            rules=shared_adapter.trainer.rules)
     except Exception as exc:
         logging.warning("fused SPMD step disabled for bucket: %s", exc)
         return None

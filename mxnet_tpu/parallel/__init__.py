@@ -27,6 +27,7 @@ from .mesh import make_mesh, local_mesh, MeshSpec, parse_mesh_spec
 from .sharding import ShardingRules, param_pspec, shardable_dims
 from .optim import make_functional_optimizer
 from .trainer import SPMDTrainer
+from .autoplan import ParallelPlan, PlanError, plan_parallel
 
 __all__ = [
     "make_mesh",
@@ -38,4 +39,7 @@ __all__ = [
     "shardable_dims",
     "make_functional_optimizer",
     "SPMDTrainer",
+    "ParallelPlan",
+    "PlanError",
+    "plan_parallel",
 ]

@@ -1,0 +1,91 @@
+"""2-process predicted-vs-measured comm-bytes check for the auto-parallel
+planner's cost model (docs/PARALLEL_PLANNER.md).
+
+Run under the launcher::
+
+    python tools/launch.py -n 2 --launcher local --cpu-devices 1 \
+        python tests/nightly/autoplan_measure.py
+
+The planner predicts gradient-sync wire bytes per device per step with the
+ring-allreduce formula ``2*(W-1)/W * grad_bytes`` — the same accounting
+``kvstore_bucket`` counts into the ``kvstore.bytes.*`` counters at flush
+time. This script fits a small MLP on the legacy (``fused_step=False``)
+bucketed kvstore path for a fixed number of steps and asserts the measured
+counters land within 2x of the prediction (the ISSUE 10 acceptance bar —
+bucket padding and comm-dtype packing are the expected slack). Rank 0
+prints one ``AUTOPLAN_MEASURE {json}`` line for the bench autoplan leg.
+"""
+import argparse
+import json
+import os
+import sys
+
+sys.path.insert(0, os.path.abspath(
+    os.path.join(os.path.dirname(__file__), "..", "..")))
+
+os.environ.setdefault("MXNET_TELEMETRY", "counters")
+
+import numpy as np  # noqa: E402
+
+import mxnet_tpu as mx  # noqa: E402
+from mxnet_tpu import telemetry  # noqa: E402
+from mxnet_tpu.parallel import autoplan  # noqa: E402
+
+BATCH, BATCHES, EPOCHS, DIM = 16, 4, 2, 64
+
+
+def _mlp():
+    s = mx.sym.Variable("data")
+    s = mx.sym.FullyConnected(s, num_hidden=256, name="fc1")
+    s = mx.sym.Activation(s, act_type="relu")
+    s = mx.sym.FullyConnected(s, num_hidden=256, name="fc2")
+    s = mx.sym.Activation(s, act_type="relu")
+    s = mx.sym.FullyConnected(s, num_hidden=4, name="fc3")
+    return mx.sym.SoftmaxOutput(s, name="softmax")
+
+
+def main():
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--max-ratio", type=float, default=2.0,
+                    help="measured/predicted acceptance band "
+                         "[1/max, max] (default 2x)")
+    args = ap.parse_args()
+
+    kv = mx.kv.create("dist_tpu_sync")
+    rank, world = kv.rank, kv.num_workers
+    rs = np.random.RandomState(11 + rank)
+    x = rs.rand(BATCH * BATCHES, DIM).astype("float32")
+    y = rs.randint(0, 4, (BATCH * BATCHES,)).astype("float32")
+    it = mx.io.NDArrayIter(x, y, batch_size=BATCH)
+    mod = mx.mod.Module(_mlp(), context=mx.cpu(), fused_step=False)
+    mod.fit(it, num_epoch=EPOCHS, kvstore=kv,
+            optimizer="sgd", optimizer_params=(("learning_rate", 0.05),))
+    mx.nd.waitall()
+    measured = sum(
+        telemetry.counter("kvstore.bytes.%s" % k).value
+        for k in ("allreduce", "reduce_scatter", "all_gather"))
+    steps = BATCHES * EPOCHS
+
+    # the legacy kvstore path IS the naive all-dp plan: predict with the
+    # planner's naive row (gradsync only — a pure-dp MLP has no reshard)
+    plan = autoplan.plan_parallel(
+        _mlp(), {"data": (BATCH * world, DIM)}, devices=world)
+    predicted = plan.naive["comm_bytes"]
+    ratio = measured / float(predicted * steps) if predicted else float("inf")
+    row = {"workers": world, "steps": steps,
+           "predicted_bytes_per_step": int(predicted),
+           "measured_bytes": int(measured),
+           "measured_bytes_per_step": int(measured // steps),
+           "ratio": round(ratio, 4)}
+    if rank == 0:
+        print("AUTOPLAN_MEASURE " + json.dumps(row))
+    assert measured > 0, "no kvstore.bytes.* counters fired"
+    assert 1.0 / args.max_ratio <= ratio <= args.max_ratio, \
+        "measured comm %d B is outside %gx of predicted %d B/step x %d" \
+        % (measured, args.max_ratio, predicted, steps)
+    kv._barrier()
+    print("AUTOPLAN_MEASURE_OK rank %d" % rank)
+
+
+if __name__ == "__main__":
+    main()

@@ -64,6 +64,40 @@ print("mesh sweep OK: %d models, peak-HBM %.3f..%.3f GiB/device"
       % (len(payload), min(peaks), max(peaks)))
 PYEOF
 rm -f "$MESH_SWEEP"
+# auto-parallel planner sweep (docs/PARALLEL_PLANNER.md): every zoo model at
+# 8 abstract devices must receive a budget-feasible ParallelPlan (or an
+# explicit structured infeasibility reason — a planner CRASH is the failure
+# mode this gates); the transformer's planner-chosen plan must additionally
+# predict no more comm bytes than the naive all-dp plan
+AUTOPLAN_SWEEP="$(mktemp /tmp/graphlint_autoplan_ci.XXXXXX.json)"
+JAX_PLATFORMS=cpu python tools/graphlint --autoplan --all-models \
+    --mesh-devices 8 --format json > "$AUTOPLAN_SWEEP" \
+    || { echo "graphlint autoplan sweep FAILED"; rm -f "$AUTOPLAN_SWEEP"; exit 1; }
+python - "$AUTOPLAN_SWEEP" <<'PYEOF' || { echo "autoplan sweep gate FAILED"; rm -f "$AUTOPLAN_SWEEP"; exit 1; }
+import json, sys
+payload = json.load(open(sys.argv[1]))
+assert payload, "empty autoplan sweep"
+bad, n_pipe = [], 0
+for entry in payload:
+    plan = entry.get("autoplan")
+    if plan is None:
+        bad.append("%s: planner error: %s"
+                   % (entry["target"], entry.get("plan_error")))
+    elif not plan["feasible"] and not plan.get("reason"):
+        bad.append("%s: infeasible with NO structured reason"
+                   % entry["target"])
+    elif plan["pipeline_stages"] > 1:
+        n_pipe += 1
+assert not bad, "autoplan gate: %s" % "; ".join(bad)
+tf = next(e["autoplan"] for e in payload if e["target"] == "transformer")
+chosen, naive = tf["predicted"]["comm_bytes"], tf["naive"]["comm_bytes"]
+assert chosen <= naive, \
+    "transformer: planner comm %d B > naive all-dp %d B" % (chosen, naive)
+print("autoplan sweep OK: %d models planned (%d pipelined); transformer "
+      "comm %.2f MiB vs naive %.2f MiB"
+      % (len(payload), n_pipe, chosen / 2**20, naive / 2**20))
+PYEOF
+rm -f "$AUTOPLAN_SWEEP"
 
 echo "== [2/8] source lint (ruff/pyflakes if available) =="
 if command -v ruff >/dev/null 2>&1; then
