@@ -772,6 +772,92 @@ def _bench_serving():
     return out
 
 
+def _bench_recommender(on_tpu, models, parallel, dev):
+    """Recommender leg (docs/SPARSE.md): the embedding-dominated workload
+    the row-sparse subsystem opens. Three numbers:
+
+    - ``samples_per_s`` — single-device DLRM-style train step (embedding
+      lookups + MLP) through the SPMD trainer;
+    - ``embedding_bytes_moved`` / ``sparse_vs_dense_wire_ratio`` — from the
+      2-process sparse-vs-dense smoke (tests/nightly/dist_sparse_kvstore):
+      the wire bytes the sparse KVStore round actually moved for the
+      tables vs the dense-push control, weight-parity enforced inside;
+    - ``autoplan`` — the 8-device plan under a budget that makes
+      replicated tables infeasible: the mesh and how many tables the
+      per-param search sharded over the model axis.
+    """
+    batch = 512 if on_tpu else 64
+    net = models.get_symbol("recommender")
+    rs = np.random.RandomState(0)
+    shapes = {"user": (batch,), "item": (batch,), "dense": (batch, 16),
+              "label": (batch,)}
+    trainer = _make_trainer(net, dev, shapes,
+                            "bfloat16" if on_tpu else None, parallel,
+                            data_names=("user", "item", "dense"))
+    data = {"user": _place(trainer, "user",
+                           rs.randint(0, 65536, (batch,)).astype("float32")),
+            "item": _place(trainer, "item",
+                           rs.randint(0, 32768, (batch,)).astype("float32")),
+            "dense": _place(trainer, "dense",
+                            rs.rand(batch, 16).astype("float32"))}
+    y = _place(trainer, "label",
+               rs.randint(0, 2, (batch,)).astype("float32"))
+    for _ in range(3):
+        outs = trainer.step(data, {"label": y})
+    _sync(outs)
+    n_steps = 20 if on_tpu else 5
+    t0 = time.perf_counter()
+    for _ in range(n_steps):
+        outs = trainer.step(data, {"label": y})
+    _sync(outs)
+    dt = time.perf_counter() - t0
+    res = {"samples_per_s": round(batch * n_steps / dt, 1), "batch": batch,
+           "step_ms": round(1000 * dt / n_steps, 2)}
+
+    # 2-proc sparse-vs-dense wire measurement (parity gated inside)
+    root = os.path.dirname(os.path.abspath(__file__))
+    env = dict(os.environ)
+    env.update({"JAX_PLATFORMS": "cpu", "MXNET_DEFAULT_CONTEXT": "cpu"})
+    r = subprocess.run(
+        [sys.executable, os.path.join(root, "tools", "launch.py"),
+         "-n", "2", "--launcher", "local", "--cpu-devices", "1",
+         sys.executable,
+         os.path.join(root, "tests", "nightly", "dist_sparse_kvstore.py")],
+        capture_output=True, text=True, timeout=420, env=env, cwd=root)
+    rec = None
+    for line in r.stdout.splitlines():
+        if line.startswith("DIST_SPARSE {"):
+            rec = json.loads(line[len("DIST_SPARSE "):])
+    if rec is None:
+        raise RuntimeError("2-proc sparse smoke produced no row (rc=%d): %s"
+                           % (r.returncode,
+                              (r.stderr or r.stdout).strip()[-300:]))
+    res["embedding_bytes_moved"] = rec["embedding_bytes_moved"]
+    res["sparse_vs_dense_wire_ratio"] = rec["sparse_vs_dense_wire_ratio"]
+    res["wire_parity_max_abs_diff"] = rec["parity_max_abs_diff"]
+    res["rows_pushed_2proc"] = rec["rows_pushed"]
+
+    # the 8-device plan when replicated tables do not fit (the regime the
+    # subsystem targets): the search must shard the tables, not pipeline
+    from mxnet_tpu.parallel import autoplan
+
+    plan = autoplan.plan_parallel(
+        net, {"user": (64,), "item": (64,), "dense": (64, 16),
+              "label": (64,)},
+        types={"user": "int32", "item": "int32"}, devices=8,
+        budget_gb=0.0625, label="recommender")
+    res["autoplan"] = {
+        "mesh": dict(plan.mesh), "feasible": plan.feasible,
+        "sharded_tables": sum(
+            1 for n in ("user_embed_weight", "item_embed_weight")
+            if any(plan.param_specs.get(n, []))),
+        "comm_vs_naive": round(
+            plan.predicted["comm_bytes"] / max(1, plan.naive["comm_bytes"]),
+            6),
+    }
+    return res
+
+
 def _bench_autoplan():
     """Auto-parallel planner leg (docs/PARALLEL_PLANNER.md): the plan the
     cost model picks for the transformer at 8 abstract devices (predicted
@@ -867,6 +953,10 @@ def main():
         autoplan_leg = _bench_autoplan()
     except Exception as exc:  # nor may the planner leg
         autoplan_leg = {"error": "%s: %s" % (type(exc).__name__, exc)}
+    try:
+        recommender = _bench_recommender(on_tpu, models, parallel, dev)
+    except Exception as exc:  # nor may the recommender leg
+        recommender = {"error": "%s: %s" % (type(exc).__name__, exc)}
 
     result = {
         "metric": "resnet50_train_throughput",
@@ -937,6 +1027,7 @@ def main():
     else:
         result["allreduce_error"] = ar["error"]
     result["serving"] = serving
+    result["recommender"] = recommender
     result["checkpoint"] = ckpt
     result["fusion_patterns"] = fusion_patterns
     result["autoplan"] = autoplan_leg

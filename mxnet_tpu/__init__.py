@@ -90,6 +90,7 @@ def __getattr__(name):
         "telemetry": ".telemetry",
         "faultinject": ".faultinject",
         "serving": ".serving",
+        "sparse": ".sparse",
         "checkpoint": ".checkpoint",
         "recordio": ".recordio",
         "image": ".image",

@@ -48,11 +48,17 @@ DEFAULT_SHAPES = {
                        "softmax_label": (2, 64)},
     "vgg16-ssd-300": {"data": (1, 3, 300, 300)},
     "vgg16-ssd-300-train": {"data": (1, 3, 300, 300), "label": (1, 3, 5)},
+    "recommender": {"user": (64,), "item": (64,), "dense": (64, 16),
+                    "label": (64,)},
+    "dlrm": {"user": (64,), "item": (64,), "dense": (64, 16),
+             "label": (64,)},
 }
 DEFAULT_TYPES = {
     "lstm": {"data": "int32"},
     "transformer": {"data": "int32"},
     "transformer_mt": {"data": "int32", "dec_data": "int32"},
+    "recommender": {"user": "int32", "item": "int32"},
+    "dlrm": {"user": "int32", "item": "int32"},
 }
 
 

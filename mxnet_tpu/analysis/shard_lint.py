@@ -259,10 +259,14 @@ def _propagate_node(node, parsed, meta, in_specs, in_shapes, out_shapes):
                    "batch_dot contraction dim sharded on one side only")
         return out_like((b, dspec[1], wspec[2])), gathers
 
-    if rule == "embedding":
+    if rule in ("embedding", "row_sparse_embedding"):
         # data (B,...) rows of weight (V, D) → (B, ..., D). A vocab-sharded
         # table serves the lookup with a masked-sum psum whose traffic is
-        # the OUTPUT, not the table — modeled as a gather of the output dim
+        # the OUTPUT, not the table — modeled as a gather of the output dim.
+        # The row_sparse variant's backward mirrors it: only touched rows
+        # scatter back, so the same output-bytes pricing holds both ways
+        # (docs/SPARSE.md) — which is why a sharded table falls out of
+        # autoplan's search instead of being taxed a full-table gather.
         dspec = specs[0] if specs else ()
         wspec = specs[1] if len(specs) > 1 else _replicated(2)
         if len(wspec) > 0 and wspec[0]:
@@ -378,6 +382,19 @@ def shard_plan_lint(ctx: GraphContext):
     # ---- seed variable specs (and GL401/GL404/GL405 on params) ----------
     data_like = {n.name for n in batch_like_vars(ctx)}
     aux_names = {n.name for n in ctx.aux_nodes}
+    # variables consumed as an embedding TABLE (slot 1 of an embedding-
+    # category op): GL405's fix hint names the table-specific placement
+    # instead of the generic rank-2 advice
+    from ..ops.infer_meta import EMBEDDING_RULES
+
+    embed_tables = {}
+    for node in ctx.topo:
+        if node.is_variable or len(node.inputs) < 2:
+            continue
+        if get_meta(node.op).shard_rule in EMBEDDING_RULES:
+            wnode = node.inputs[1][0]
+            if wnode.is_variable:
+                embed_tables.setdefault(wnode.name, (node.name, node.op))
     for node in ctx.arg_nodes + ctx.aux_nodes:
         shape = ctx.var_shape.get(node.name)
         if shape is None:
@@ -394,6 +411,25 @@ def shard_plan_lint(ctx: GraphContext):
                     param_pspec(node.name, shape, rules.model_axis or "model",
                                 model_size), len(shape))
                 if any(default):
+                    if node.name in embed_tables:
+                        consumer, op = embed_tables[node.name]
+                        hint = ("%r is the embedding table of %s (%s): "
+                                "param_pspec(%r, %s, model_axis=%r, "
+                                "model_size=%d) shards its vocab dim over "
+                                "the model axis — the lookup then psums "
+                                "only the output rows%s. Drop the custom "
+                                "param_rule for this name or return that "
+                                "spec."
+                                % (node.name, consumer, op, node.name,
+                                   tuple(shape), rules.model_axis or "model",
+                                   model_size,
+                                   " and the row-sparse backward scatters "
+                                   "only touched rows (docs/SPARSE.md)"
+                                   if op == "SparseEmbedding" else ""))
+                    else:
+                        hint = ("parallel.sharding.param_pspec would shard "
+                                "it — drop the custom param_rule for this "
+                                "name or return its spec")
                     diags.append(Diagnostic(
                         "GL405",
                         "parameter %r %s (%s) is replicated on every device "
@@ -404,9 +440,7 @@ def shard_plan_lint(ctx: GraphContext):
                            next(d for d, a in enumerate(default) if a),
                            model_size),
                         node=node.name,
-                        fix_hint="parallel.sharding.param_pspec would shard "
-                                 "it — drop the custom param_rule for this "
-                                 "name or return its spec",
+                        fix_hint=hint,
                     ))
                 elif (len(shape) == 2 and elems >= MIN_SHARD_ELEMS
                       and not shardable_dims(shape, model_size)):
@@ -460,7 +494,8 @@ def shard_plan_lint(ctx: GraphContext):
                 f *= spec_factor(sp, mesh, dim=d)
             if f <= 1:
                 continue
-            if meta.shard_rule == "embedding" and i == 1:
+            if meta.shard_rule in ("embedding", "row_sparse_embedding") \
+                    and i == 1:
                 # a vocab-sharded table never moves: the masked-sum psum
                 # traffic is the LOOKUP OUTPUT, once per non-owner shard
                 osh = out_shapes[0]

@@ -73,7 +73,8 @@ __all__ = [
     "Checkpointer", "atomic_write_bytes", "atomic_replace", "checkpoint_dir",
     "checkpoint_keep", "latest_complete", "load_manifest", "read_flat_buckets",
     "read_local_shard", "read_extra", "per_key_states", "step_dir",
-    "list_steps",
+    "list_steps", "read_shard_set", "read_sparse_tables",
+    "sparse_shard_arrays", "sparse_manifest_section",
     "apply_retention", "prefix_retention", "load_ndarrays_checked",
     "read_sharded_pointer",
 ]
@@ -415,13 +416,22 @@ def read_local_shard(root, step, manifest, rank):
     return _load_npz_checked(base + ".npz", meta.get("digest"))
 
 
-def read_flat_buckets(root, step, manifest):
+def read_shard_set(root, step, manifest):
+    """Every worker's digest-verified shard arrays, in rank order — read
+    ONCE and passed to both ``read_flat_buckets`` and
+    ``read_sparse_tables`` so a resume pays one disk+sha256 pass, not
+    three."""
+    world = int(manifest["world"])
+    return [read_local_shard(root, step, manifest, r) for r in range(world)]
+
+
+def read_flat_buckets(root, step, manifest, shards=None):
     """Assemble the FULL flat per-bucket arrays from every worker's shard
     file: ``{bucket_index: {"w": np, "states": [np, ...]}}``. Works for any
     saved world size — this is the re-flatten half of different-W resume."""
-    world = int(manifest["world"])
     n_states = int(manifest["optimizer"]["n_states"])
-    shards = [read_local_shard(root, step, manifest, r) for r in range(world)]
+    if shards is None:
+        shards = read_shard_set(root, step, manifest)
     out = {}
     for b in manifest["plan"]["buckets"]:
         idx = int(b["index"])
@@ -437,6 +447,87 @@ def read_flat_buckets(root, step, manifest):
         states = [np.concatenate([sh["b%d.s%d" % (idx, i)] for sh in shards])
                   for i in range(n_states)]
         out[idx] = {"w": w, "states": states}
+    return out
+
+
+# --------------------------------------------------- row-sparse table shards
+# Sparse embedding tables (docs/SPARSE.md) live OUTSIDE the bucket plan —
+# their optimizer state is a lazily-grown (indices, rows) set, not a flat
+# slice — so they checkpoint as their own shard arrays: worker r writes the
+# r-th contiguous piece of the dense table plus the r-th piece of the
+# touched-index set with its state rows (``index+rows per shard``). The
+# pieces are np.array_split slices of SORTED arrays, so any reader world
+# re-assembles them by plain concatenation — the same any-world re-flatten
+# property the flat buckets have.
+
+def sparse_shard_arrays(sparse_tables, rank, world):
+    """This worker's shard arrays for the manifest's sparse section.
+
+    ``sparse_tables``: ``{key: {"shape", "dtype", "w" (np dense table),
+    "indices" (np sorted int64), "states" ([np (nnz, ...) rows])}}``, in a
+    deterministic key order (the manifest section's order names the
+    ``sp<j>.*`` arrays)."""
+    out = {}
+    for j, key in enumerate(sorted(sparse_tables, key=str)):
+        t = sparse_tables[key]
+        flat = np.asarray(t["w"]).reshape(-1)
+        out["sp%d.w" % j] = np.array_split(flat, world)[rank]
+        out["sp%d.idx" % j] = np.array_split(
+            np.asarray(t["indices"], np.int64), world)[rank]
+        for i, s in enumerate(t["states"]):
+            out["sp%d.s%d" % (j, i)] = np.array_split(
+                np.asarray(s), world)[rank]
+    return out
+
+
+def sparse_manifest_section(sparse_tables):
+    """The manifest rows describing the sparse shard set (order matches
+    ``sparse_shard_arrays``)."""
+    rows = []
+    for key in sorted(sparse_tables, key=str):
+        t = sparse_tables[key]
+        rows.append({"key": _manifest_key(key),
+                     "shape": list(t["shape"]),
+                     "dtype": str(np.dtype(t["dtype"])),
+                     "nnz": int(np.asarray(t["indices"]).size),
+                     "n_states": len(t["states"])})
+    return rows
+
+
+def read_sparse_tables(root, step, manifest, shards=None):
+    """Re-assemble every sparse table from the shard set:
+    ``{key: {"w": np dense table, "indices": np, "states": [np rows]}}``.
+    Works for ANY saved world size (concatenation of the per-rank pieces) —
+    the index+rows half of the different-W re-flatten path."""
+    section = manifest.get("sparse") or []
+    if not section:
+        return {}
+    if shards is None:
+        shards = read_shard_set(root, step, manifest)
+    out = {}
+    for j, row in enumerate(section):
+        key = _manifest_key(row["key"])
+        shape = tuple(row["shape"])
+        names = (["sp%d.w" % j, "sp%d.idx" % j]
+                 + ["sp%d.s%d" % (j, i) for i in range(row["n_states"])])
+        for name in names:
+            for r, sh in enumerate(shards):
+                if name not in sh:
+                    raise MXNetError(
+                        "checkpoint step %s shard %d is missing sparse "
+                        "array %r — manifest/shard mismatch"
+                        % (step, r, name))
+        w = np.concatenate([sh["sp%d.w" % j] for sh in shards]).reshape(shape)
+        idx = np.concatenate([sh["sp%d.idx" % j] for sh in shards])
+        states = [np.concatenate([sh["sp%d.s%d" % (j, i)] for sh in shards])
+                  for i in range(row["n_states"])]
+        if idx.size != row["nnz"]:
+            raise MXNetError(
+                "checkpoint step %s sparse key %r: %d touched rows in the "
+                "shards, manifest says %d" % (step, key, idx.size,
+                                              row["nnz"]))
+        out[key] = {"w": w, "indices": idx.astype(np.int64),
+                    "states": states}
     return out
 
 
@@ -663,44 +754,62 @@ class Checkpointer:
         commit marker). All workers must call this at the same step.
         """
         engine = getattr(kv, "_bucket_engine", None)
-        if engine is None or engine.plan is None:
-            raise MXNetError(
-                "save_sharded needs a committed bucket plan (run at least "
-                "one push round first)")
-        if engine.mode != "sharded" or not engine._sharded_state:
+        sparse_tables = self._collect_sparse(kv)
+        dense_ok = (engine is not None and engine.plan is not None
+                    and engine.mode == "sharded" and engine._sharded_state)
+        if not dense_ok and not sparse_tables:
+            if engine is None or engine.plan is None:
+                raise MXNetError(
+                    "save_sharded needs a committed bucket plan (run at "
+                    "least one push round first)")
             raise MXNetError(
                 "save_sharded called while the engine is not in sharded "
                 "update mode — use save_replicated (states live per key)")
-        missing = [b.index for b in engine.plan.buckets
-                   if b.index not in engine._sharded_state]
-        if missing:
-            raise MXNetError(
-                "sharded checkpoint needs every bucket's flat state "
-                "materialized; buckets %s have not dispatched yet (finish "
-                "the push round / call finalize_all first)" % missing)
-        coll = engine._coll()
-        rank, world = coll.rank, coll.n_workers
+        if dense_ok:
+            missing = [b.index for b in engine.plan.buckets
+                       if b.index not in engine._sharded_state]
+            if missing:
+                raise MXNetError(
+                    "sharded checkpoint needs every bucket's flat state "
+                    "materialized; buckets %s have not dispatched yet "
+                    "(finish the push round / call finalize_all first)"
+                    % missing)
+            coll = engine._coll()
+            rank, world = coll.rank, coll.n_workers
+        else:
+            rank, world = kv.rank, kv.num_workers
         opt = kv._optimizer
         kind, hyper, n_states = opt.flat_update_spec()
         with _tm.span("checkpoint.save", step=step, kind="sharded"):
             local = {}
-            for b in engine.plan.buckets:
-                sstate = engine._sharded_state[b.index]
-                shard = b.total // world
-                # device-side slice of the replicated weight buffer: async
-                # dispatch, the host transfer happens on the writer thread
-                w_loc = sstate["w_full"].addressable_data(0)
-                local["b%d.w" % b.index] = \
-                    w_loc[rank * shard:(rank + 1) * shard]
-                for i, s in enumerate(sstate["states"]):
-                    local["b%d.s%d" % (b.index, i)] = s.addressable_data(0)
+            if dense_ok:
+                for b in engine.plan.buckets:
+                    sstate = engine._sharded_state[b.index]
+                    shard = b.total // world
+                    # device-side slice of the replicated weight buffer:
+                    # async dispatch, the host transfer happens on the
+                    # writer thread
+                    w_loc = sstate["w_full"].addressable_data(0)
+                    local["b%d.w" % b.index] = \
+                        w_loc[rank * shard:(rank + 1) * shard]
+                    for i, s in enumerate(sstate["states"]):
+                        local["b%d.s%d" % (b.index, i)] = \
+                            s.addressable_data(0)
+            # row-sparse tables ride the same shard files: this worker's
+            # 1/W piece of each table + touched index set + state rows
+            # (host snapshots — the (indices, rows) state is host-resident
+            # already, and the table slice is 1/W of the dense bytes)
+            local.update(sparse_shard_arrays(sparse_tables, rank, world))
             manifest = None
             if rank == 0:
+                plan_view = (engine.plan.describe_portable() if dense_ok
+                             else {"buckets": []})
                 manifest = {
                     "format": FORMAT_VERSION, "kind": "sharded",
                     "step": int(step), "world": world,
-                    "plan_hash": engine.plan.hash,
-                    "plan": engine.plan.describe_portable(),
+                    "plan_hash": engine.plan.hash if dense_ok else None,
+                    "plan": plan_view,
+                    "sparse": sparse_manifest_section(sparse_tables),
                     "optimizer": {
                         "kind": kind, "n_states": n_states,
                         "hyper": {k: v for k, v in hyper.items()},
@@ -713,11 +822,28 @@ class Checkpointer:
                     "meta": dict(meta or {}),
                     "written_at": time.time(),
                 }
+            plan_hash = engine.plan.hash if dense_ok else None
             return self._submit(
                 lambda: self._write_shard(step, rank, world,
-                                          engine.plan.hash, local,
+                                          plan_hash, local,
                                           extra, manifest),
                 step, block)
+
+    @staticmethod
+    def _collect_sparse(kv):
+        """Host-side snapshot of every row-sparse table + its lazy state
+        (docs/SPARSE.md): the checkpoint view ``sparse_shard_arrays``
+        slices. ``{}`` when the store has no sparse keys."""
+        sp = getattr(kv, "_sparse_engine", None)
+        if sp is None:
+            return {}
+        out = {}
+        for key, (shape, dtype, st) in sp.sparse_states().items():
+            out[key] = {"shape": tuple(shape), "dtype": dtype,
+                        "w": np.asarray(kv._store[key]._jax()),
+                        "indices": st.indices.copy(),
+                        "states": [r.copy() for r in st.rows]}
+        return out
 
     def save_replicated(self, step, weights, states_bytes=None, extra=None,
                         meta=None, world=1, rank=0, block=False):

@@ -24,6 +24,8 @@ from __future__ import annotations
 import pickle
 from typing import Dict, List, Optional
 
+import numpy as np
+
 from .base import MXNetError
 from . import ndarray as nd
 from . import optimizer as opt
@@ -49,6 +51,7 @@ class KVStore:
         self._updater: Optional[opt.Updater] = None
         self._optimizer = None
         self._bucket_engine = None  # dist comm engine (kvstore_bucket)
+        self._sparse_engine = None  # row-sparse rounds (sparse/kvstore_sparse)
 
     # ------------------------------------------------------------------ meta
     @property
@@ -132,6 +135,9 @@ class KVStore:
             sp = _tm.span("kvstore.push", nkeys=len(keys), bytes=pushed,
                           dist="dist" in self._type, priority=priority)
         with sp:
+            keys, grouped = self._route_sparse(keys, grouped, priority)
+            if not keys:
+                return
             eng = self._engine()
             if eng is not None:
                 # bucketed path never mutates the merged value: skip the
@@ -171,6 +177,60 @@ class KVStore:
                 local = self._store[k]
                 for o in outs:
                     o[:] = local
+
+    def _route_sparse(self, keys, grouped, priority):
+        """Split row-sparse values out of a push round and run them through
+        the sparse engine (index-union round + lazy update,
+        sparse/kvstore_sparse.py); returns the remaining dense items.
+        Sparse keys bypass the bucket plan entirely — which rows move
+        changes every round, the opposite of the plan's fixed offsets."""
+        from .sparse import RowSparseNDArray
+
+        if not any(isinstance(v, RowSparseNDArray)
+                   for vals in grouped for v in vals):
+            return keys, grouped
+        eng = self._sparse()
+        dense_k, dense_g = [], []
+        for k, vals in zip(keys, grouped):
+            if isinstance(vals[0], RowSparseNDArray):
+                merged = vals[0]
+                for v in vals[1:]:  # local multi-device reduce: index merge
+                    merged = merged + v
+                eng.push(k, merged, priority=priority)
+            else:
+                dense_k.append(k)
+                dense_g.append(vals)
+        return dense_k, dense_g
+
+    def _sparse(self):
+        """Lazy row-sparse engine (works on local AND dist stores)."""
+        if self._sparse_engine is None:
+            from .sparse.kvstore_sparse import SparseEngine
+
+            self._sparse_engine = SparseEngine(self)
+        return self._sparse_engine
+
+    def row_sparse_pull(self, key, row_ids, priority=0):
+        """Pull only the requested rows of a key as a RowSparseNDArray
+        (reference: kvstore.py row_sparse_pull / kvstore_dist.h
+        PullRowSparseImpl) — the serving/eval-side complement of the sparse
+        push: a huge sharded-out table never has to materialize densely on
+        the consumer."""
+        if key not in self._store:
+            raise MXNetError("key %s has not been inited" % key)
+        from .sparse import RowSparseNDArray, normalize_row_ids
+
+        rows = normalize_row_ids(row_ids)
+        stored = self._store[key]
+        if _tm.enabled():
+            _tm.counter("kvstore.pull_calls").inc()
+            _tm.counter("kvstore.pull_bytes").inc(
+                int(rows.size * int(np.prod(stored.shape[1:]) or 1)
+                    * stored.dtype.itemsize))
+        vals = stored._jax()[rows] if rows.size else \
+            np.zeros((0,) + tuple(stored.shape[1:]), stored.dtype)
+        return RowSparseNDArray(rows, NDArray(vals, ctx=stored.context),
+                                stored.shape, ctx=stored.context)
 
     def _engine(self):
         """Lazy bucket engine for multi-process dist stores
@@ -306,6 +366,12 @@ class KVStore:
         from . import checkpoint as ckpt
 
         eng = self._bucket_engine
+        # ONLY the flat-sharded engine takes the pointer-file path: sparse
+        # tables ride its shard files there (Checkpointer._collect_sparse).
+        # A replicated/local store — sparse keys or not — keeps the classic
+        # per-key state pickle, which carries RowSparseState as plain numpy
+        # (a sparse-only branch here once silently DROPPED every dense
+        # key's state; regression-tested in test_sparse_checkpoint.py).
         if eng is not None and eng._sharded_state:
             eng.finalize_all()
             opt = self._optimizer
@@ -390,7 +456,8 @@ class KVStore:
                 % (path, want["kind"], want["n_states"],
                    type(opt).__name__, kind, n_states))
 
-    def _seed_states_from_manifest(self, root, step, manifest, flats=None):
+    def _seed_states_from_manifest(self, root, step, manifest, flats=None,
+                                   sparse_tables=None):
         """Seed optimizer state from a sharded checkpoint step: shard-direct
         when the live plan/world match (momentum bit-parity), else re-flatten
         every worker's shard into per-key Updater states (different-W
@@ -454,12 +521,35 @@ class KVStore:
                     nds[0] if len(nds) == 1 else nds if nds else None)
             if eng is not None:
                 eng.reseed_updater_states()
+        self._seed_sparse_states(root, step, manifest, tables=sparse_tables)
         opt = self._optimizer
         if opt is not None:
             for key, count in manifest.get("update_counts", ()):
                 opt._index_update_count[key] = int(count)
             opt.num_update = max(opt.num_update,
                                  int(manifest.get("num_update", 0)))
+
+    def _seed_sparse_states(self, root, step, manifest, tables=None):
+        """Seed row-sparse optimizer states from the manifest's sparse
+        section (index+rows per shard, docs/SPARSE.md) — re-assembled by
+        concatenation, so ANY reader world resumes bit-identically from
+        any writer world. ``tables`` reuses an already-read shard set."""
+        from . import checkpoint as ckpt
+
+        if not manifest.get("sparse"):
+            return
+        from .sparse import RowSparseState
+
+        if tables is None:
+            tables = ckpt.read_sparse_tables(root, step, manifest)
+        for row in manifest["sparse"]:
+            key = row["key"]
+            t = tables[key]
+            st = RowSparseState(tuple(row["shape"]), row["dtype"],
+                                int(row["n_states"]))
+            st.indices = t["indices"]
+            st.rows = [np.asarray(s, st.dtype) for s in t["states"]]
+            self._updater.states[key] = st
 
     # ---------------------------------------------------------------- elastic
     #
@@ -549,8 +639,18 @@ class KVStore:
         self._check_flat_spec(manifest, root)
         with _tm.span("checkpoint.load", step=step,
                       world=manifest.get("world")):
-            flats = ckpt.read_flat_buckets(root, step, manifest)
+            # ONE disk + sha256 pass over the shard set; flats, sparse
+            # tables and the state seeding below all slice from it
+            shards = ckpt.read_shard_set(root, step, manifest)
+            flats = ckpt.read_flat_buckets(root, step, manifest,
+                                           shards=shards)
             weights = ckpt.per_key_states(manifest, flats, weights=True)
+            # row-sparse tables: the full dense table re-assembles from the
+            # per-worker 1/W pieces (docs/SPARSE.md)
+            sparse_tables = ckpt.read_sparse_tables(root, step, manifest,
+                                                    shards=shards)
+            for key, t in sparse_tables.items():
+                weights[key] = t["w"]
             from .ndarray import NDArray
             import jax.numpy as jnp
 
@@ -558,7 +658,8 @@ class KVStore:
                 if key in self._store:
                     self._store[key] = NDArray(jnp.asarray(w))
             self._seed_states_from_manifest(root, step, manifest,
-                                            flats=flats)
+                                            flats=flats,
+                                            sparse_tables=sparse_tables)
         return step, weights
 
 

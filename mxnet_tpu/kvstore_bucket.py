@@ -243,52 +243,13 @@ class BucketPlan:
 
 
 # --------------------------------------------------------------------- flat
-# jittable flat optimizer kernels for the sharded update — each mirrors the
-# corresponding fused op in ops/optimizer_ops.py exactly (same expression
-# tree, so sharded and replicated land within reassociation drift of each
-# other; per-key lr/wd arrive as per-element vectors gathered from the
-# bucket's static key-index map).
-
-def _flat_sgd(hyper):
-    import jax.numpy as jnp
-
-    rg, clip = hyper["rescale_grad"], hyper["clip_gradient"]
-    mu = hyper["momentum"]
-
-    def fn(w, g, states, lr, wd):
-        g = g * rg
-        if clip and clip > 0:
-            g = jnp.clip(g, -clip, clip)
-        if mu:
-            (mom,) = states
-            new_mom = mu * mom - lr * (g + wd * w)
-            return w + new_mom, (new_mom,)
-        return w - lr * (g + wd * w), ()
-
-    return fn
-
-
-def _flat_adam(hyper):
-    import jax.numpy as jnp
-
-    rg, clip = hyper["rescale_grad"], hyper["clip_gradient"]
-    b1, b2, eps = hyper["beta1"], hyper["beta2"], hyper["epsilon"]
-
-    def fn(w, g, states, lr, wd):
-        g = g * rg
-        if clip and clip > 0:
-            g = jnp.clip(g, -clip, clip)
-        g = g + wd * w
-        mean, var = states
-        new_mean = b1 * mean + (1 - b1) * g
-        new_var = b2 * var + (1 - b2) * jnp.square(g)
-        w = w - lr * new_mean / (jnp.sqrt(new_var) + eps)
-        return w, (new_mean, new_var)
-
-    return fn
-
-
-_FLAT_KERNELS = {"sgd": _flat_sgd, "adam": _flat_adam}
+# The jittable flat optimizer kernels moved to ``optimizer.FLAT_KERNELS``
+# so the row-sparse lazy update (optimizer.update_row_sparse,
+# docs/SPARSE.md) and this engine's fused sharded update share ONE
+# expression tree — sharded, replicated and lazy-sparse land within
+# reassociation drift of each other. Re-exported under the old name for
+# existing imports/tests.
+from .optimizer import FLAT_KERNELS as _FLAT_KERNELS  # noqa: E402
 
 
 class _BucketState:

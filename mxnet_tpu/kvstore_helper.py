@@ -58,7 +58,7 @@ def initialize_kvstore(kvstore, param_arrays, arg_params, param_names, update_on
 
 
 def update_params_on_kvstore(param_arrays, grad_arrays, kvstore,
-                             priorities=None):
+                             priorities=None, sparse_indices=()):
     """(reference: model.py:88 _update_params_on_kvstore) — push grads (store
     reduces + runs the optimizer), pull fresh weights back to every device.
 
@@ -73,12 +73,25 @@ def update_params_on_kvstore(param_arrays, grad_arrays, kvstore,
     — the ones the next forward needs first — finalize while the deep
     buckets' collectives are still in flight (docs/PERF.md §11). Non-dist
     stores keep the single batched round: with no inter-process collective
-    there is nothing to overlap."""
+    there is nothing to overlap.
+
+    ``sparse_indices`` names the param indices whose producer declared a
+    row-sparse gradient (``SparseEmbedding`` / ``Embedding(sparse_grad=
+    True)``, resolved by ``Module`` via ``sparse.sparse_param_names``):
+    their dense grad buffers convert at this boundary (``from_dense``
+    nonzero-row detection — the executor layer does not thread the batch's
+    ids here) and ride the KVStore sparse round + lazy update
+    (docs/SPARSE.md) instead of the bucket plan."""
     keys, grads, args = [], [], []
+    sparse_set = set(sparse_indices or ())
+    if sparse_set:
+        from .sparse import from_dense
     for index, (arg_list, grad_list) in enumerate(zip(param_arrays, grad_arrays)):
         if grad_list[0] is None:
             continue
         keys.append(index)
+        if index in sparse_set:
+            grad_list = [from_dense(g) for g in grad_list]
         grads.append(grad_list)
         args.append(arg_list)
     if not keys:

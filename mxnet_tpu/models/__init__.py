@@ -11,7 +11,7 @@ matmuls/convs, and bf16-friendly dtypes threaded via the ``dtype`` argument.
 resnet.py get_symbol).
 """
 from . import (lenet, mlp, alexnet, vgg, resnet, inception_bn, inception_v3,
-               lstm, transformer, vgg16_ssd)
+               lstm, transformer, vgg16_ssd, recommender)
 
 _ZOO = {
     "lenet": lenet.get_symbol,
@@ -35,6 +35,8 @@ _ZOO = {
     "transformer_mt": transformer.get_symbol_mt,
     "vgg16-ssd-300": vgg16_ssd.get_symbol,
     "vgg16-ssd-300-train": vgg16_ssd.get_symbol_train,
+    "recommender": recommender.get_symbol,
+    "dlrm": recommender.get_symbol,
 }
 
 

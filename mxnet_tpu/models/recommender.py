@@ -1,0 +1,70 @@
+"""DLRM-style two-tower recommender: sharded embedding tables + MLP.
+
+The canonical "millions of users" training workload (docs/SPARSE.md) and
+the first embedding-dominated member of the zoo: ~97% of the trainable
+bytes live in two ``SparseEmbedding`` tables whose gradients are row-sparse
+by contract — only the rows a batch looks up ever reach the optimizer or
+the wire. That shape is what the whole sparse subsystem exists to exploit:
+
+* training — the KVStore sparse round (``sparse/kvstore_sparse.py``) ships
+  the batch's unique-row union instead of the (vocab, dim) tables;
+* placement — the tables carry the ``row_sparse_embedding`` shard-rule
+  category, so the plan lint prices a vocab-sharded table as output-psum
+  traffic and autoplan's per-param search shards them over the model axis
+  instead of paying the dp grad-sync on the full tables.
+
+Architecture (DLRM's embedding+MLP scaffold at a CI-friendly scale):
+sparse id features ``user``/``item`` → embedding rows; dense features →
+bottom MLP projected to the embedding width; the three vectors concatenate
+(with the explicit user·item dot — the two-tower affinity — appended) into
+a top MLP ending in a logistic click head.
+
+Inputs: ``user`` (B,), ``item`` (B,) integer ids; ``dense`` (B, dense_dim)
+float features; ``label`` (B,) in {0,1}.
+"""
+from .. import symbol as sym
+
+__all__ = ["get_symbol"]
+
+
+def _mlp(x, dims, name, act="relu"):
+    for i, d in enumerate(dims):
+        x = sym.FullyConnected(x, num_hidden=d, name="%s_fc%d" % (name, i))
+        x = sym.Activation(x, act_type=act, name="%s_act%d" % (name, i))
+    return x
+
+
+def get_symbol(num_users=65536, num_items=32768, embed_dim=64, dense_dim=16,
+               bottom_hidden=(128,), top_hidden=(512, 256), **kwargs):
+    """Build the recommender Symbol.
+
+    Defaults are sized so (a) each table clears the tensor-parallel
+    shard-or-replicate boundary (``vocab * dim >= MIN_SHARD_ELEMS``) with a
+    vocab dim divisible by every mesh factor up to 8, and (b) the top-MLP
+    weights are large enough that autoplan can Megatron-shard them too —
+    a dp×tp plan then splits EVERY major tensor and the planner's
+    compute-utilization term stays neutral (docs/PARALLEL_PLANNER.md).
+    """
+    user = sym.Variable("user")
+    item = sym.Variable("item")
+    dense = sym.Variable("dense")
+    label = sym.Variable("label")
+
+    u = sym.SparseEmbedding(data=user, input_dim=num_users,
+                            output_dim=embed_dim, name="user_embed")
+    v = sym.SparseEmbedding(data=item, input_dim=num_items,
+                            output_dim=embed_dim, name="item_embed")
+
+    # bottom MLP: dense features projected to the embedding width
+    d = _mlp(dense, tuple(bottom_hidden) + (embed_dim,), "bot")
+
+    # two-tower affinity: the explicit user·item interaction, kept as a
+    # feature next to the raw vectors (the DLRM pairwise-dot idea at the
+    # two-tower special case)
+    dot_uv = sym.sum(u * v, axis=1, keepdims=True)
+
+    z = sym.Concat(u, v, d, dot_uv, num_args=4, dim=1, name="interact")
+    top = _mlp(z, tuple(top_hidden), "top")
+    logit = sym.FullyConnected(top, num_hidden=1, name="click")
+    return sym.LogisticRegressionOutput(data=logit, label=label,
+                                        name="click_prob")

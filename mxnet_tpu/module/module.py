@@ -459,10 +459,23 @@ class Module(BaseModule):
         if self._update_on_kvstore:
             from ..kvstore_helper import update_params_on_kvstore
 
+            sparse_idx = getattr(self, "_sparse_grad_idx", None)
+            if sparse_idx is None:
+                # params whose producer declared a row-sparse gradient
+                # (SparseEmbedding / Embedding(sparse_grad=True)): their
+                # pushes ride the KVStore sparse round + lazy update
+                # (docs/SPARSE.md)
+                from ..sparse import sparse_param_names
+
+                names = set(sparse_param_names(self._symbol))
+                sparse_idx = frozenset(
+                    i for i, n in enumerate(self._param_names) if n in names)
+                self._sparse_grad_idx = sparse_idx
             update_params_on_kvstore(
                 self._exec_group.param_arrays, self._exec_group.grad_arrays,
                 self._kvstore,
                 priorities=self._exec_group.param_priorities,
+                sparse_indices=sparse_idx,
             )
         else:
             from ..kvstore_helper import update_params

@@ -27,7 +27,10 @@ structs; here they are declarative:
                        data, channel dim from weight dim 0, spatial dims
                        replicated), ``"fc"``/``"dot"`` (contraction: out
                        dims from data dim 0 and weight/rhs out dim),
-                       ``"embedding"``, ``"flatten"``, ``"reshape"``,
+                       ``"embedding"``/``"row_sparse_embedding"`` (lookup
+                       tables; the sparse variant's weight gradient is
+                       row-sparse by contract, docs/SPARSE.md),
+                       ``"flatten"``, ``"reshape"``,
                        ``"transpose"``, ``"concat"``, ``"reduce"``,
                        ``"softmax"`` (needs its softmax'd dim whole). The
                        default ``"batch0"`` keeps the first input's batch-
@@ -58,8 +61,14 @@ def rank_range(v) -> Optional[Tuple[int, int]]:
 
 
 SHARD_RULES = ("batch0", "elementwise", "conv", "fc", "dot", "batch_dot",
-               "embedding", "flatten", "reshape", "transpose", "concat",
-               "reduce", "softmax")
+               "embedding", "row_sparse_embedding", "flatten", "reshape",
+               "transpose", "concat", "reduce", "softmax")
+
+# categories whose slot-1 parameter is an embedding TABLE (vocab, dim): the
+# sharding lint prices a vocab-sharded table as output-psum traffic (the
+# table itself never moves), and GL405's fix hint names the table-specific
+# param_pspec instead of the generic rank-2 advice.
+EMBEDDING_RULES = ("embedding", "row_sparse_embedding")
 
 
 class OpMeta:
@@ -145,6 +154,15 @@ register_meta("Embedding",
               input_ranks={"weight": 2},
               dtype_policy="first",
               param_slots=("weight",), shard_rule="embedding")
+# the sparse-grad variant (docs/SPARSE.md): same lookup semantics, but the
+# weight's gradient is row-sparse by contract — its own shard-rule category
+# so the plan lint/autoplan can price a vocab-sharded table (the lookup
+# psums only the OUTPUT; the backward scatters only touched rows)
+register_meta("SparseEmbedding",
+              input_ranks={"weight": 2},
+              dtype_policy="first",
+              param_slots=("weight",), shard_rule="row_sparse_embedding",
+              aliases=("row_sparse_embedding",))
 register_meta("RNN",
               input_ranks={"data": 3, "parameters": 1,
                            "state": 3, "state_cell": 3},

@@ -228,12 +228,39 @@ def _slice_channel(attrs, data):
         "input_dim": AttrSpec("int", required=True),
         "output_dim": AttrSpec("int", required=True),
         "dtype": AttrSpec("dtype", default=np.float32),
+        # reference: Embedding(..., sparse_grad=True) marks the weight for a
+        # row-sparse gradient (docs/SPARSE.md). The forward is identical;
+        # the flag is metadata the sparse KVStore glue and the GL4xx
+        # sharding lint read (sparse.sparse_param_names).
+        "sparse_grad": AttrSpec("bool", default=False),
     },
     input_names=("data", "weight"),
 )
 def _embedding(attrs, data, weight):
     """Lookup-table embedding (reference: indexing_op.cc Embedding). XLA lowers
     this gather to a one-hot matmul on the MXU for small vocabularies."""
+    return jnp.take(weight, data.astype(jnp.int32), axis=0)
+
+
+@register(
+    "SparseEmbedding",
+    attrs={
+        "input_dim": AttrSpec("int", required=True),
+        "output_dim": AttrSpec("int", required=True),
+        "dtype": AttrSpec("dtype", default=np.float32),
+    },
+    input_names=("data", "weight"),
+    aliases=("row_sparse_embedding",),
+)
+def _sparse_embedding(attrs, data, weight):
+    """Embedding whose weight gradient is row-sparse by contract
+    (reference: contrib.SparseEmbedding over kRowSparseStorage): the
+    backward is a segment-sum over the batch's unique ids
+    (``sparse.embedding_backward``) — the (vocab, dim) dense gradient is
+    never materialized, and only touched rows reach the optimizer/wire.
+    Forward is the same gather; the distinct op name carries the
+    ``row_sparse_embedding`` shard-rule category (ops/infer_meta.py) so the
+    sharding lint and autoplan price its vocab-sharded placement."""
     return jnp.take(weight, data.astype(jnp.int32), axis=0)
 
 

@@ -6,9 +6,27 @@ state)`` lowers to ONE fused update op from ``ops/optimizer_ops.py`` where the
 reference has a device kernel (sgd/sgd_mom/adam/rmsprop), so XLA fuses
 rescale+clip+wd+update into a single HBM pass per weight — the reference's
 device-side kvstore-updater path, TPU-native.
+
+The jittable FLAT kernels (``flat_kernel``) are the shared lowering behind
+two consumers: the kvstore bucket engine's fused sharded weight update
+(``kvstore_bucket``) and the row-sparse LAZY update
+(``update_row_sparse``, docs/SPARSE.md) — one expression tree, so sharded,
+replicated and lazy-sparse all land within reassociation drift of each
+other.
+
+**Lazy-update contract** (``update_row_sparse``): a row-sparse gradient
+updates ONLY the rows its index set names — weight rows outside the set are
+untouched, and their optimizer state stays *bit-identical to seed* (for
+Adam that means mean/var are still exactly zero, never decayed by a
+phantom zero-gradient step). The per-key update count still ticks once per
+round, so lr schedules match the dense path. Enforced by construction
+(``sparse.RowSparseState`` stores no row it never updated) and regression-
+tested in tests/test_sparse.py — including against a dense-wire fallback
+round, which must convert back to a row set before updating.
 """
 from __future__ import annotations
 
+import functools
 import math
 from typing import Optional
 
@@ -33,7 +51,78 @@ __all__ = [
     "register",
     "get_updater",
     "Updater",
+    "flat_kernel",
+    "FLAT_KERNELS",
 ]
+
+
+# ------------------------------------------------------------------ flat
+# jittable flat optimizer kernels — each mirrors the corresponding fused op
+# in ops/optimizer_ops.py exactly (same expression tree). ``lr``/``wd``
+# arrive at runtime as scalars or per-element vectors; everything in
+# ``hyper`` is a trace-time constant. Shared by kvstore_bucket's sharded
+# update and the row-sparse lazy update below.
+
+def _flat_sgd(hyper):
+    import jax.numpy as jnp
+
+    rg, clip = hyper["rescale_grad"], hyper["clip_gradient"]
+    mu = hyper["momentum"]
+
+    def fn(w, g, states, lr, wd):
+        g = g * rg
+        if clip and clip > 0:
+            g = jnp.clip(g, -clip, clip)
+        if mu:
+            (mom,) = states
+            new_mom = mu * mom - lr * (g + wd * w)
+            return w + new_mom, (new_mom,)
+        return w - lr * (g + wd * w), ()
+
+    return fn
+
+
+def _flat_adam(hyper):
+    import jax.numpy as jnp
+
+    rg, clip = hyper["rescale_grad"], hyper["clip_gradient"]
+    b1, b2, eps = hyper["beta1"], hyper["beta2"], hyper["epsilon"]
+
+    def fn(w, g, states, lr, wd):
+        g = g * rg
+        if clip and clip > 0:
+            g = jnp.clip(g, -clip, clip)
+        g = g + wd * w
+        mean, var = states
+        new_mean = b1 * mean + (1 - b1) * g
+        new_var = b2 * var + (1 - b2) * jnp.square(g)
+        w = w - lr * new_mean / (jnp.sqrt(new_var) + eps)
+        return w, (new_mean, new_var)
+
+    return fn
+
+
+FLAT_KERNELS = {"sgd": _flat_sgd, "adam": _flat_adam}
+
+
+@functools.lru_cache(maxsize=64)
+def _jitted_flat_kernel(kind, hyper_key, n_states):
+    """One compiled row-update executable per (kind, hyper) — shapes/dtypes
+    specialize through jit's own cache."""
+    import jax
+
+    kernel = FLAT_KERNELS[kind](dict(hyper_key))
+
+    def run(w, g, states, lr, wd):
+        w_new, s_new = kernel(w, g, tuple(states), lr, wd)
+        return (w_new,) + tuple(s_new)
+
+    return jax.jit(run)
+
+
+def flat_kernel(kind, hyper):
+    """The raw (unjitted) flat kernel for a ``flat_update_spec`` family."""
+    return FLAT_KERNELS[kind](hyper)
 
 
 class Optimizer:
@@ -99,8 +188,71 @@ class Optimizer:
         optimizer's fused per-key op, or ``None`` when the optimizer has no
         flat lowering (the engine then falls back to the replicated
         update). ``hyper`` must be trace-time constants; per-key lr/wd
-        arrive at runtime as vectors."""
+        arrive at runtime as vectors. The same spec powers the row-sparse
+        LAZY update (``update_row_sparse``) — sparse-aware by construction:
+        the kernel runs over the touched rows only."""
         return None
+
+    def create_state_row_sparse(self, index, weight):
+        """State for a row-sparse-gradient parameter: a lazily-grown
+        ``sparse.RowSparseState`` with one row slot per flat-kernel state
+        (docs/SPARSE.md). Optimizers without a flat lowering fall back to
+        the dense state (their row-sparse updates densify, with a one-time
+        warning — lazy semantics need SGD/Adam)."""
+        spec = self.flat_update_spec()
+        if spec is None:
+            if not getattr(self, "_warned_no_lazy", False):
+                self._warned_no_lazy = True
+                import logging
+
+                logging.getLogger("mxnet_tpu.sparse").warning(
+                    "optimizer %s has no flat_update_spec(): row-sparse "
+                    "gradients densify and the update is NOT lazy (untouched "
+                    "rows see a zero-gradient step)", type(self).__name__)
+            return self.create_state(index, weight)
+        from .sparse import RowSparseState
+
+        _, _, n_states = spec
+        return RowSparseState(weight.shape, weight.dtype, n_states)
+
+    def update_row_sparse(self, index, weight, grad, state):
+        """Lazy row update (reference: the ``lazy_update=True`` path of
+        sgd_update/adam_update over kRowSparseStorage). Applies the flat
+        kernel to exactly ``grad.indices``'s rows of ``weight`` and
+        ``state``; every other row — weight AND optimizer state — is
+        bit-untouched. The per-key update count ticks once per call, so lr
+        schedules stay identical to the dense path."""
+        from .sparse import RowSparseNDArray, RowSparseState
+
+        assert isinstance(grad, RowSparseNDArray), type(grad)
+        spec = self.flat_update_spec()
+        if spec is None or not isinstance(state, RowSparseState):
+            # no flat lowering (or a dense state from a dense resume):
+            # densify — correctness preserved, laziness forfeited
+            self.update(index, weight, grad.to_dense(), state)
+            return
+        kind, hyper, n_states = spec
+        self._update_count(index)
+        lr, wd = self._get_lr(index), self._get_wd(index)
+        if kind == "adam":
+            # same host-side bias-correction fold Adam.update applies
+            t = self._index_update_count[index]
+            lr *= (math.sqrt(1.0 - hyper["beta2"] ** t)
+                   / (1.0 - hyper["beta1"] ** t))
+        rows = grad.indices.asnumpy().astype(np.int64)
+        if not rows.size:
+            return
+        import jax.numpy as jnp
+
+        fn = _jitted_flat_kernel(
+            kind, tuple(sorted(hyper.items())), n_states)
+        w_jax = weight._jax()
+        w_rows = w_jax[rows]
+        g_rows = grad.values._jax().astype(w_rows.dtype)
+        s_rows = tuple(jnp.asarray(s) for s in state.gather(rows))
+        out = fn(w_rows, g_rows, s_rows, np.float32(lr), np.float32(wd))
+        weight._set_jax(w_jax.at[rows].set(out[0]))
+        state.scatter(rows, [np.asarray(s) for s in out[1:]])
 
     # ----------------------------------------------------------------- mults
     def set_lr_mult(self, args_lr_mult):
@@ -439,6 +591,23 @@ class Updater:
         self.states = {}
 
     def __call__(self, index, grad, weight):
+        from .sparse import RowSparseNDArray, RowSparseState, from_dense
+
+        if isinstance(grad, RowSparseNDArray):
+            if index not in self.states:
+                self.states[index] = \
+                    self.optimizer.create_state_row_sparse(index, weight)
+            self.optimizer.update_row_sparse(index, weight, grad,
+                                             self.states[index])
+            return
+        if isinstance(self.states.get(index), RowSparseState):
+            # a key that trained row-sparse now sees a DENSE gradient (e.g.
+            # a sparse-resumed table fed by a dense producer): keep the
+            # key's lazy contract — its nonzero rows ARE its touched set —
+            # instead of crashing Optimizer.update on the foreign state
+            self.optimizer.update_row_sparse(index, weight, from_dense(grad),
+                                             self.states[index])
+            return
         if index not in self.states:
             self.states[index] = self.optimizer.create_state(index, weight)
         self.optimizer.update(index, weight, grad, self.states[index])

@@ -1,0 +1,374 @@
+"""Row-sparse storage kind: the recommender subsystem's foundation.
+
+The reference made sparse push/pull a first-class KVStore citizen because
+embedding-dominated recommenders are the canonical "millions of users"
+training workload (kvstore_dist.h's sparse PushImpl/PullRowSparse over
+ps-lite): an Embedding gradient only ever touches the rows the batch looked
+up, so shipping — or running the optimizer over — the other 99% of a
+(vocab, dim) table is pure waste. This package is that capability for the
+TPU-native port (docs/SPARSE.md):
+
+* ``RowSparseNDArray`` — the ``row_sparse`` storage kind: a sorted unique
+  ``indices`` vector plus the corresponding value ROWS of a logically-dense
+  ``(vocab, ...)`` array. ``to_dense``/``retain``/``from_dense`` convert;
+  ``__add__`` merges two row-sparse values (the KVStore local reduce).
+* ``embedding_backward`` — the segment-sum backward of the Embedding
+  lookup: grad rows accumulate per UNIQUE looked-up id
+  (``jax.ops.segment_sum``), emitting a row-sparse gradient directly —
+  never materializing the (vocab, dim) dense grad. This is the producer
+  the sparse KVStore round (``sparse/kvstore_sparse.py``) consumes.
+* ``RowSparseState`` — lazily-grown row-sparse optimizer state: a row that
+  was never touched has NO state row at all, which makes the lazy-update
+  contract (``optimizer.Optimizer.update_row_sparse``) auditable — an
+  untouched row's state is bit-identical to seed *by construction*.
+
+Telemetry: ``embedding.rows_touched`` counts unique rows entering
+``embedding_backward``/``from_dense`` (docs/OBSERVABILITY.md).
+
+Env knobs (docs/ENV_VARS.md): ``MXNET_KVSTORE_SPARSE`` gates the sparse
+wire path, ``MXNET_SPARSE_DENSE_FALLBACK_PCT`` the density threshold past
+which a round ships dense (the update stays row-lazy either way).
+"""
+from __future__ import annotations
+
+import logging
+import os
+
+import numpy as np
+
+from ..base import MXNetError
+from ..context import Context, current_context
+from .. import telemetry as _tm
+from ..ndarray import NDArray
+
+__all__ = ["RowSparseNDArray", "row_sparse_array", "from_dense",
+           "embedding_backward", "RowSparseState", "sparse_enabled",
+           "dense_fallback_pct", "sparse_param_names", "normalize_row_ids"]
+
+log = logging.getLogger("mxnet_tpu.sparse")
+
+DEFAULT_DENSE_FALLBACK_PCT = 50.0
+
+
+def sparse_enabled() -> bool:
+    """MXNET_KVSTORE_SPARSE (docs/ENV_VARS.md) — `0` disables the sparse
+    WIRE path (row-sparse pushes then ship dense buffers); the row-lazy
+    update semantics are not affected by this knob."""
+    return os.environ.get("MXNET_KVSTORE_SPARSE", "1").lower() not in (
+        "0", "off", "false")
+
+
+def dense_fallback_pct() -> float:
+    """MXNET_SPARSE_DENSE_FALLBACK_PCT — when a round's unique-row union
+    touches at least this percentage of the table, the round ships the
+    DENSE buffer instead (a near-dense union costs more as index+rows than
+    as the plain table: indices ride along and the allreduce loses its
+    fixed-shape executable). The optimizer update remains row-lazy — the
+    fallback changes wire strategy only, never semantics."""
+    raw = os.environ.get("MXNET_SPARSE_DENSE_FALLBACK_PCT", "")
+    try:
+        pct = float(raw) if raw else DEFAULT_DENSE_FALLBACK_PCT
+        if not (0.0 < pct <= 100.0):
+            raise ValueError(pct)
+    except ValueError:
+        log.warning("MXNET_SPARSE_DENSE_FALLBACK_PCT=%r is not in (0, 100]; "
+                    "using %g", raw, DEFAULT_DENSE_FALLBACK_PCT)
+        pct = DEFAULT_DENSE_FALLBACK_PCT
+    return pct
+
+
+def normalize_row_ids(rows) -> np.ndarray:
+    """Sorted unique int64 row ids from an NDArray or array-like — the one
+    boundary normalization every row-id consumer (``retain``,
+    ``from_dense``, ``KVStore.row_sparse_pull``) shares."""
+    return np.unique(np.asarray(
+        rows.asnumpy() if isinstance(rows, NDArray) else rows
+    ).astype(np.int64).reshape(-1))
+
+
+class RowSparseNDArray:
+    """The ``row_sparse`` storage kind (reference: RowSparseNDArray,
+    python/mxnet/ndarray/sparse.py / kRowSparseStorage in ndarray.h):
+    ``indices`` — sorted UNIQUE int32 row ids, shape (nnz,); ``values`` —
+    the corresponding rows, shape ``(nnz,) + shape[1:]``; ``shape`` — the
+    logical dense shape. A zero-nnz array is valid (the all-zero
+    gradient)."""
+
+    stype = "row_sparse"
+
+    def __init__(self, indices, values, shape, ctx: Context = None):
+        ctx = ctx or (values.context if isinstance(values, NDArray)
+                      else current_context())
+        idx = (indices.asnumpy() if isinstance(indices, NDArray)
+               else np.asarray(indices)).astype(np.int64).reshape(-1)
+        if idx.size and (np.any(idx[1:] <= idx[:-1])
+                         or idx[0] < 0 or idx[-1] >= shape[0]):
+            raise MXNetError(
+                "row_sparse indices must be sorted, unique and in "
+                "[0, %d); got %r..." % (shape[0], idx[:8].tolist()))
+        self.shape = tuple(int(s) for s in shape)
+        vals = values if isinstance(values, NDArray) else NDArray(values,
+                                                                  ctx=ctx)
+        if tuple(vals.shape) != (idx.size,) + self.shape[1:]:
+            raise MXNetError(
+                "row_sparse values shape %s does not match %d indices of "
+                "dense shape %s" % (tuple(vals.shape), idx.size, self.shape))
+        self.indices = NDArray(idx.astype(np.int32), ctx=ctx)
+        self.values = vals
+        self._ctx = ctx
+
+    # ------------------------------------------------------------ properties
+    @property
+    def nnz(self) -> int:
+        return int(self.indices.shape[0])
+
+    @property
+    def size(self) -> int:
+        """Stored element count (nnz rows × row size) — what actually moves,
+        which is what the kvstore byte telemetry should count."""
+        row = 1
+        for s in self.shape[1:]:
+            row *= int(s)
+        return self.nnz * row
+
+    @property
+    def dtype(self):
+        return self.values.dtype
+
+    @property
+    def context(self) -> Context:
+        return self._ctx
+
+    ctx = context
+
+    @property
+    def density(self) -> float:
+        return self.nnz / max(1, self.shape[0])
+
+    def __repr__(self):
+        return "<RowSparseNDArray %s nnz=%d @%s>" % (
+            "x".join(str(s) for s in self.shape), self.nnz, self.context)
+
+    # ----------------------------------------------------------- conversions
+    def to_dense(self) -> NDArray:
+        """Scatter the rows into a dense NDArray of ``self.shape``."""
+        import jax.numpy as jnp
+
+        dense = jnp.zeros(self.shape, dtype=self.dtype)
+        if self.nnz:
+            dense = dense.at[self.indices._jax()].set(self.values._jax())
+        return NDArray(dense, ctx=self.context)
+
+    def asnumpy(self) -> np.ndarray:
+        return self.to_dense().asnumpy()
+
+    def retain(self, row_ids) -> "RowSparseNDArray":
+        """Keep only the rows named in ``row_ids`` (reference:
+        sparse_retain) — rows absent from self come back as nothing, not
+        zeros, so ``retain`` composes with the lazy-state contract."""
+        want = normalize_row_ids(row_ids)
+        mine = self.indices.asnumpy().astype(np.int64)
+        keep = np.isin(mine, want)
+        if keep.all():
+            return self
+        pos = np.flatnonzero(keep)
+        vals = self.values._jax()[pos] if pos.size else \
+            np.zeros((0,) + self.shape[1:], self.dtype)
+        return RowSparseNDArray(mine[keep], NDArray(vals, ctx=self.context),
+                                self.shape, ctx=self.context)
+
+    def copy(self) -> "RowSparseNDArray":
+        return RowSparseNDArray(self.indices.asnumpy(), self.values.copy(),
+                                self.shape, ctx=self.context)
+
+    # ------------------------------------------------------------ arithmetic
+    def __add__(self, other) -> "RowSparseNDArray":
+        """Merge two row-sparse arrays (segment-sum on the index union) —
+        the KVStore local multi-device reduce for sparse gradients."""
+        if not isinstance(other, RowSparseNDArray):
+            raise TypeError("row_sparse + %s is not defined" % type(other))
+        if other.shape != self.shape:
+            raise MXNetError("shape mismatch %s vs %s"
+                             % (self.shape, other.shape))
+        import jax.numpy as jnp
+
+        a_idx = self.indices.asnumpy().astype(np.int64)
+        b_idx = other.indices.asnumpy().astype(np.int64)
+        union = np.union1d(a_idx, b_idx)
+        vals = jnp.zeros((union.size,) + self.shape[1:],
+                         dtype=np.promote_types(self.dtype, other.dtype))
+        if a_idx.size:
+            vals = vals.at[np.searchsorted(union, a_idx)].add(
+                self.values._jax())
+        if b_idx.size:
+            vals = vals.at[np.searchsorted(union, b_idx)].add(
+                other.values._jax())
+        return RowSparseNDArray(union, NDArray(vals, ctx=self.context),
+                                self.shape, ctx=self.context)
+
+    def __mul__(self, scalar) -> "RowSparseNDArray":
+        return RowSparseNDArray(self.indices.asnumpy(),
+                                self.values * float(scalar), self.shape,
+                                ctx=self.context)
+
+    __rmul__ = __mul__
+
+
+def row_sparse_array(data, shape, ctx=None) -> RowSparseNDArray:
+    """Construct from ``(values, indices)`` (reference:
+    mx.nd.sparse.row_sparse_array)."""
+    values, indices = data
+    return RowSparseNDArray(indices, values if isinstance(values, NDArray)
+                            else NDArray(np.asarray(values), ctx=ctx),
+                            shape, ctx=ctx)
+
+
+def from_dense(dense: NDArray, rows=None, shape=None) -> RowSparseNDArray:
+    """Dense → row_sparse. With ``rows`` (the batch's looked-up ids — what
+    the executor boundary knows for free) only those rows are gathered —
+    O(nnz), no full-table scan; without it, rows with any non-zero entry
+    are detected (O(size), the tolerant path)."""
+    shape = tuple(shape or dense.shape)
+    d = dense._jax().reshape(shape)
+    if rows is not None:
+        idx = normalize_row_ids(rows)
+    else:
+        flat = np.asarray(d.reshape(shape[0], -1))
+        idx = np.flatnonzero(np.any(flat != 0, axis=1)).astype(np.int64)
+    if _tm.enabled():
+        _tm.counter("embedding.rows_touched").inc(int(idx.size))
+    vals = d[idx] if idx.size else np.zeros((0,) + shape[1:], dense.dtype)
+    return RowSparseNDArray(idx, NDArray(vals, ctx=dense.context), shape,
+                            ctx=dense.context)
+
+
+def embedding_backward(data, ograd, input_dim) -> RowSparseNDArray:
+    """Row-sparse gradient of an Embedding lookup via segment-sum
+    (reference: the Embedding op's ``sparse_grad=True`` backward,
+    src/operator/tensor/indexing_op.cc EmbeddingOpBackward over
+    kRowSparseStorage).
+
+    ``data`` — the looked-up ids, any shape; ``ograd`` — the output
+    cotangent, shape ``data.shape + (dim,)``. Gradient rows accumulate per
+    unique id with ``jax.ops.segment_sum`` over compacted segment ids, so
+    the (vocab, dim) dense gradient is never materialized — the whole
+    computation is O(batch · dim + nnz · dim)."""
+    import jax
+    import jax.numpy as jnp
+
+    ids = np.asarray(data.asnumpy() if isinstance(data, NDArray)
+                     else data).astype(np.int64).reshape(-1)
+    g = (ograd._jax() if isinstance(ograd, NDArray)
+         else jnp.asarray(ograd))
+    dim = int(g.shape[-1])
+    g = g.reshape(-1, dim)
+    if g.shape[0] != ids.size:
+        raise MXNetError(
+            "embedding_backward: %d ids but %d gradient rows"
+            % (ids.size, g.shape[0]))
+    uniq, seg = np.unique(ids, return_inverse=True)
+    if uniq.size and (uniq[0] < 0 or uniq[-1] >= input_dim):
+        raise MXNetError("embedding_backward: id out of [0, %d)" % input_dim)
+    rows = jax.ops.segment_sum(g, jnp.asarray(seg, jnp.int32),
+                               num_segments=max(1, uniq.size))
+    if not uniq.size:
+        rows = rows[:0]
+    if _tm.enabled():
+        _tm.counter("embedding.rows_touched").inc(int(uniq.size))
+    ctx = ograd.context if isinstance(ograd, NDArray) else None
+    return RowSparseNDArray(uniq, NDArray(rows, ctx=ctx),
+                            (int(input_dim), dim), ctx=ctx)
+
+
+class RowSparseState:
+    """Lazily-grown row-sparse optimizer state for one parameter
+    (docs/SPARSE.md): ``indices`` — sorted unique rows that have EVER been
+    updated; ``rows`` — one ``(nnz, ...)`` host-backed value array per
+    optimizer state slot (SGD momentum: 1, Adam: 2). A row outside
+    ``indices`` has state bit-identical to a fresh Updater's zeros because
+    it literally has no storage — the auditable form of the lazy-update
+    contract ``optimizer.Optimizer.update_row_sparse`` enforces.
+
+    Pickles (``Updater.get_states``) and checkpoints (index+rows per
+    shard, ``checkpoint.sparse_shard_arrays``) as plain numpy."""
+
+    def __init__(self, shape, dtype, n_states):
+        self.shape = tuple(shape)
+        self.dtype = np.dtype(dtype)
+        self.n_states = int(n_states)
+        self.indices = np.zeros((0,), np.int64)
+        self.rows = [np.zeros((0,) + self.shape[1:], self.dtype)
+                     for _ in range(self.n_states)]
+
+    @property
+    def nnz(self) -> int:
+        return int(self.indices.size)
+
+    def gather(self, rows):
+        """Per-slot state rows for ``rows`` (sorted unique int64) — zeros
+        for rows never updated (what a fresh Updater would lazily create)."""
+        out = [np.zeros((rows.size,) + self.shape[1:], self.dtype)
+               for _ in range(self.n_states)]
+        if self.indices.size:
+            pos = np.searchsorted(self.indices, rows)
+            pos = np.clip(pos, 0, self.indices.size - 1)
+            hit = self.indices[pos] == rows
+            for i in range(self.n_states):
+                out[i][hit] = self.rows[i][pos[hit]]
+        return out
+
+    def scatter(self, rows, new_rows):
+        """Write back updated state rows, growing the touched set."""
+        if not rows.size:
+            return
+        union = np.union1d(self.indices, rows)
+        if union.size != self.indices.size:
+            grown = [np.zeros((union.size,) + self.shape[1:], self.dtype)
+                     for _ in range(self.n_states)]
+            if self.indices.size:
+                old_pos = np.searchsorted(union, self.indices)
+                for i in range(self.n_states):
+                    grown[i][old_pos] = self.rows[i]
+            self.indices, self.rows = union, grown
+        pos = np.searchsorted(self.indices, rows)
+        for i in range(self.n_states):
+            self.rows[i][pos] = np.asarray(new_rows[i], self.dtype)
+
+    def state_bytes(self) -> int:
+        return sum(r.nbytes for r in self.rows) + self.indices.nbytes
+
+    def __getstate__(self):
+        return {"shape": self.shape, "dtype": self.dtype.name,
+                "n_states": self.n_states, "indices": self.indices,
+                "rows": self.rows}
+
+    def __setstate__(self, d):
+        self.shape = tuple(d["shape"])
+        self.dtype = np.dtype(d["dtype"])
+        self.n_states = int(d["n_states"])
+        self.indices = np.asarray(d["indices"], np.int64)
+        self.rows = [np.asarray(r, self.dtype) for r in d["rows"]]
+
+    def __repr__(self):
+        return "<RowSparseState %s nnz=%d x%d slots>" % (
+            "x".join(str(s) for s in self.shape), self.nnz, self.n_states)
+
+
+def sparse_param_names(symbol):
+    """Names of parameters consumed as a sparse-grad embedding table: the
+    weight input of every ``SparseEmbedding`` node and of every
+    ``Embedding`` node carrying ``sparse_grad=True`` — what the Module/
+    kvstore glue uses to route those keys through the sparse path."""
+    names = []
+    for node in symbol._topo():
+        if node.is_variable:
+            continue
+        sparse = node.op == "SparseEmbedding"
+        if node.op == "Embedding":
+            flag = str(node.attrs.get("sparse_grad", "")).lower()
+            sparse = flag in ("1", "true")
+        if sparse and len(node.inputs) > 1:
+            w = node.inputs[1][0]
+            if w.is_variable:
+                names.append(w.name)
+    return names

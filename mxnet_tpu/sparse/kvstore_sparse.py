@@ -1,0 +1,219 @@
+"""Sparse push/pull rounds for the KVStore (docs/SPARSE.md).
+
+The bucketed engine (kvstore_bucket) owns DENSE gradients: a static plan,
+fixed offsets, one compiled collective per bucket. A row-sparse gradient is
+the opposite shape of problem — *which* rows move changes every round — so
+sparse keys bypass the bucket plan entirely and run through this engine,
+the TPU-native translation of the reference's ps-lite sparse push /
+PullRowSparse (kvstore_dist.h):
+
+1. **Index union** — every worker computes its local touched-row set (the
+   segment-sum backward's unique ids); the round's working set is the
+   allgather'd UNION across workers. The allgather ships counts first,
+   then sentinel-padded id vectors (host-side, 8 bytes/row — noise next to
+   the value rows it saves).
+2. **Padded-row collective** — the union's value rows scatter into a
+   ``(U_pad, row)`` buffer, ``U_pad`` = next power of two ≥ U: the
+   collective executable re-specializes per power-of-two bucket instead of
+   per round, bounding retraces at log2(vocab) while wasting < 2× wire on
+   padding (counted honestly — ``kvstore.bytes.sparse`` is the PADDED
+   wire formula, the same ``2·(W-1)/W·N`` accounting the dense path uses).
+3. **Lazy update** — the reduced rows apply through
+   ``optimizer.update_row_sparse``: only union rows pass through the flat
+   kernel, untouched rows keep bit-identical weight AND optimizer state.
+4. **Dense fallback** — when the union covers ≥
+   ``MXNET_SPARSE_DENSE_FALLBACK_PCT`` of the table (or
+   ``MXNET_KVSTORE_SPARSE=0``), the round ships the plain dense buffer
+   through the ordinary allreduce instead — near-dense unions cost more as
+   index+rows than as the table, and the fixed shape keeps one executable.
+   The update is STILL row-lazy: the dense wire result is re-sparsified
+   against the union before the optimizer sees it, so a fallback round can
+   never silently decay untouched Adam state (regression-tested).
+
+Telemetry (docs/OBSERVABILITY.md): ``kvstore.sparse_rows_pushed``,
+``kvstore.bytes.sparse``, ``kvstore.sparse_dense_fallbacks`` counters and
+``kvstore.sparse_push`` spans.
+"""
+from __future__ import annotations
+
+import logging
+from typing import Dict
+
+import numpy as np
+
+from ..base import MXNetError
+from .. import telemetry as _tm
+from ..ndarray import NDArray
+from . import (RowSparseNDArray, dense_fallback_pct, from_dense,
+               sparse_enabled)
+
+__all__ = ["SparseEngine"]
+
+log = logging.getLogger("mxnet_tpu.sparse")
+
+
+def _next_pow2(n: int) -> int:
+    return 1 << max(0, int(n - 1).bit_length())
+
+
+class SparseEngine:
+    """Per-KVStore engine for row-sparse keys. Stateless across rounds
+    except for telemetry and the per-key registration (shape/dtype checks);
+    optimizer state lives in the Updater's per-key ``RowSparseState``."""
+
+    def __init__(self, kv):
+        self._kv = kv
+        self._keys: Dict = {}  # key -> (shape, dtype str)
+
+    # ------------------------------------------------------------------ util
+    def _dist(self) -> bool:
+        if "dist" not in self._kv._type:
+            return False
+        import jax
+
+        return jax.process_count() > 1
+
+    def _coll(self):
+        from ..kvstore import _Collective
+
+        return _Collective.get()
+
+    def _register(self, key, rsp: RowSparseNDArray):
+        stored = self._kv._store[key]
+        if tuple(stored.shape) != tuple(rsp.shape):
+            raise MXNetError(
+                "sparse push of key %s: gradient dense shape %s does not "
+                "match the stored value %s"
+                % (key, tuple(rsp.shape), tuple(stored.shape)))
+        self._keys[key] = (tuple(rsp.shape), str(stored.dtype))
+
+    # ----------------------------------------------------------------- rounds
+    def push(self, key, rsp: RowSparseNDArray, priority=0):
+        """One key's locally-reduced row-sparse gradient: union the touched
+        rows across workers, reduce the rows, lazily update the store."""
+        if key not in self._keys:
+            self._register(key, rsp)
+        shape, dtype = self._keys[key]
+        vocab = shape[0]
+        local_idx = rsp.indices.asnumpy().astype(np.int64)
+        dist = self._dist()
+        if dist:
+            union = self._allgather_union(local_idx, vocab)
+        else:
+            union = local_idx
+        pct = 100.0 * union.size / max(1, vocab)
+        go_dense = (not sparse_enabled()) or pct >= dense_fallback_pct()
+        sp = _tm.NULL_SPAN
+        if _tm.enabled():
+            sp = _tm.span("kvstore.sparse_push", key=key,
+                          rows=int(union.size), vocab=vocab,
+                          density_pct=round(pct, 3), dense_wire=go_dense,
+                          priority=priority)
+        with sp:
+            if go_dense:
+                reduced = self._dense_wire_round(key, rsp, union, dtype)
+            else:
+                reduced = self._sparse_wire_round(key, rsp, union, local_idx,
+                                                  shape, dtype)
+            self._apply(key, reduced)
+
+    def _allgather_union(self, local_idx, vocab):
+        """Sorted unique union of every worker's touched rows. Two host
+        allgathers: fixed-shape counts, then max-count sentinel-padded id
+        vectors — every worker derives the identical union (SPMD)."""
+        from jax.experimental.multihost_utils import process_allgather
+
+        counts = np.asarray(process_allgather(
+            np.asarray([local_idx.size], np.int64))).reshape(-1)
+        cap = int(counts.max())
+        if cap == 0:
+            return np.zeros((0,), np.int64)
+        padded = np.full((cap,), -1, np.int64)
+        padded[:local_idx.size] = local_idx
+        allv = np.asarray(process_allgather(padded)).reshape(-1)
+        union = np.unique(allv[allv >= 0])
+        if union.size and (union[0] < 0 or union[-1] >= vocab):
+            raise MXNetError("sparse push: row id out of [0, %d)" % vocab)
+        return union
+
+    def _sparse_wire_round(self, key, rsp, union, local_idx, shape, dtype):
+        """Reduce only the union rows: scatter local rows into the padded
+        (U_pad, row) buffer, one allreduce, slice back."""
+        import jax.numpy as jnp
+
+        row_shape = shape[1:]
+        U = int(union.size)
+        U_pad = _next_pow2(U)
+        acc_dt = jnp.dtype(dtype)
+        buf = jnp.zeros((U_pad,) + tuple(row_shape), acc_dt)
+        if local_idx.size:
+            pos = np.searchsorted(union, local_idx)
+            buf = buf.at[pos].set(rsp.values._jax().astype(acc_dt))
+        if self._dist():
+            coll = self._coll()
+            W = coll.n_workers
+            itemsize = np.dtype(dtype).itemsize
+            row_elems = int(np.prod(row_shape)) if row_shape else 1
+            wire = int(2 * (W - 1) / W * U_pad * row_elems * itemsize)
+            out = coll.allreduce_rows(buf.reshape(1, -1), acc_dtype=dtype)
+            vals = out.addressable_data(0).reshape(
+                (U_pad,) + tuple(row_shape))[:U]
+            if _tm.enabled():
+                _tm.counter("kvstore.bytes.sparse").inc(wire)
+        else:
+            vals = buf[:U]
+        if _tm.enabled():
+            _tm.counter("kvstore.sparse_rows_pushed").inc(U)
+        stored = self._kv._store[key]
+        return RowSparseNDArray(union, NDArray(vals, ctx=stored.context),
+                                shape, ctx=stored.context)
+
+    def _dense_wire_round(self, key, rsp, union, dtype):
+        """Near-dense round: ship the plain dense buffer (fixed-shape
+        executable, ``kvstore.bytes.allreduce`` accounting), then
+        re-sparsify against the union so the UPDATE stays row-lazy."""
+        if _tm.enabled():
+            _tm.counter("kvstore.sparse_dense_fallbacks").inc()
+            _tm.counter("kvstore.sparse_rows_pushed").inc(int(union.size))
+        dense = rsp.to_dense()
+        if self._dist():
+            coll = self._coll()
+            W = coll.n_workers
+            wire = int(2 * (W - 1) / W * dense.size
+                       * np.dtype(dtype).itemsize)
+            out = coll.allreduce_concat([dense._jax().reshape(-1)])
+            dense = NDArray(out.reshape(dense.shape), ctx=dense.context)
+            if _tm.enabled():
+                _tm.counter("kvstore.bytes.allreduce").inc(wire)
+        return from_dense(dense, rows=union)
+
+    def _apply(self, key, reduced: RowSparseNDArray):
+        kv = self._kv
+        stored = kv._store[key]
+        if kv._updater is not None:
+            kv._updater(key, reduced, stored)
+            return
+        # no updater: sparse push REPLACES the touched rows (the dense
+        # path's replace semantics, restricted to the rows that moved)
+        rows = reduced.indices.asnumpy().astype(np.int64)
+        if rows.size:
+            stored._set_jax(
+                stored._jax().at[rows].set(
+                    reduced.values._jax().astype(stored.dtype)))
+
+    # ------------------------------------------------------------- checkpoint
+    def sparse_states(self):
+        """``{key: (shape, dtype, RowSparseState)}`` for every registered
+        sparse key whose Updater state is row-sparse — the checkpoint
+        writer's view (checkpoint.sparse_shard_arrays)."""
+        from . import RowSparseState
+
+        upd = self._kv._updater
+        out = {}
+        if upd is None:
+            return out
+        for key, (shape, dtype) in self._keys.items():
+            st = upd.states.get(key)
+            if isinstance(st, RowSparseState):
+                out[key] = (shape, dtype, st)
+        return out

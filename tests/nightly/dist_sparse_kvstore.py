@@ -1,0 +1,163 @@
+"""2-process sparse-vs-dense KVStore smoke: the recommender round
+(docs/SPARSE.md; tools/ci_check.sh runs this at -n 2).
+
+One tiny embedding+MLP click model trains twice through a dist KVStore with
+rank-DISJOINT batches (the index-union machinery must merge genuinely
+different touched sets):
+
+  * sparse arm — the embedding gradient pushes as a RowSparseNDArray: the
+    engine allgathers the unique-row union and allreduces only those rows
+    (``kvstore.bytes.sparse``);
+  * dense arm  — the same gradient pushes as the full (vocab, dim) buffer
+    through the bucketed allreduce (``kvstore.bytes.allreduce``), the
+    pre-sparse control.
+
+Gates, on every rank:
+  1. weight parity: after R rounds the two arms' weights match, atol 1e-6
+     (wire strategy must not change the math);
+  2. wire bytes: the sparse arm's ``kvstore.bytes.sparse`` is strictly less
+     than the dense control's table-attributable allreduce bytes.
+
+Rank 0 prints one ``DIST_SPARSE {json}`` line (bench.py's recommender leg
+reads it: embedding-bytes-moved + the sparse/dense wire ratio).
+
+    python tools/launch.py -n 2 --launcher local --cpu-devices 1 \
+        python tests/nightly/dist_sparse_kvstore.py
+"""
+import json
+import os
+import sys
+import time
+
+import numpy as np
+
+sys.path.insert(0, os.path.abspath(os.path.join(os.path.dirname(__file__),
+                                                "..", "..")))
+
+os.environ.setdefault("MXNET_TELEMETRY", "counters")
+
+import mxnet_tpu as mx  # noqa: E402
+from mxnet_tpu import telemetry  # noqa: E402
+from mxnet_tpu.sparse import from_dense  # noqa: E402
+
+V, D, B, ROUNDS = 2048, 32, 32, 6
+TRAINABLE = ("emb_weight", "fc_weight", "fc_bias", "click_weight",
+             "click_bias")
+
+
+def _net():
+    user = mx.sym.Variable("user")
+    emb = mx.sym.SparseEmbedding(data=user, input_dim=V, output_dim=D,
+                                 name="emb")
+    h = mx.sym.Activation(
+        mx.sym.FullyConnected(emb, num_hidden=16, name="fc"),
+        act_type="relu")
+    logit = mx.sym.FullyConnected(h, num_hidden=1, name="click")
+    return mx.sym.LogisticRegressionOutput(
+        data=logit, label=mx.sym.Variable("label"), name="out")
+
+
+def _batch(rnd, rank):
+    """Rank-disjoint ids: rank r draws from its own half of the vocab, so
+    the union is strictly larger than any local set."""
+    rs = np.random.RandomState(1000 * rnd + rank)
+    lo, hi = rank * (V // 8), (rank + 1) * (V // 8)
+    ids = rs.randint(lo, hi, (B,))
+    labels = rs.randint(0, 2, (B,))
+    return ids, labels
+
+
+def run_arm(sparse_wire, nworker):
+    kv = mx.kv.create("dist_tpu_sync")
+    opt = mx.optimizer.SGD(learning_rate=0.05,
+                           rescale_grad=1.0 / nworker)
+    kv.set_optimizer(opt)
+    ex = _net().simple_bind(mx.context.current_context(),
+                            user=(B,), label=(B,))
+    rs = np.random.RandomState(42)  # identical on every rank and arm
+    for name in TRAINABLE:
+        ex.arg_dict[name][:] = (rs.rand(*ex.arg_dict[name].shape)
+                                .astype("float32") - 0.5) * 0.1
+        kv.init(name, ex.arg_dict[name])
+        kv.pull(name, out=ex.arg_dict[name])
+    pre = dict(telemetry.counters())
+    t0 = time.perf_counter()
+    for rnd in range(ROUNDS):
+        ids, labels = _batch(rnd, kv.rank)
+        ex.arg_dict["user"][:] = ids.astype("float32")
+        ex.arg_dict["label"][:] = labels.astype("float32")
+        ex.forward(is_train=True)
+        ex.backward()
+        g_emb = ex.grad_dict["emb_weight"]
+        if sparse_wire:
+            kv.push("emb_weight", from_dense(g_emb, rows=ids))
+        else:
+            kv.push("emb_weight", g_emb)
+        for name in TRAINABLE[1:]:
+            kv.push(name, ex.grad_dict[name])
+        for name in TRAINABLE:
+            kv.pull(name, out=ex.arg_dict[name])
+    for name in TRAINABLE:
+        ex.arg_dict[name].wait_to_read()
+    elapsed = time.perf_counter() - t0
+    kv._barrier()
+    post = dict(telemetry.counters())
+    delta = {k: post.get(k, 0) - pre.get(k, 0)
+             for k in ("kvstore.bytes.sparse", "kvstore.bytes.allreduce",
+                       "kvstore.sparse_rows_pushed",
+                       "kvstore.sparse_dense_fallbacks",
+                       "embedding.rows_touched")}
+    weights = {name: ex.arg_dict[name].asnumpy() for name in TRAINABLE}
+    return weights, delta, elapsed
+
+
+def main():
+    kv_probe = mx.kv.create("dist_tpu_sync")
+    rank, nworker = kv_probe.rank, kv_probe.num_workers
+    assert nworker >= 2, "run under tools/launch.py -n 2"
+
+    w_sparse, d_sparse, t_sparse = run_arm(True, nworker)
+    w_dense, d_dense, t_dense = run_arm(False, nworker)
+
+    # ---- gate 1: weight parity (wire strategy must not change the math)
+    max_diff = 0.0
+    for name in TRAINABLE:
+        diff = float(np.abs(w_sparse[name] - w_dense[name]).max())
+        max_diff = max(max_diff, diff)
+        np.testing.assert_allclose(
+            w_sparse[name], w_dense[name], atol=1e-6,
+            err_msg="sparse/dense weight divergence in %s" % name)
+
+    # ---- gate 2: wire bytes. The dense control's table cost is its
+    # allreduce delta minus the sparse arm's (both arms push the SAME
+    # dense MLP params through the bucket path — that cost cancels).
+    sparse_bytes = d_sparse["kvstore.bytes.sparse"]
+    table_dense_bytes = (d_dense["kvstore.bytes.allreduce"]
+                         - d_sparse["kvstore.bytes.allreduce"])
+    assert sparse_bytes > 0, "sparse arm moved no sparse bytes"
+    assert d_sparse["kvstore.sparse_dense_fallbacks"] == 0, \
+        "sparse arm fell back to dense wire (union too dense for the test?)"
+    assert sparse_bytes < table_dense_bytes, \
+        "sparse wire (%d B) not below the dense control's table " \
+        "allreduce (%d B)" % (sparse_bytes, table_dense_bytes)
+
+    if rank == 0:
+        print("DIST_SPARSE " + json.dumps({
+            "workers": nworker, "vocab": V, "dim": D, "batch": B,
+            "rounds": ROUNDS,
+            "parity_max_abs_diff": max_diff,
+            "embedding_bytes_moved": int(sparse_bytes),
+            "dense_table_bytes": int(table_dense_bytes),
+            "sparse_vs_dense_wire_ratio": round(
+                sparse_bytes / max(1, table_dense_bytes), 4),
+            "rows_pushed": int(d_sparse["kvstore.sparse_rows_pushed"]),
+            "samples_per_s_sparse": round(nworker * B * ROUNDS / t_sparse, 1),
+            "samples_per_s_dense": round(nworker * B * ROUNDS / t_dense, 1),
+        }), flush=True)
+    print("dist_sparse_kvstore rank %d/%d: parity + wire-byte gates passed "
+          "(sparse %d B < dense %d B)"
+          % (rank, nworker, sparse_bytes, table_dense_bytes))
+
+
+if __name__ == "__main__":
+    main()

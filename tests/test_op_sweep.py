@@ -340,6 +340,13 @@ SPECS = {
                          input_dim=5, output_dim=3),
         {"i": np.array([1., 4., 0.], "f"), "w": _u(-1, 1, (5, 3), 15)},
         [_u(-1, 1, (5, 3), 15)[[1, 4, 0]]]), grad=False),
+    # same lookup; the row-sparse-gradient contract lives in the sparse
+    # subsystem (tests/test_sparse.py), the op itself is the plain gather
+    "SparseEmbedding": CUSTOM(lambda op: (
+        mx.sym.SparseEmbedding(mx.sym.Variable("i"), mx.sym.Variable("w"),
+                               input_dim=5, output_dim=3),
+        {"i": np.array([1., 4., 0.], "f"), "w": _u(-1, 1, (5, 3), 15)},
+        [_u(-1, 1, (5, 3), 15)[[1, 4, 0]]]), grad=False),
     # ---- linalg
     "dot": CUSTOM(lambda op: (
         mx.sym.dot(mx.sym.Variable("a"), mx.sym.Variable("b")),

@@ -1,0 +1,408 @@
+"""Row-sparse gradients + lazy updates: the recommender subsystem
+(docs/SPARSE.md).
+
+Single-process coverage of what the 2-process smoke
+(tests/nightly/dist_sparse_kvstore.py) exercises end to end: the
+``row_sparse`` storage kind and its conversions, the Embedding segment-sum
+backward, the lazy-update contract (untouched rows keep bit-identical
+weight AND optimizer state — including through a dense-wire fallback
+round), the KVStore sparse round on a local store, the
+``row_sparse_embedding`` shard-rule category + GL405 table hint, and the
+autoplan acceptance gate: a budget-armed 8-device search shards the
+recommender's embedding tables over the model axis.
+"""
+import os
+
+import numpy as np
+import pytest
+
+import mxnet_tpu as mx
+from mxnet_tpu import sparse
+from mxnet_tpu.base import MXNetError
+from mxnet_tpu.sparse import (RowSparseNDArray, RowSparseState,
+                              embedding_backward, from_dense,
+                              row_sparse_array, sparse_param_names)
+
+V, D = 20, 4
+
+
+def _rsp(rs, rows, scale=1.0):
+    rows = np.asarray(sorted(set(rows)), np.int64)
+    vals = (rs.rand(rows.size, D).astype("float32") - 0.5) * scale
+    return row_sparse_array((vals, rows), (V, D)), rows, vals
+
+
+# ------------------------------------------------------------ storage kind
+def test_roundtrip_to_dense_from_dense():
+    rs = np.random.RandomState(0)
+    r, rows, vals = _rsp(rs, [3, 7, 11])
+    dense = r.to_dense()
+    assert dense.shape == (V, D)
+    np.testing.assert_array_equal(dense.asnumpy()[rows], vals)
+    back = from_dense(dense)
+    np.testing.assert_array_equal(back.indices.asnumpy(), rows)
+    np.testing.assert_array_equal(back.values.asnumpy(), vals)
+
+
+def test_from_dense_with_row_hint_skips_scan():
+    """With the batch's ids supplied, rows outside the hint are dropped
+    even if dense happens to hold junk there — the O(nnz) boundary path."""
+    rs = np.random.RandomState(1)
+    dense = mx.nd.array(rs.rand(V, D).astype("float32"))
+    r = from_dense(dense, rows=[5, 2, 5])
+    assert r.indices.asnumpy().tolist() == [2, 5]
+    np.testing.assert_array_equal(r.values.asnumpy(),
+                                  dense.asnumpy()[[2, 5]])
+
+
+def test_retain():
+    rs = np.random.RandomState(2)
+    r, rows, vals = _rsp(rs, [1, 4, 9, 15])
+    kept = r.retain([4, 15, 19])
+    assert kept.indices.asnumpy().tolist() == [4, 15]
+    np.testing.assert_array_equal(kept.values.asnumpy(), vals[[1, 3]])
+
+
+def test_add_merges_index_union():
+    rs = np.random.RandomState(3)
+    a, arows, avals = _rsp(rs, [2, 6])
+    b, brows, bvals = _rsp(rs, [6, 13])
+    c = a + b
+    assert c.indices.asnumpy().tolist() == [2, 6, 13]
+    np.testing.assert_allclose(c.to_dense().asnumpy(),
+                               a.to_dense().asnumpy()
+                               + b.to_dense().asnumpy(), atol=1e-6)
+
+
+def test_invalid_indices_rejected():
+    with pytest.raises(MXNetError):
+        RowSparseNDArray([3, 1], np.zeros((2, D), "f"), (V, D))  # unsorted
+    with pytest.raises(MXNetError):
+        RowSparseNDArray([1, V], np.zeros((2, D), "f"), (V, D))  # range
+    with pytest.raises(MXNetError):
+        RowSparseNDArray([1], np.zeros((2, D), "f"), (V, D))  # shape
+
+
+def test_zero_nnz_valid():
+    r = row_sparse_array((np.zeros((0, D), "f"), np.zeros((0,), np.int64)),
+                         (V, D))
+    assert r.nnz == 0 and r.size == 0
+    assert not np.any(r.to_dense().asnumpy())
+
+
+# --------------------------------------------------- segment-sum backward
+def test_embedding_backward_matches_dense_reference():
+    rs = np.random.RandomState(4)
+    ids = rs.randint(0, V, (3, 5))  # repeated ids must accumulate
+    og = rs.rand(3, 5, D).astype("float32")
+    g = embedding_backward(ids, mx.nd.array(og), V)
+    ref = np.zeros((V, D), "float32")
+    for i, o in zip(ids.reshape(-1), og.reshape(-1, D)):
+        ref[i] += o
+    assert g.nnz == np.unique(ids).size
+    np.testing.assert_allclose(g.to_dense().asnumpy(), ref, atol=1e-5)
+
+
+def test_embedding_backward_matches_executor_grad():
+    """The segment-sum backward must equal the dense autodiff gradient the
+    executor computes for the same lookup."""
+    rs = np.random.RandomState(5)
+    data = mx.sym.Variable("data")
+    net = mx.sym.LinearRegressionOutput(
+        mx.sym.SparseEmbedding(data=data, input_dim=V, output_dim=D,
+                               name="emb"),
+        label=mx.sym.Variable("label"), name="out")
+    ex = net.simple_bind(mx.cpu(), data=(6,), label=(6, D))
+    ids = rs.randint(0, V, (6,))
+    ex.arg_dict["data"][:] = ids.astype("float32")
+    ex.arg_dict["emb_weight"][:] = rs.rand(V, D).astype("float32")
+    ex.arg_dict["label"][:] = rs.rand(6, D).astype("float32")
+    out = ex.forward(is_train=True)[0].asnumpy()
+    ex.backward()
+    dense_grad = ex.grad_dict["emb_weight"].asnumpy()
+    # the output-op backward is (out - label) / D
+    og = (out - ex.arg_dict["label"].asnumpy()) / D
+    g = embedding_backward(ids, mx.nd.array(og), V)
+    np.testing.assert_allclose(g.to_dense().asnumpy(), dense_grad, atol=1e-5)
+
+
+def test_sparse_embedding_forward_matches_embedding():
+    rs = np.random.RandomState(6)
+    w = rs.rand(V, D).astype("float32")
+    ids = rs.randint(0, V, (7,)).astype("float32")
+    a = mx.nd.Embedding(mx.nd.array(ids), mx.nd.array(w),
+                        input_dim=V, output_dim=D)
+    b = mx.nd.SparseEmbedding(mx.nd.array(ids), mx.nd.array(w),
+                              input_dim=V, output_dim=D)
+    np.testing.assert_array_equal(a.asnumpy(), b.asnumpy())
+
+
+# ------------------------------------------------------- lazy-update contract
+def _fit_rounds(opt, rounds, fallback_pct=None):
+    """Run sparse push rounds through a local kvstore; returns (w0, kv)."""
+    env = {}
+    if fallback_pct is not None:
+        env["MXNET_SPARSE_DENSE_FALLBACK_PCT"] = str(fallback_pct)
+    old = {k: os.environ.get(k) for k in env}
+    os.environ.update(env)
+    try:
+        rs = np.random.RandomState(7)
+        kv = mx.kv.create("local")
+        kv.set_optimizer(opt)
+        w0 = rs.rand(V, D).astype("float32")
+        kv.init("emb", mx.nd.array(w0))
+        for rows in rounds:
+            r, _, _ = _rsp(rs, rows)
+            kv.push("emb", r)
+        return w0, kv
+    finally:
+        for k, v in old.items():
+            if v is None:
+                os.environ.pop(k, None)
+            else:
+                os.environ[k] = v
+
+
+def test_lazy_sgd_momentum_parity_with_dense_on_touched_rows():
+    """Touched rows must match the dense momentum-SGD math exactly; rows
+    outside the round's set keep bit-identical weight."""
+    rs = np.random.RandomState(8)
+    opt = mx.optimizer.SGD(learning_rate=0.1, momentum=0.9, wd=1e-3)
+    kv = mx.kv.create("local")
+    kv.set_optimizer(opt)
+    w0 = rs.rand(V, D).astype("float32")
+    kv.init("emb", mx.nd.array(w0))
+    r, rows, vals = _rsp(rs, [0, 5, 19])
+    kv.push("emb", r)
+    out = mx.nd.zeros((V, D))
+    kv.pull("emb", out=out)
+    w1 = out.asnumpy()
+    # dense reference on the touched rows
+    mom = 0.9 * 0 - 0.1 * (vals + 1e-3 * w0[rows])
+    np.testing.assert_allclose(w1[rows], w0[rows] + mom, atol=1e-6)
+    unt = np.setdiff1d(np.arange(V), rows)
+    np.testing.assert_array_equal(w1[unt], w0[unt])
+
+
+def test_lazy_adam_untouched_state_bit_identical_to_seed():
+    """THE regression the lazy contract exists for: after rounds touching
+    different row sets, a row never touched must have optimizer state
+    bit-identical to seed — for the row-sparse state that means NO stored
+    row at all (a dense fallback would have decayed Adam's mean/var with
+    phantom zero-gradient steps)."""
+    opt = mx.optimizer.Adam(learning_rate=0.01)
+    _, kv = _fit_rounds(opt, [[1, 3], [3, 8], [1, 15]])
+    st = kv._updater.states["emb"]
+    assert isinstance(st, RowSparseState)
+    touched = {1, 3, 8, 15}
+    assert set(st.indices.tolist()) == touched
+    # update counts still tick per key per round (lr schedules match dense)
+    assert opt._index_update_count["emb"] == 3
+
+
+def test_dense_wire_fallback_preserves_lazy_state():
+    """Force every round through the dense-wire fallback
+    (MXNET_SPARSE_DENSE_FALLBACK_PCT at its floor): the WIRE strategy
+    changes, the update must stay row-lazy — untouched rows still have no
+    state row."""
+    opt = mx.optimizer.Adam(learning_rate=0.01)
+    _, kv = _fit_rounds(opt, [[2, 9], [9, 12]], fallback_pct=1e-6)
+    st = kv._updater.states["emb"]
+    assert isinstance(st, RowSparseState)
+    assert set(st.indices.tolist()) == {2, 9, 12}
+
+
+def test_sparse_vs_dense_fallback_same_weights():
+    """Wire strategy must not change the math: identical rounds through the
+    sparse wire and the forced dense fallback give identical weights."""
+    w_a, kv_a = _fit_rounds(mx.optimizer.Adam(learning_rate=0.01),
+                            [[1, 4], [4, 11]], fallback_pct=100.0)
+    w_b, kv_b = _fit_rounds(mx.optimizer.Adam(learning_rate=0.01),
+                            [[1, 4], [4, 11]], fallback_pct=1e-6)
+    a = mx.nd.zeros((V, D))
+    kv_a.pull("emb", out=a)
+    b = mx.nd.zeros((V, D))
+    kv_b.pull("emb", out=b)
+    np.testing.assert_array_equal(a.asnumpy(), b.asnumpy())
+
+
+def test_push_without_updater_replaces_touched_rows_only():
+    rs = np.random.RandomState(9)
+    kv = mx.kv.create("local")
+    w0 = rs.rand(V, D).astype("float32")
+    kv.init("emb", mx.nd.array(w0))
+    r, rows, vals = _rsp(rs, [6, 17])
+    kv.push("emb", r)
+    out = mx.nd.zeros((V, D))
+    kv.pull("emb", out=out)
+    got = out.asnumpy()
+    np.testing.assert_array_equal(got[rows], vals)
+    unt = np.setdiff1d(np.arange(V), rows)
+    np.testing.assert_array_equal(got[unt], w0[unt])
+
+
+def test_row_sparse_pull():
+    rs = np.random.RandomState(10)
+    kv = mx.kv.create("local")
+    w0 = rs.rand(V, D).astype("float32")
+    kv.init("emb", mx.nd.array(w0))
+    r = kv.row_sparse_pull("emb", [7, 2, 7])
+    assert r.indices.asnumpy().tolist() == [2, 7]
+    np.testing.assert_array_equal(r.values.asnumpy(), w0[[2, 7]])
+
+
+def test_optimizer_without_flat_spec_densifies_with_warning():
+    """Optimizers with no flat lowering stay correct (dense math), just not
+    lazy — and say so once."""
+    rs = np.random.RandomState(11)
+    kv = mx.kv.create("local")
+    kv.set_optimizer(mx.optimizer.RMSProp(learning_rate=0.01))
+    w0 = rs.rand(V, D).astype("float32")
+    kv.init("emb", mx.nd.array(w0))
+    r, rows, _ = _rsp(rs, [3])
+    kv.push("emb", r)
+    out = mx.nd.zeros((V, D))
+    kv.pull("emb", out=out)
+    assert not np.allclose(out.asnumpy()[rows], w0[rows])
+    assert not isinstance(kv._updater.states["emb"], RowSparseState)
+
+
+def test_flat_kernels_shared_with_bucket_engine():
+    """One expression tree for sharded, replicated and lazy-sparse: the
+    bucket engine's kernel table IS the optimizer module's."""
+    from mxnet_tpu import kvstore_bucket, optimizer
+
+    assert kvstore_bucket._FLAT_KERNELS is optimizer.FLAT_KERNELS
+
+
+# ------------------------------------------------- shard rules / lint / plan
+def test_shard_rule_category_registered():
+    from mxnet_tpu.ops.infer_meta import (EMBEDDING_RULES, SHARD_RULES,
+                                          get_meta)
+
+    assert "row_sparse_embedding" in SHARD_RULES
+    assert get_meta("SparseEmbedding").shard_rule == "row_sparse_embedding"
+    assert get_meta("SparseEmbedding").param_slots == ("weight",)
+    assert set(EMBEDDING_RULES) == {"embedding", "row_sparse_embedding"}
+
+
+def test_sparse_param_names():
+    net = mx.models.get_symbol("recommender")
+    assert sorted(sparse_param_names(net)) == ["item_embed_weight",
+                                               "user_embed_weight"]
+    # the Embedding sparse_grad=True spelling is recognized too
+    d = mx.sym.Variable("data")
+    e = mx.sym.Embedding(data=d, input_dim=V, output_dim=D,
+                         sparse_grad=True, name="emb")
+    assert sparse_param_names(e) == ["emb_weight"]
+    e2 = mx.sym.Embedding(data=d, input_dim=V, output_dim=D, name="emb2")
+    assert sparse_param_names(e2) == []
+
+
+def test_gl405_hint_names_embedding_table_pspec():
+    """Satellite: the GL405 fix hint for a replicated embedding table must
+    name the table's param_pspec placement, not the generic rank-2 advice."""
+    from jax.sharding import PartitionSpec as P
+
+    from mxnet_tpu import analysis
+    from mxnet_tpu.parallel import ShardingRules, parse_mesh_spec
+
+    mesh = parse_mesh_spec("dp=2,model=2")
+    rules = ShardingRules.infer_axes(mesh,
+                                     param_rule=lambda name, shape: P())
+    ids = mx.sym.Variable("ids")
+    net = mx.sym.SparseEmbedding(data=ids, input_dim=4096, output_dim=64,
+                                 name="table")
+    report = analysis.lint(net, shapes={"ids": (8,)}, types={"ids": "int32"},
+                           mesh=mesh, rules=rules)
+    gl405 = [d for d in report.diagnostics if d.code == "GL405"]
+    assert gl405, report.codes()
+    hint = gl405[0].fix_hint
+    assert "embedding table" in hint and "param_pspec" in hint
+    assert "table_weight" in hint and "row-sparse" in hint
+
+
+def test_autoplan_recommender_shards_embedding_over_model_axis():
+    """Acceptance gate: at 8 devices with the realistic constraint that
+    replicated tables blow the HBM budget, the planner's per-param search
+    lands a model-axis-sharded embedding spec and beats naive all-dp on
+    predicted comm."""
+    from mxnet_tpu.parallel import autoplan
+
+    net = mx.models.get_symbol("recommender")
+    shapes = {"user": (64,), "item": (64,), "dense": (64, 16),
+              "label": (64,)}
+    plan = autoplan.plan_parallel(net, shapes,
+                                  types={"user": "int32", "item": "int32"},
+                                  devices=8, budget_gb=0.0625,
+                                  label="recommender")
+    assert plan.feasible
+    assert plan.mesh.get("model", 1) > 1
+    sharded_tables = [n for n in ("user_embed_weight", "item_embed_weight")
+                      if any(plan.param_specs.get(n, []))]
+    assert sharded_tables, plan.param_specs
+    assert plan.predicted["comm_bytes"] < plan.naive["comm_bytes"]
+
+
+def test_module_fit_routes_sparse_grad_params(monkeypatch):
+    """The Module glue resolves sparse-grad params (sparse_param_names) and
+    routes their pushes through the KVStore sparse round: after a fit, the
+    embedding key's optimizer state is row-sparse and the sparse counters
+    ticked — no hand-rolled from_dense at the call site."""
+    from mxnet_tpu import telemetry
+
+    monkeypatch.setenv("MXNET_TELEMETRY", "counters")
+    rs = np.random.RandomState(12)
+    data = mx.sym.Variable("data")
+    emb = mx.sym.SparseEmbedding(data=data, input_dim=64, output_dim=8,
+                                 name="emb")
+    net = mx.sym.SoftmaxOutput(
+        mx.sym.FullyConnected(emb, num_hidden=4, name="fc"), name="softmax")
+    it = mx.io.NDArrayIter(
+        rs.randint(0, 64, (24,)).astype("float32"),
+        rs.randint(0, 4, (24,)).astype("float32"), batch_size=8)
+    kv = mx.kv.create("local")
+    pre = telemetry.counter("kvstore.sparse_rows_pushed").value
+    mod = mx.mod.Module(net, context=mx.cpu())
+    mod.fit(it, num_epoch=2, kvstore=kv, optimizer="sgd",
+            optimizer_params=(("learning_rate", 0.05),))
+    idx = next(i for i, n in enumerate(mod._param_names)
+               if n == "emb_weight")
+    assert isinstance(kv._updater.states.get(idx), RowSparseState)
+    assert telemetry.counter("kvstore.sparse_rows_pushed").value > pre
+
+
+def test_updater_dense_grad_on_sparse_state_stays_lazy():
+    """A key that trained row-sparse then receives a DENSE gradient (e.g. a
+    sparse-resumed table fed by a dense producer) must keep the lazy
+    contract — its nonzero rows are its touched set — not crash the dense
+    update on the foreign state type."""
+    rs = np.random.RandomState(13)
+    kv = mx.kv.create("local")
+    kv.set_optimizer(mx.optimizer.Adam(learning_rate=0.01))
+    w0 = rs.rand(V, D).astype("float32")
+    kv.init("emb", mx.nd.array(w0))
+    r, rows, _ = _rsp(rs, [2, 7])
+    kv.push("emb", r)
+    dense = np.zeros((V, D), "float32")
+    dense[[7, 11]] = rs.rand(2, D).astype("float32")
+    kv.push("emb", mx.nd.array(dense))  # dense grad, sparse state
+    st = kv._updater.states["emb"]
+    assert isinstance(st, RowSparseState)
+    assert set(st.indices.tolist()) == {2, 7, 11}
+    out = mx.nd.zeros((V, D))
+    kv.pull("emb", out=out)
+    unt = np.setdiff1d(np.arange(V), [2, 7, 11])
+    np.testing.assert_array_equal(out.asnumpy()[unt], w0[unt])
+
+
+def test_recommender_in_zoo_and_lints_clean():
+    from mxnet_tpu import analysis
+    from mxnet_tpu.analysis.cli import DEFAULT_SHAPES, DEFAULT_TYPES
+
+    assert "recommender" in DEFAULT_SHAPES and "dlrm" in DEFAULT_SHAPES
+    net = mx.models.get_symbol("dlrm")
+    report = analysis.lint(net, shapes=DEFAULT_SHAPES["recommender"],
+                           types=DEFAULT_TYPES["recommender"])
+    errors = [d for d in report.diagnostics if d.severity == "error"]
+    assert not errors, [d.format() for d in errors]

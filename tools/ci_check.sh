@@ -19,14 +19,21 @@
 # smokes it end to end). Step 5 runs the 8-process CPU kvstore smoke
 # (tests/nightly/dist_kvstore_overlap.py): bucket-plan overlap counters
 # during a Module.fit, sharded-vs-replicated weight parity, and the
-# bucketed allreduce bandwidth floor (docs/PERF.md §11). Step 6 runs the serving
-# engine smoke (tools/serve_bench.py --check): QPS/p99 under a tiny
-# open-loop load with zero post-warmup retraces, for both the bucketed
-# engine and the transformer KV-cache decode path (docs/SERVING.md), plus
-# the serving CHAOS smoke (--chaos): deterministic fault injection on the
-# dispatch path + a mid-run hitless weight reload, gated on zero hung
-# futures, zero retraces, and recovery to `healthy` (docs/RESILIENCE.md).
-# Step 7 runs the elastic fault-tolerance chaos smoke
+# bucketed allreduce bandwidth floor (docs/PERF.md §11).
+# Step 6 runs the 2-process recommender sparse-kvstore smoke
+# (tests/nightly/dist_sparse_kvstore.py, docs/SPARSE.md): a sparse-push fit
+# must be weight-parity (atol 1e-6) with a dense-push control while moving
+# strictly fewer wire bytes (kvstore.bytes.sparse < the control's
+# allreduce bytes), plus the budget-armed autoplan gate: the 8-device plan
+# for the recommender must shard an embedding table over the model axis.
+# Step 7 runs the serving engine smoke (tools/serve_bench.py --check):
+# QPS/p99 under a tiny open-loop load with zero post-warmup retraces, for
+# both the bucketed engine and the transformer KV-cache decode path
+# (docs/SERVING.md), plus the serving CHAOS smoke (--chaos): deterministic
+# fault injection on the dispatch path + a mid-run hitless weight reload,
+# gated on zero hung futures, zero retraces, and recovery to `healthy`
+# (docs/RESILIENCE.md).
+# Step 8 runs the elastic fault-tolerance chaos smoke
 # (tests/nightly/dist_elastic_chaos.py --orchestrate): an 8-process
 # Module.fit in sharded-update mode with periodic async checkpoints, one
 # worker killed mid-run — the survivors must re-form to 7, reseed from the
@@ -34,11 +41,11 @@
 # 7-process control run; it also asserts checkpoint.inflight was observed
 # > 0 mid-fit, i.e. the async write really overlapped the step
 # (docs/FAULT_TOLERANCE.md).
-# Step 8 is the repo's tier-1 pytest command (ROADMAP.md).
+# Step 9 is the repo's tier-1 pytest command (ROADMAP.md).
 set -uo pipefail
 cd "$(dirname "$0")/.."
 
-echo "== [1/8] graphlint: all bundled models (plain + sharding-plan sweep) =="
+echo "== [1/9] graphlint: all bundled models (plain + sharding-plan sweep) =="
 JAX_PLATFORMS=cpu python tools/graphlint --all-models --min-severity warning \
     || { echo "graphlint FAILED"; exit 1; }
 # the same zoo under an abstract dp=8,model=2 mesh: the GL4xx sharding-plan
@@ -99,7 +106,7 @@ print("autoplan sweep OK: %d models planned (%d pipelined); transformer "
 PYEOF
 rm -f "$AUTOPLAN_SWEEP"
 
-echo "== [2/8] source lint (ruff/pyflakes if available) =="
+echo "== [2/9] source lint (ruff/pyflakes if available) =="
 if command -v ruff >/dev/null 2>&1; then
     ruff check mxnet_tpu/ || { echo "ruff FAILED"; exit 1; }
 elif python -c 'import pyflakes' >/dev/null 2>&1; then
@@ -108,7 +115,7 @@ else
     echo "(neither ruff nor pyflakes installed; compile-check runs in pytest)"
 fi
 
-echo "== [3/8] fused conv+BN: interpret-mode autotune smoke + bwd parity subset =="
+echo "== [3/9] fused conv+BN: interpret-mode autotune smoke + bwd parity subset =="
 FUSED_TABLE="$(mktemp /tmp/fused_conv_bn_table_ci.XXXXXX.py)"
 JAX_PLATFORMS=cpu python tools/fused_stats_bench.py --interpret --emit-table \
     --table-out "$FUSED_TABLE" \
@@ -171,7 +178,7 @@ PYEOF
 done
 rm -rf "$TUNE_DIR"
 
-echo "== [4/8] telemetry: trace-on fit smoke + mxtrace schema gate =="
+echo "== [4/9] telemetry: trace-on fit smoke + mxtrace schema gate =="
 TRACE_DIR="$(mktemp -d /tmp/mxtrace_ci.XXXXXX)"
 JAX_PLATFORMS=cpu MXNET_DEFAULT_CONTEXT=cpu MXNET_TELEMETRY=trace \
 python - "$TRACE_DIR" <<'PYEOF' || { echo "telemetry fit smoke FAILED"; rm -rf "$TRACE_DIR"; exit 1; }
@@ -212,7 +219,7 @@ python tools/mxtrace "$TRACE_DIR/profile.json" --check \
     || { echo "mxtrace --check FAILED"; rm -rf "$TRACE_DIR"; exit 1; }
 rm -rf "$TRACE_DIR"
 
-echo "== [5/8] kvstore: 8-process bucket/overlap smoke (docs/PERF.md §11) =="
+echo "== [5/9] kvstore: 8-process bucket/overlap smoke (docs/PERF.md §11) =="
 # functional leg: overlap counters fire during Module.fit on the per-key
 # priority path, and sharded-update weights bit-match replicated (atol 1e-6)
 JAX_PLATFORMS=cpu MXNET_DEFAULT_CONTEXT=cpu \
@@ -233,7 +240,38 @@ JAX_PLATFORMS=cpu MXNET_DEFAULT_CONTEXT=cpu MXNET_KVSTORE_BUCKET_MB=16 \
     "${BW_CMD[@]}" || { echo "kvstore bandwidth smoke FAILED"; exit 1; }
 }
 
-echo "== [6/8] serving: serve_bench smoke (docs/SERVING.md) =="
+echo "== [6/9] sparse kvstore: 2-proc recommender smoke (docs/SPARSE.md) =="
+# sparse-push fit weight-parity with the dense-push control (atol 1e-6) AND
+# kvstore.bytes.sparse strictly below the control's table allreduce bytes;
+# both gates assert inside the script on every rank
+JAX_PLATFORMS=cpu MXNET_DEFAULT_CONTEXT=cpu \
+python tools/launch.py -n 2 --launcher local --cpu-devices 1 \
+    python tests/nightly/dist_sparse_kvstore.py \
+    || { echo "sparse kvstore smoke FAILED"; exit 1; }
+# budget-armed autoplan gate: with replicated tables over the HBM budget,
+# the 8-device per-param search must shard an embedding table over the
+# model axis and beat naive all-dp on predicted comm
+SPARSE_PLAN="$(mktemp /tmp/graphlint_recsys_ci.XXXXXX.json)"
+JAX_PLATFORMS=cpu python tools/graphlint --autoplan recommender \
+    --mesh-devices 8 --budget-gb 0.0625 --format json > "$SPARSE_PLAN" \
+    || { echo "recommender autoplan FAILED"; rm -f "$SPARSE_PLAN"; exit 1; }
+python - "$SPARSE_PLAN" <<'PYEOF' || { echo "recommender autoplan gate FAILED"; rm -f "$SPARSE_PLAN"; exit 1; }
+import json, sys
+plan = json.load(open(sys.argv[1]))[0]["autoplan"]
+assert plan["feasible"], plan.get("reason")
+assert plan["mesh"].get("model", 1) > 1, plan["mesh"]
+tables = [n for n in ("user_embed_weight", "item_embed_weight")
+          if any(plan["param_specs"].get(n, []))]
+assert tables, "no embedding table sharded: %s" % plan["param_specs"]
+chosen, naive = plan["predicted"]["comm_bytes"], plan["naive"]["comm_bytes"]
+assert chosen < naive, "recommender: %d B >= naive %d B" % (chosen, naive)
+print("recommender autoplan OK: mesh %s, sharded tables %s, comm %.2f KiB "
+      "vs naive %.2f MiB" % (plan["mesh"], tables, chosen / 2**10,
+                             naive / 2**20))
+PYEOF
+rm -f "$SPARSE_PLAN"
+
+echo "== [7/9] serving: serve_bench smoke (docs/SERVING.md) =="
 # tiny-model CPU serving smoke: sustained QPS > 0, finite p99, ZERO
 # post-warmup retraces/compiles (the sealed executable-cache contract,
 # gated via the GL201-203 guard + executor compile/cache-hit telemetry),
@@ -256,7 +294,7 @@ python tools/serve_bench.py --model mlp --chaos --qps 150 --duration 2 \
     --check \
     || { echo "serve_bench chaos smoke FAILED"; exit 1; }
 
-echo "== [7/8] elastic: 8-proc chaos smoke (docs/FAULT_TOLERANCE.md) =="
+echo "== [8/9] elastic: 8-proc chaos smoke (docs/FAULT_TOLERANCE.md) =="
 # kill 1 of 8 workers mid-fit: survivors pause, re-form to 7, reseed from
 # the sharded async checkpoint, resume — and must reach weight parity with
 # an uninterrupted 7-proc control run; checkpoint.inflight must have been
@@ -268,7 +306,7 @@ python tests/nightly/dist_elastic_chaos.py --orchestrate "$CHAOS_DIR" \
     || { echo "elastic chaos smoke FAILED"; rm -rf "$CHAOS_DIR"; exit 1; }
 rm -rf "$CHAOS_DIR"
 
-echo "== [8/8] tier-1 tests =="
+echo "== [9/9] tier-1 tests =="
 rm -f /tmp/_t1.log
 timeout -k 10 870 env JAX_PLATFORMS=cpu python -m pytest tests/ -q -m 'not slow' \
     --continue-on-collection-errors -p no:cacheprovider -p no:xdist -p no:randomly \
