@@ -733,10 +733,12 @@ def _bench_checkpoint():
 def _bench_serving():
     """Serving leg (docs/SERVING.md): QPS + p99 under a fixed open-loop
     load for lenet/mlp, continuous-batching-vs-batch-1 saturation speedup
-    on mlp, and the transformer KV-cache decode rate — the scoreboard's
-    serving trajectory next to the training numbers. Each model runs
-    tools/serve_bench.py in a fresh subprocess (its telemetry/counter
-    deltas must not bleed into this process)."""
+    on mlp, the transformer KV-cache decode rate, and the FLEET leg — a
+    4-replica router run under the seeded chaos plan (kill-one + mid-run
+    rollout) recording aggregate QPS / p99 / redispatches / restarts next
+    to its single-replica closed-loop baseline (docs/SERVING.md §Fleet).
+    Each leg runs tools/serve_bench.py in a fresh subprocess (its
+    telemetry/counter deltas must not bleed into this process)."""
     root = os.path.dirname(os.path.abspath(__file__))
     legs = {
         "mlp": ["--model", "mlp", "--qps", "120", "--duration", "2",
@@ -744,6 +746,8 @@ def _bench_serving():
         "lenet": ["--model", "lenet", "--qps", "40", "--duration", "2"],
         "transformer_decode": ["--model", "transformer-decode", "--qps",
                                "30", "--duration", "2", "--rows", "4"],
+        "fleet": ["--model", "mlp", "--fleet", "--fleet-replicas", "4",
+                  "--qps", "80", "--duration", "3"],
     }
     out = {}
     for name, extra in legs.items():
@@ -752,7 +756,7 @@ def _bench_serving():
                 [sys.executable, os.path.join(root, "tools",
                                               "serve_bench.py"),
                  "--json"] + extra,
-                capture_output=True, text=True, timeout=300,
+                capture_output=True, text=True, timeout=420,
                 cwd=root)
             rec = None
             for l in r.stdout.splitlines():
@@ -764,8 +768,14 @@ def _bench_serving():
                                       (r.stderr or r.stdout).strip()[-300:]))
             keep = {k: rec.get(k) for k in
                     ("qps", "p50_ms", "p99_ms", "batch_occupancy",
-                     "retraces_post_warmup", "batching_speedup")
+                     "retraces_post_warmup", "batching_speedup",
+                     "qps_single_replica_closed", "replicas",
+                     "redispatches", "replica_restarts", "paged_kv")
                     if rec.get(k) is not None}
+            if name == "fleet":
+                keep["resolved"] = rec.get("resolved")
+                keep["rollout_applied"] = bool(
+                    (rec.get("rollout") or {}).get("applied"))
             out[name] = keep
         except Exception as exc:
             out[name] = {"error": "%s: %s" % (type(exc).__name__, exc)}

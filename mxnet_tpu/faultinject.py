@@ -88,6 +88,15 @@ SITES = {
                        "allreduce/reduce-scatter passes through it",
     "io.prefetch": "PrefetchingIter._pump, before child.next() (a raise "
                    "surfaces to the consumer as the epoch's error)",
+    "fleet.dispatch": "Router dispatch worker, before the replica RPC "
+                      "(the re-dispatch-covered window; a raise exercises "
+                      "redispatch-to-another-replica)",
+    "fleet.health": "Router health poll, before the replica's health RPC "
+                    "(a raise/hang makes that replica's snapshot go stale "
+                    "— the router must stop dispatching on it)",
+    "fleet.replica_spawn": "ReplicaSupervisor._spawn, before the process "
+                           "launch (a raise fails the spawn; the capped "
+                           "restart backoff retries it)",
 }
 
 

@@ -197,7 +197,7 @@ def get_prefill_symbol(vocab_size=32000, num_layers=6, num_heads=8,
 
 def get_decode_symbol(vocab_size=32000, num_layers=6, num_heads=8,
                       model_dim=512, ffn_dim=2048, max_len=64, pos_len=None,
-                      **kwargs):
+                      per_stream_slots=False, **kwargs):
     """Serving single-token decode graph (docs/SERVING.md): ONE token per
     stream through the ``get_symbol`` stack, attending over a preallocated
     ring KV buffer of ``max_len`` slots per layer. Compiles ONCE — every
@@ -218,6 +218,18 @@ def get_decode_symbol(vocab_size=32000, num_layers=6, num_heads=8,
         buffers. The updated buffers are program OUTPUTS; the caller swaps
         them back in as the next step's inputs (KVCacheDecoder does).
 
+    ``per_stream_slots=True`` is the paged/multiplexed variant
+    (PagedKVDecoder): ``slot_onehot`` and ``kv_mask`` become (B, max_len)
+    so every batch lane carries its OWN write slot, its own valid-slot set
+    and its own position — one decode dispatch serves B *independent*
+    sequences at arbitrary, different positions. An all-zero onehot row
+    writes nothing (that lane's KV passes through unchanged), which is how
+    idle lanes ride along for free. Attention over slots stays
+    order-agnostic (positions live in the embeddings), so a lane's tokens
+    may occupy ANY physical slots — the property the paged allocator's
+    non-contiguous page placement relies on. The math per lane is
+    identical to the shared-slot graph at the same position.
+
     T=1 collapses attention to a masked weighted sum, so it is composed
     from broadcast primitives (scores = Σ_d q·k, softmax, Σ_s p·v) instead
     of the fused MultiHeadAttention op — same math, fp32-exact against the
@@ -232,9 +244,13 @@ def get_decode_symbol(vocab_size=32000, num_layers=6, num_heads=8,
     pos_idx = sym.Variable("pos_idx")
     oh = sym.Variable("slot_onehot")
     msk = sym.Variable("kv_mask")
-    oh4 = sym.Reshape(oh, shape=(1, 1, max_len, 1))
+    if per_stream_slots:
+        oh4 = sym.Reshape(oh, shape=(-1, 1, max_len, 1))
+        msk3 = sym.Reshape(msk, shape=(-1, 1, max_len))
+    else:
+        oh4 = sym.Reshape(oh, shape=(1, 1, max_len, 1))
+        msk3 = sym.Reshape(msk, shape=(1, 1, max_len))
     keep4 = 1.0 - oh4
-    msk3 = sym.Reshape(msk, shape=(1, 1, max_len))
     emb = sym.Embedding(data=data, input_dim=vocab_size,
                         output_dim=model_dim, name="embed")
     posrow = sym.Embedding(data=pos_idx, input_dim=pos_len,

@@ -27,8 +27,10 @@ from __future__ import annotations
 from .cache import PersistentExecutableCache
 from .engine import (InferenceEngine, ServeFuture, ServeDeadlineError,
                      ServeOverloadError, ServeClosedError)
-from .kv_decode import KVCacheDecoder
+from .kv_decode import KVCacheDecoder, PagedKVDecoder, PagedKVExhausted
+from . import fleet
 
 __all__ = ["PersistentExecutableCache", "InferenceEngine", "ServeFuture",
            "ServeDeadlineError", "ServeOverloadError", "ServeClosedError",
-           "KVCacheDecoder"]
+           "KVCacheDecoder", "PagedKVDecoder", "PagedKVExhausted",
+           "fleet"]

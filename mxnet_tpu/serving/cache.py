@@ -395,6 +395,29 @@ class PersistentExecutableCache:
                 for b in rec.get("buckets", [])]
 
     # ------------------------------------------------------------ hot swap
+    def snapshot_params(self, arg_names=None, aux_names=None):
+        """Host-side copies of the named (default: all) loaded arg/aux
+        params, consistent under the swap lock — the pre-swap snapshot a
+        rollback restores (the fleet replica's ``reload`` takes one
+        before applying, so a fleet rollout abort can put the old
+        weights back). Unknown names are skipped: ``swap_params`` would
+        have refused them before writing anything, so they cannot need
+        restoring. Returns ``(arg_params, aux_params)``."""
+
+        def _host(v):
+            return np.array(getattr(v, "asnumpy", lambda: v)())
+
+        with self._lock:
+            args = {n: _host(self._arg_params[n])
+                    for n in (self._arg_params if arg_names is None
+                              else arg_names)
+                    if n in self._arg_params}
+            aux = {n: _host(self._aux_params[n])
+                   for n in (self._aux_params if aux_names is None
+                             else aux_names)
+                   if n in self._aux_params}
+        return args, aux
+
     @staticmethod
     def _swap_value(name, value, target, what):
         """Validate ONE incoming swap value against its target buffer:

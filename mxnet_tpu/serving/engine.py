@@ -93,6 +93,10 @@ class ServeDeadlineError(MXNetError):
         super().__init__(msg)
         self.queued_ms = queued_ms
 
+    def __reduce__(self):  # pickle-safe across the fleet RPC boundary
+        return (type(self), (self.args[0] if self.args else "",
+                             self.queued_ms))
+
 
 class ServeOverloadError(MXNetError):
     """Shed at admission: the engine's queue-wait estimate says this
@@ -102,6 +106,10 @@ class ServeOverloadError(MXNetError):
     def __init__(self, msg, retry_after_ms):
         super().__init__(msg)
         self.retry_after_ms = retry_after_ms
+
+    def __reduce__(self):  # pickle-safe across the fleet RPC boundary
+        return (type(self), (self.args[0] if self.args else "",
+                             self.retry_after_ms))
 
 
 class ServeClosedError(MXNetError):
@@ -261,6 +269,7 @@ class InferenceEngine:
         self._reloads = 0
         self._shed_count = 0
         self._submit_count = 0
+        self._health_seq = 0  # monotonic snapshot counter; see health()
 
     @staticmethod
     def _parse_shed(raw):
@@ -809,6 +818,12 @@ class InferenceEngine:
         * ``shed_rate`` — sheds / offered over the engine's lifetime, and
           ``recent_sheds`` / ``recent_dispatch_errors`` over the window
         * ``reloads`` — applied hot swaps
+        * ``seq`` / ``snapshot_ms`` — a per-engine monotonic snapshot
+          counter and the wall-clock stamp of THIS snapshot. A consumer
+          that caches snapshots (the fleet router does) can tell a fresh
+          report from a dead replica's last-good numbers: a repeated
+          ``seq`` or an old ``snapshot_ms`` means nobody is answering —
+          dispatching on those numbers would send traffic to a corpse.
         """
         now = time.perf_counter()
         with self._cond:
@@ -819,6 +834,8 @@ class InferenceEngine:
             recent = self._recent_faults_snapshot(now)
             sheds, submits = self._shed_count, self._submit_count
             reloads = self._reloads
+            self._health_seq += 1
+            seq = self._health_seq
         alive = self._thread is not None and self._thread.is_alive()
         if fatal is not None:
             state = "latched"
@@ -830,6 +847,8 @@ class InferenceEngine:
             state = "healthy"
         return {
             "state": state,
+            "seq": seq,
+            "snapshot_ms": time.time() * 1000.0,
             "queue_depth": depth,
             "batcher_alive": alive,
             "ewma_queue_wait_ms": None if est is None

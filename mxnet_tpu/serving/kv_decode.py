@@ -27,7 +27,7 @@ from ..base import MXNetError
 from .. import telemetry as _tm
 from .cache import PersistentExecutableCache
 
-__all__ = ["KVCacheDecoder"]
+__all__ = ["KVCacheDecoder", "PagedKVDecoder", "PagedKVExhausted"]
 
 _NEG = np.float32(-1e9)
 
@@ -201,3 +201,348 @@ class KVCacheDecoder:
             if t + 1 < n_tokens:
                 logits = self.decode_step(nxt)
         return out
+
+
+# --------------------------------------------------------------- paged decode
+class PagedKVExhausted(MXNetError):
+    """The paged KV pool cannot satisfy an allocation: no free lane for a
+    new sequence, or no free page for a growing one. Retire a sequence (or
+    size the pool larger) and retry — this is admission backpressure, not
+    corruption."""
+
+
+class _PagePool:
+    """Block allocator over each lane's slot axis (docs/SERVING.md).
+
+    A lane's ``max_len`` KV slots are carved into ``slots // page_size``
+    fixed-size page frames. Frames are handed out from a per-lane LIFO
+    free list — a re-admitted sequence deliberately gets the most recently
+    freed frames first, so physical placement is routinely NON-contiguous
+    (the attention math is slot-order-agnostic; the in-graph write goes to
+    whatever slot the host-side onehot names). A global ``budget`` below
+    the physical frame count models admission control against a smaller
+    HBM reservation: acquisitions past it raise ``PagedKVExhausted`` even
+    when the lane itself has free frames."""
+
+    def __init__(self, lanes, slots, page_size, budget=None):
+        if slots % page_size:
+            raise MXNetError("paged_kv: page_size %d must divide the %d "
+                             "slots per lane" % (page_size, slots))
+        self.lanes = int(lanes)
+        self.page_size = int(page_size)
+        self.frames_per_lane = slots // page_size
+        self.budget = int(budget) if budget else self.lanes * \
+            self.frames_per_lane
+        self._free = [list(range(self.frames_per_lane))
+                      for _ in range(self.lanes)]
+        self.in_use = 0
+
+    def acquire(self, lane):
+        """One frame index within ``lane``'s slot axis, or raise."""
+        if self.in_use >= self.budget:
+            raise PagedKVExhausted(
+                "paged_kv: page budget exhausted (%d/%d frames in use); "
+                "retire a sequence and retry" % (self.in_use, self.budget))
+        free = self._free[lane]
+        if not free:
+            raise PagedKVExhausted(
+                "paged_kv: lane %d has no free page frame (%d slots / %d "
+                "per page all allocated) — the sequence outgrew its lane"
+                % (lane, self.frames_per_lane * self.page_size,
+                   self.page_size))
+        self.in_use += 1
+        return free.pop()
+
+    def release(self, lane, frames):
+        self._free[lane].extend(frames)
+        self.in_use -= len(frames)
+
+
+class _Lane:
+    __slots__ = ("seq_id", "pos", "frames", "valid_slots")
+
+    def __init__(self, seq_id):
+        self.seq_id = seq_id
+        self.pos = 0            # next position to be written
+        self.frames = []        # logical page -> physical frame index
+        self.valid_slots = []   # physical slots holding real context
+
+
+class PagedKVDecoder:
+    """Multiplexed KV-cache decode: ONE decode batch serves many
+    concurrent, independently-positioned sequences (docs/SERVING.md).
+
+    ``KVCacheDecoder`` is per-request-shaped — all B streams march in
+    lockstep from one prefill. This decoder instead treats the decode
+    executable's batch rows as ``lanes``: sequences are admitted one at a
+    time (a batch-1 prefill seeds that lane's slots), advance at their own
+    positions, and retire independently — the continuous-batching idea
+    applied to autoregressive decode. Slot storage is paged: each lane's
+    ring is carved into ``page_size``-slot frames allocated on demand from
+    a ``_PagePool`` (and freed at retire), so short sequences don't
+    reserve ``max_len`` slots of KV for their whole life and admission
+    fails with a structured ``PagedKVExhausted`` instead of an OOM.
+
+    Per-lane math is identical to a batch-1 ``KVCacheDecoder`` at the same
+    position (the per-stream decode graph differs only in carrying one
+    slot_onehot/kv_mask row per lane), so multiplexed decode is
+    token-identical to sequential per-request decode — the acceptance
+    test pins exactly that.
+    """
+
+    def __init__(self, arg_params: Dict[str, object], vocab_size,
+                 num_layers=2, num_heads=2, model_dim=32, ffn_dim=64,
+                 max_len=64, page_size=8, lanes=4, page_budget=None,
+                 prefill_len: Optional[int] = None,
+                 pos_len: Optional[int] = None, ctx=None,
+                 dtype="float32", cache_dir=None, model_key=None):
+        from ..models import transformer as _tf
+
+        self.vocab_size = int(vocab_size)
+        self.num_layers = int(num_layers)
+        self.num_heads = int(num_heads)
+        self.model_dim = int(model_dim)
+        self.max_len = int(max_len)
+        self.lanes = int(lanes)
+        self.prefill_len = int(prefill_len or max_len)
+        self.pos_len = int(pos_len or max_len)
+        self.dh = self.model_dim // self.num_heads
+        if self.prefill_len > self.max_len:
+            raise MXNetError("paged_kv: prefill_len %d > max_len %d"
+                             % (self.prefill_len, self.max_len))
+        self.pool = _PagePool(self.lanes, self.max_len, page_size,
+                              budget=page_budget)
+        self.page_size = self.pool.page_size
+        cfg = dict(vocab_size=self.vocab_size, num_layers=self.num_layers,
+                   num_heads=self.num_heads, model_dim=self.model_dim,
+                   ffn_dim=int(ffn_dim), pos_len=self.pos_len)
+        key = model_key or "transformer_paged_decode"
+        self._pf_cache = PersistentExecutableCache(
+            _tf.get_prefill_symbol(prefill_len=self.prefill_len, **cfg),
+            arg_params, {}, ctx=ctx, dtype=dtype, cache_dir=cache_dir,
+            model_key=key + "-prefill")
+        self._dec_cache = PersistentExecutableCache(
+            _tf.get_decode_symbol(max_len=self.max_len,
+                                  per_stream_slots=True, **cfg),
+            arg_params, {}, ctx=ctx, dtype=dtype, cache_dir=cache_dir,
+            model_key=key + "-decode")
+        self._dec_exe = None
+        self._lanes: Dict[int, _Lane] = {}   # lane index -> _Lane
+        self._seq_lane: Dict[int, int] = {}  # seq_id -> lane index
+        self._next_seq = 0
+        self._warm = False
+
+    # ------------------------------------------------------------ lifecycle
+    def _decode_shapes(self):
+        B, S, H, dh = self.lanes, self.max_len, self.num_heads, self.dh
+        shapes = {"data": (B, 1), "pos_idx": (B, 1),
+                  "slot_onehot": (B, S), "kv_mask": (B, S)}
+        for i in range(self.num_layers):
+            shapes["kv_k_%d" % i] = (B, H, S, dh)
+            shapes["kv_v_%d" % i] = (B, H, S, dh)
+        return shapes
+
+    def warmup(self):
+        """Compile the batch-1 prefill and the multiplexed decode
+        executable; seal both caches (two programs total, any number of
+        concurrent sequences)."""
+        if self._warm:
+            return self
+        self._pf_cache.warmup([{"data": (1, self.prefill_len)}])
+        self._dec_cache.warmup([self._decode_shapes()])
+        self._dec_exe = self._dec_cache.executable(self._decode_shapes())
+        self._warm = True
+        return self
+
+    def stats(self):
+        return {"lanes": self.lanes,
+                "active": len(self._lanes),
+                "pages_in_use": self.pool.in_use,
+                "page_budget": self.pool.budget,
+                "page_size": self.page_size}
+
+    # ------------------------------------------------------------ admission
+    def _phys_slot(self, lane: _Lane, pos):
+        """Physical slot of logical position ``pos``, acquiring a new page
+        frame when the position crosses into an unallocated page."""
+        page, off = divmod(pos, self.page_size)
+        while len(lane.frames) <= page:
+            lane.frames.append(
+                self.pool.acquire(self._seq_lane[lane.seq_id]))
+        return lane.frames[page] * self.page_size + off
+
+    def admit(self, prompt):
+        """Admit one sequence: a batch-1 prefill seeds its lane's pages.
+        ``prompt`` is a (L,) or (1, L) token array, 0 < L <= prefill_len.
+        Returns ``(seq_id, logits)`` with logits the (vocab,) distribution
+        for the sequence's next token. Raises ``PagedKVExhausted`` when no
+        lane or not enough page frames are free."""
+        self.warmup()
+        prompt = np.asarray(prompt, dtype=np.float32).reshape(1, -1)
+        L = prompt.shape[1]
+        if not 0 < L <= self.prefill_len:
+            raise MXNetError("paged_kv: prompt length %d not in (0, %d]"
+                             % (L, self.prefill_len))
+        free_lanes = [i for i in range(self.lanes) if i not in self._lanes]
+        if not free_lanes:
+            raise PagedKVExhausted(
+                "paged_kv: all %d lanes occupied; retire a sequence first"
+                % self.lanes)
+        idx = free_lanes[0]
+        seq_id = self._next_seq
+        self._next_seq += 1
+        lane = _Lane(seq_id)
+        self._lanes[idx] = lane
+        self._seq_lane[seq_id] = idx
+        try:
+            phys = [self._phys_slot(lane, p) for p in range(L)]
+            padded = np.zeros((1, self.prefill_len), np.float32)
+            padded[:, :L] = prompt
+            with _tm.span("serving.paged_admit", seq=seq_id, prompt_len=L,
+                          lane=idx):
+                pf = self._pf_cache.executable(
+                    {"data": (1, self.prefill_len)})
+                pf.arg_dict["data"][:] = padded
+                pf.forward(is_train=False)
+                logits = np.asarray(
+                    pf.outputs[0]._jax().reshape(
+                        1, self.prefill_len, self.vocab_size)[0, L - 1, :])
+                # scatter the prompt's K/V into THIS lane's physical
+                # slots — device-side; only the last position's logits
+                # crossed above
+                phys_idx = np.asarray(phys)
+                exe = self._dec_exe
+                for i in range(self.num_layers):
+                    for tag, out in (("kv_k_%d" % i,
+                                      pf.outputs[1 + 2 * i]),
+                                     ("kv_v_%d" % i,
+                                      pf.outputs[2 + 2 * i])):
+                        ring = exe.arg_dict[tag]._jax()
+                        row = ring[idx].at[:, phys_idx, :].set(
+                            out._jax()[0, :, :L, :])
+                        exe.arg_dict[tag]._set_jax(ring.at[idx].set(row))
+        except BaseException:
+            # ANY admit failure (pool exhaustion, a prefill/scatter
+            # error) must release the lane and its frames — the caller
+            # has no seq_id to retire, so a leak here would bleed the
+            # pool dry one failed admit at a time
+            self._evict(idx)
+            raise
+        lane.pos = L
+        lane.valid_slots = phys
+        if _tm.enabled():
+            _tm.counter("serving.paged_admits").inc()
+            _tm.counter("serving.prefill_tokens").inc(L)
+            _tm.gauge("serving.paged_pages_in_use").set(self.pool.in_use)
+        return seq_id, logits
+
+    def _evict(self, idx):
+        lane = self._lanes.pop(idx)
+        self._seq_lane.pop(lane.seq_id, None)
+        self.pool.release(idx, lane.frames)
+
+    def retire(self, seq_id):
+        """Free a finished sequence's lane and page frames (the slots are
+        masked out for every other lane already; no zeroing needed)."""
+        idx = self._seq_lane.get(seq_id)
+        if idx is None:
+            raise MXNetError("paged_kv: unknown seq_id %r" % (seq_id,))
+        self._evict(idx)
+        if _tm.enabled():
+            _tm.counter("serving.paged_retires").inc()
+            _tm.gauge("serving.paged_pages_in_use").set(self.pool.in_use)
+
+    @property
+    def active(self):
+        return sorted(self._seq_lane)
+
+    def position(self, seq_id):
+        return self._lanes[self._seq_lane[seq_id]].pos
+
+    # --------------------------------------------------------------- decode
+    def step(self, tokens: Dict[int, object]):
+        """One multiplexed decode dispatch: ``tokens`` maps seq_id -> next
+        token id for any subset of active sequences; every stepped
+        sequence advances at ITS OWN position in the one batch. Returns
+        {seq_id: (vocab,) logits}. Lanes not stepped (or unoccupied) ride
+        along with an all-zero write-onehot — their KV is untouched and
+        their logits discarded."""
+        self.warmup()
+        if not tokens:
+            return {}
+        B, S = self.lanes, self.max_len
+        data = np.zeros((B, 1), np.float32)
+        pos_idx = np.zeros((B, 1), np.float32)
+        oh = np.zeros((B, S), np.float32)
+        mask = np.full((B, S), _NEG, np.float32)
+        stepped = []
+        for seq_id, tok in tokens.items():
+            idx = self._seq_lane.get(seq_id)
+            if idx is None:
+                raise MXNetError("paged_kv: unknown seq_id %r" % (seq_id,))
+            lane = self._lanes[idx]
+            if lane.pos >= self.pos_len:
+                raise MXNetError(
+                    "paged_kv: seq %d at position %d exceeds the trained "
+                    "position table (%d rows)"
+                    % (seq_id, lane.pos, self.pos_len))
+            phys = self._phys_slot(lane, lane.pos)
+            data[idx, 0] = float(np.asarray(tok).reshape(()))
+            pos_idx[idx, 0] = lane.pos
+            oh[idx, phys] = 1.0
+            mask[idx, lane.valid_slots] = 0.0
+            mask[idx, phys] = 0.0
+            stepped.append((seq_id, idx, lane, phys))
+        exe = self._dec_exe
+        exe.arg_dict["data"][:] = data
+        exe.arg_dict["pos_idx"][:] = pos_idx
+        exe.arg_dict["slot_onehot"][:] = oh
+        exe.arg_dict["kv_mask"][:] = mask
+        with _tm.span("serving.decode_step", rows=len(stepped),
+                      paged=True):
+            exe.forward(is_train=False)
+            logits = exe.outputs[0].asnumpy()
+        for i in range(self.num_layers):
+            exe.arg_dict["kv_k_%d" % i]._set_jax(
+                exe.outputs[1 + 2 * i]._jax())
+            exe.arg_dict["kv_v_%d" % i]._set_jax(
+                exe.outputs[2 + 2 * i]._jax())
+        out = {}
+        for seq_id, idx, lane, phys in stepped:
+            lane.valid_slots.append(phys)
+            lane.pos += 1
+            out[seq_id] = logits[idx]
+        if _tm.enabled():
+            _tm.counter("serving.decode_tokens").inc(len(stepped))
+            _tm.counter("serving.paged_steps").inc()
+            _tm.gauge("serving.paged_pages_in_use").set(self.pool.in_use)
+        return out
+
+    def greedy(self, prompts, n_tokens):
+        """Greedy-decode ``n_tokens`` continuations for several prompts AT
+        ONCE through the multiplexed batch (admitted together, stepped
+        together — one dispatch per token across all of them). ``prompts``
+        is a list of (L_i,) token arrays (lengths may differ). Returns a
+        list of (n_tokens,) int64 arrays. Convenience for tests/bench."""
+        seqs = []
+        logits = {}
+        try:
+            for p in prompts:
+                sid, lg = self.admit(p)
+                seqs.append(sid)
+                logits[sid] = lg
+            out = {sid: np.zeros((n_tokens,), np.int64) for sid in seqs}
+            for t in range(n_tokens):
+                nxt = {sid: int(np.argmax(logits[sid])) for sid in seqs}
+                for sid in seqs:
+                    out[sid][t] = nxt[sid]
+                if t + 1 < n_tokens:
+                    logits = self.step(nxt)
+            return [out[sid] for sid in seqs]
+        finally:
+            # retire on EVERY exit: a partial admit/step failure must not
+            # strand the already-admitted lanes (the caller has no
+            # seq_ids to clean up)
+            for sid in seqs:
+                if sid in self._seq_lane:
+                    self.retire(sid)

@@ -1,0 +1,594 @@
+"""Distributed serving fleet (mxnet_tpu/serving/fleet/, docs/SERVING.md
+§Fleet): router dispatch policy against in-process fake replicas
+(load-aware pick, degraded/latched skip, stale-snapshot discard,
+fleet-saturated shed, dead-replica re-dispatch with zero lost requests,
+rollout drain + abort-on-bad-swap), supervisor spawn/restart/heartbeat
+machinery against a lightweight stand-in worker, the RPC framing layer,
+the fleet.* fault-injection sites, and the health() seq/snapshot_ms
+staleness satellite."""
+import sys
+import threading
+import time
+
+import numpy as np
+import pytest
+
+from mxnet_tpu.base import MXNetError
+from mxnet_tpu import faultinject, telemetry
+from mxnet_tpu.serving import ServeOverloadError
+from mxnet_tpu.serving.fleet import (Router, ReplicaSupervisor,
+                                     FleetRolloutError, RpcServer,
+                                     RpcClient, RpcConnectionError)
+
+
+# ---------------------------------------------------------------- fakes
+class FakeReplica:
+    """In-process replica implementing the RPC-handler protocol with
+    scripted behavior: per-call transport faults, overloads, slow
+    inference, frozen health snapshots, reload success/failure."""
+
+    def __init__(self, rid, wait_ms=1.0, state="healthy"):
+        self.rid = rid
+        self.wait_ms = wait_ms
+        self.state = state
+        self.seq = 0
+        self.pid = 40000 + rid
+        self.served = 0
+        self.fail_next = 0          # raise ConnectionError on next N infers
+        self.overload_next = 0      # shed the next N infers
+        self.infer_delay_s = 0.0
+        self.frozen_health = None   # replay this dict (a corpse's numbers)
+        self.health_raises = False
+        self.reload_raises = False
+        self.params_ver = 0
+        self._prev_ver = None
+        self.reload_times = []
+        self.infer_done_times = []
+        self._lock = threading.Lock()
+
+    def health(self, **kw):
+        if self.health_raises:
+            raise ConnectionError("health: replica %d gone" % self.rid)
+        if self.frozen_health is not None:
+            return dict(self.frozen_health)
+        self.seq += 1
+        return {"state": self.state, "seq": self.seq,
+                "snapshot_ms": time.time() * 1000.0,
+                "ewma_queue_wait_ms": self.wait_ms, "pid": self.pid,
+                "queue_depth": 0}
+
+    def infer(self, inputs, deadline_ms=None, **kw):
+        with self._lock:
+            if self.fail_next > 0:
+                self.fail_next -= 1
+                raise ConnectionError("infer: replica %d died" % self.rid)
+            if self.overload_next > 0:
+                self.overload_next -= 1
+                raise ServeOverloadError("replica %d saturated" % self.rid,
+                                         retry_after_ms=25)
+        if self.infer_delay_s:
+            time.sleep(self.infer_delay_s)
+        with self._lock:
+            self.served += 1
+            self.infer_done_times.append(time.perf_counter())
+        return [np.full((2, 4), self.rid, np.float32)]
+
+    def reload(self, arg_params, aux_params=None, **kw):
+        if self.reload_raises:
+            raise MXNetError("swap refused on replica %d" % self.rid)
+        with self._lock:
+            self._prev_ver = self.params_ver
+            self.params_ver += 1
+            self.reload_times.append(time.perf_counter())
+        return True
+
+    def rollback(self, **kw):
+        with self._lock:
+            if self._prev_ver is None:
+                raise MXNetError("nothing to roll back")
+            self.params_ver = self._prev_ver
+            self._prev_ver = None
+        return True
+
+
+def make_router(fakes, **kw):
+    kw.setdefault("workers", 4)
+    kw.setdefault("health_interval_ms", 20)
+    kw.setdefault("stale_ms", 400)
+    kw.setdefault("dispatch_wait_ms", 2000)
+    return Router(lambda: fakes, **kw)
+
+
+@pytest.fixture
+def payload():
+    return {"data": np.zeros((2, 3), np.float32)}
+
+
+def _wait_fresh(router, n, timeout=3.0):
+    deadline = time.perf_counter() + timeout
+    while time.perf_counter() < deadline:
+        h = router.health()
+        if sum(1 for d in h["replicas"].values() if d["fresh"]) >= n:
+            return h
+        time.sleep(0.02)
+    raise AssertionError("views never became fresh: %s" % router.health())
+
+
+# ------------------------------------------------------------ dispatch
+def test_load_aware_pick_prefers_lowest_wait(payload):
+    fakes = {0: FakeReplica(0, wait_ms=2.0), 1: FakeReplica(1, wait_ms=80.0)}
+    with make_router(fakes) as r:
+        _wait_fresh(r, 2)
+        futs = [r.submit(payload) for _ in range(12)]
+        for f in futs:
+            f.result(timeout=5)
+    assert fakes[0].served == 12
+    assert fakes[1].served == 0
+
+
+def test_degraded_and_latched_skip(payload):
+    fakes = {0: FakeReplica(0, wait_ms=1.0, state="degraded"),
+             1: FakeReplica(1, wait_ms=90.0),
+             2: FakeReplica(2, wait_ms=1.0, state="latched")}
+    with make_router(fakes) as r:
+        _wait_fresh(r, 3)
+        for _ in range(5):
+            r.infer(payload, timeout=5)
+        # the slow-but-healthy replica wins over faster degraded/latched
+        assert fakes[1].served == 5
+        assert fakes[0].served == 0 and fakes[2].served == 0
+        # with NO healthy replica left, degraded still beats shedding
+        fakes[1].state = "latched"
+        _wait_fresh(r, 3)
+        time.sleep(0.1)
+        r.infer(payload, timeout=5)
+        assert fakes[0].served == 1
+
+
+def test_stale_snapshot_discarded(payload):
+    """A dead replica's last-good numbers must not attract traffic: a
+    frozen (seq/snapshot_ms replay) health response is discarded and the
+    replica ages out of eligibility."""
+    telemetry.reset()
+    telemetry.set_mode("counters")
+    try:
+        fakes = {0: FakeReplica(0, wait_ms=1.0),
+                 1: FakeReplica(1, wait_ms=50.0)}
+        with make_router(fakes) as r:
+            _wait_fresh(r, 2)
+            # freeze replica 0's snapshot — same seq, same snapshot_ms,
+            # flattering wait estimate
+            fakes[0].frozen_health = fakes[0].health()
+            deadline = time.perf_counter() + 3.0
+            while time.perf_counter() < deadline:
+                if not r.health()["replicas"][0]["fresh"]:
+                    break
+                time.sleep(0.02)
+            assert not r.health()["replicas"][0]["fresh"]
+            for _ in range(4):
+                r.infer(payload, timeout=5)
+            assert fakes[1].served == 4
+            assert fakes[0].served == 0
+        assert telemetry.counters().get("fleet.stale_health_discards", 0) > 0
+    finally:
+        telemetry.set_mode(None)
+        telemetry.reset()
+
+
+def test_fleet_saturated_shed_with_retry_after(payload):
+    fakes = {0: FakeReplica(0, wait_ms=5000.0),
+             1: FakeReplica(1, wait_ms=9000.0)}
+    with make_router(fakes, shed_ms=1000.0) as r:
+        _wait_fresh(r, 2)
+        with pytest.raises(ServeOverloadError) as ei:
+            r.submit(payload)
+        assert ei.value.retry_after_ms >= 1000
+        # deadline-aware shed too: budget below the best estimate
+        with pytest.raises(ServeOverloadError):
+            r.submit(payload, deadline_ms=100)
+    # no replica eligible at all -> shed with retry_after, not a hang
+    with make_router({}, stale_ms=100) as r:
+        with pytest.raises(ServeOverloadError) as ei:
+            r.submit(payload)
+        assert ei.value.retry_after_ms > 0
+
+
+def test_dead_replica_redispatch_zero_lost(payload):
+    """Kill the preferred replica with requests in flight: every one of
+    them re-dispatches to the survivor — zero lost, zero hung."""
+    telemetry.reset()
+    telemetry.set_mode("counters")
+    try:
+        fakes = {0: FakeReplica(0, wait_ms=1.0),
+                 1: FakeReplica(1, wait_ms=60.0)}
+        with make_router(fakes, workers=4) as r:
+            _wait_fresh(r, 2)
+            # replica 0 dies for the next 6 calls (in-flight + queued),
+            # and its health endpoint dies with it
+            fakes[0].fail_next = 6
+            fakes[0].health_raises = True
+            futs = [r.submit(payload) for _ in range(6)]
+            outs = [f.result(timeout=10) for f in futs]
+            # let the poller observe the dead health endpoint too
+            deadline = time.perf_counter() + 3.0
+            while time.perf_counter() < deadline and \
+                    not telemetry.counters().get(
+                        "fleet.health_poll_errors", 0):
+                time.sleep(0.02)
+        for o in outs:
+            assert o[0][0, 0] == 1.0  # everyone landed on the survivor
+        assert fakes[1].served == 6
+        assert telemetry.counters().get("fleet.redispatches", 0) >= 1
+        assert telemetry.counters().get("fleet.health_poll_errors", 0) >= 1
+    finally:
+        telemetry.set_mode(None)
+        telemetry.reset()
+
+
+def test_redispatch_budget_exhausted_fails_structured(payload):
+    from mxnet_tpu.serving.fleet import FleetDispatchError
+
+    fakes = {0: FakeReplica(0)}
+    fakes[0].fail_next = 10
+    with make_router(fakes, max_redispatch=2,
+                     dispatch_wait_ms=500) as r:
+        _wait_fresh(r, 1)
+        fut = r.submit(payload)
+        with pytest.raises(FleetDispatchError, match="re-dispatches"):
+            fut.result(timeout=10)
+
+
+def test_replica_overload_tries_next_then_sheds(payload):
+    fakes = {0: FakeReplica(0, wait_ms=1.0), 1: FakeReplica(1, wait_ms=2.0)}
+    with make_router(fakes) as r:
+        _wait_fresh(r, 2)
+        # preferred replica sheds once -> request lands on the other
+        fakes[0].overload_next = 1
+        out = r.infer(payload, timeout=5)
+        assert fakes[0].served + fakes[1].served == 1
+        # the WHOLE fleet shedding propagates the overload to the client
+        fakes[0].overload_next = 5
+        fakes[1].overload_next = 5
+        fut = r.submit(payload)
+        with pytest.raises(ServeOverloadError):
+            fut.result(timeout=10)
+
+
+# -------------------------------------------------------------- rollout
+def test_rollout_drains_then_swaps_every_replica(payload):
+    fakes = {0: FakeReplica(0, wait_ms=1.0), 1: FakeReplica(1, wait_ms=2.0)}
+    fakes[0].infer_delay_s = 0.3
+    with make_router(fakes) as r:
+        _wait_fresh(r, 2)
+        fut = r.submit(payload)  # in flight on replica 0 for ~300ms
+        time.sleep(0.05)
+        res = r.rollout({"w": np.zeros(3, np.float32)},
+                        drain_timeout_s=5.0)
+        fut.result(timeout=5)
+        assert sorted(res["applied"]) == [0, 1]
+        assert fakes[0].params_ver == 1 and fakes[1].params_ver == 1
+        # the drain ordering: replica 0's swap happened only after its
+        # in-flight request delivered
+        assert fakes[0].reload_times[0] > fakes[0].infer_done_times[0]
+
+
+def test_rollout_abort_rolls_back_swapped_replicas(payload):
+    telemetry.reset()
+    telemetry.set_mode("counters")
+    try:
+        fakes = {0: FakeReplica(0), 1: FakeReplica(1), 2: FakeReplica(2)}
+        fakes[2].reload_raises = True  # third swap fails
+        with make_router(fakes) as r:
+            _wait_fresh(r, 3)
+            with pytest.raises(FleetRolloutError, match="rolled back"):
+                r.rollout({"w": np.zeros(3, np.float32)})
+            # old weights live fleet-wide: 0 and 1 swapped then rolled back
+            assert fakes[0].params_ver == 0
+            assert fakes[1].params_ver == 0
+            assert fakes[2].params_ver == 0
+            # serving continues after the abort
+            r.infer(payload, timeout=5)
+        assert telemetry.counters().get("fleet.rollout_aborts", 0) == 1
+    finally:
+        telemetry.set_mode(None)
+        telemetry.reset()
+
+
+# --------------------------------------------------------- faultinject
+def test_fleet_dispatch_site_drives_redispatch(payload):
+    fakes = {0: FakeReplica(0)}
+    with make_router(fakes) as r:
+        _wait_fresh(r, 1)
+        faultinject.reset_stats()
+        with faultinject.inject("fleet.dispatch", "raise", prob=1.0,
+                                seed=3, times=1):
+            out = r.infer(payload, timeout=10)
+        assert faultinject.stats().get("fleet.dispatch:raise") == 1
+        assert r.health()["counts"]["redispatched"] == 1
+    assert out[0][0, 0] == 0.0
+
+
+def test_wedged_health_poll_does_not_stale_the_fleet(payload):
+    """One replica whose health RPC wedges must cost only ITSELF
+    freshness: polls run per-replica-concurrent (with an in-flight
+    guard), so the survivor's view stays fresh and keeps serving."""
+    fakes = {0: FakeReplica(0, wait_ms=1.0), 1: FakeReplica(1, wait_ms=5.0)}
+    orig = fakes[0].health
+
+    def slow_health(**kw):
+        time.sleep(1.2)  # way past stale_ms — a wedged replica
+        return orig(**kw)
+
+    with make_router(fakes, stale_ms=300) as r:
+        _wait_fresh(r, 2)
+        fakes[0].health = slow_health
+        time.sleep(0.6)
+        h = r.health()
+        assert h["replicas"][1]["fresh"], h
+        assert not h["replicas"][0]["fresh"], h
+        r.infer(payload, timeout=5)
+        assert fakes[1].served == 1
+
+
+def test_fleet_health_site_starves_the_view(payload):
+    """An injected health-poll fault makes the replica's snapshot stale —
+    the router must stop dispatching on it (and recover once the
+    injection stops)."""
+    fakes = {0: FakeReplica(0, wait_ms=1.0), 1: FakeReplica(1, wait_ms=50.0)}
+    with make_router(fakes, stale_ms=150) as r:
+        _wait_fresh(r, 2)
+        # the injection hits polls for BOTH replicas; give replica 0's
+        # plan enough fires to starve it while 1 survives on p<1 misses
+        with faultinject.inject("fleet.health", "raise", prob=1.0, seed=5):
+            deadline = time.perf_counter() + 2.0
+            while time.perf_counter() < deadline:
+                h = r.health()
+                if not any(d["fresh"] for d in h["replicas"].values()):
+                    break
+                time.sleep(0.02)
+            assert not any(d["fresh"]
+                           for d in r.health()["replicas"].values())
+        _wait_fresh(r, 2)  # polls succeed again once injection stops
+        r.infer(payload, timeout=5)
+
+
+# ------------------------------------------------------------------ rpc
+def test_rpc_roundtrip_errors_and_connection_loss():
+    calls = []
+
+    def echo(x):
+        calls.append(x)
+        return {"got": x, "arr": np.arange(6).reshape(2, 3)}
+
+    def boom():
+        raise ServeOverloadError("busy", retry_after_ms=7)
+
+    srv = RpcServer({"echo": echo, "boom": boom}).start()
+    addr = srv.addr
+    try:
+        cli = RpcClient(addr, timeout_s=5.0)
+        out = cli.call("echo", x=3)
+        assert out["got"] == 3
+        np.testing.assert_array_equal(out["arr"], np.arange(6).reshape(2, 3))
+        # remote structured errors arrive as their original type
+        with pytest.raises(ServeOverloadError) as ei:
+            cli.call("boom")
+        assert ei.value.retry_after_ms == 7
+        with pytest.raises(MXNetError, match="unknown method"):
+            cli.call("nope")
+    finally:
+        srv.stop()
+    # server gone: transport failure, not a hang
+    cli2 = RpcClient(addr, timeout_s=1.0, connect_timeout_s=0.5)
+    with pytest.raises(RpcConnectionError):
+        cli2.call("echo", x=1)
+    cli.close()
+
+
+# ------------------------------------------------------------ supervisor
+_FAKE_WORKER = r"""
+import json, os, sys, time
+spec = json.load(open(sys.argv[1]))
+mode = spec.get("fake_mode", "ok")
+if mode != "never_ready":
+    with open(spec["port_file"] + ".tmp", "w") as f:
+        f.write("127.0.0.1:1\n")
+    os.replace(spec["port_file"] + ".tmp", spec["port_file"])
+beats = 0
+while True:
+    if mode != "wedge" or beats < 2:
+        with open(spec["heartbeat_path"], "a"):
+            os.utime(spec["heartbeat_path"], None)
+        beats += 1
+    time.sleep(0.05)
+"""
+
+
+class StubSupervisor(ReplicaSupervisor):
+    """Spawns a tiny stand-in worker (port file + heartbeats, no jax) so
+    spawn/monitor/restart logic is testable in milliseconds."""
+
+    def _spawn_cmd(self, h):
+        return [sys.executable, "-c", _FAKE_WORKER, h.spec_path]
+
+
+def _mk_sup(tmp_path, n=2, **kw):
+    spec = {"model": "stub", "fake_mode": kw.pop("fake_mode", "ok")}
+    kw.setdefault("restart_backoff_ms", 50)
+    kw.setdefault("restart_backoff_max_ms", 400)
+    kw.setdefault("dead_after_ms", 600)
+    kw.setdefault("poll_interval_s", 0.05)
+    return StubSupervisor(spec, n_replicas=n, workdir=str(tmp_path), **kw)
+
+
+def test_supervisor_spawns_to_ready_and_restarts_dead(tmp_path):
+    sup = _mk_sup(tmp_path, n=2)
+    try:
+        sup.start()
+        sup.wait_ready(2, timeout_s=15)
+        states = sup.states()
+        pid0 = states[0]["pid"]
+        assert all(d["state"] == "ready" for d in states.values())
+        # kill replica 0: monitor must notice the exit and respawn it
+        sup.kill_replica(0)
+        deadline = time.perf_counter() + 15
+        while time.perf_counter() < deadline:
+            s = sup.states()[0]
+            if s["state"] == "ready" and s["pid"] not in (None, pid0):
+                break
+            time.sleep(0.05)
+        s = sup.states()[0]
+        assert s["state"] == "ready" and s["pid"] != pid0
+        assert s["restarts"] == 1
+        assert sup.states()[1]["restarts"] == 0  # the peer never blinked
+    finally:
+        sup.stop()
+
+
+def test_supervisor_kills_wedged_replica_on_stale_heartbeat(tmp_path):
+    """A process that stops heartbeating but keeps its PID is dead for
+    serving purposes: the monitor SIGKILLs and restarts it."""
+    sup = _mk_sup(tmp_path, n=1, fake_mode="wedge", dead_after_ms=300)
+    try:
+        sup.start()
+        sup.wait_ready(1, timeout_s=15)
+        deadline = time.perf_counter() + 15
+        while time.perf_counter() < deadline:
+            if sup.states()[0]["restarts"] >= 1:
+                break
+            time.sleep(0.05)
+        assert sup.states()[0]["restarts"] >= 1
+    finally:
+        sup.stop()
+
+
+def test_supervisor_spawn_fault_injection_backs_off_and_retries(tmp_path):
+    """An injected fleet.replica_spawn raise fails the first attempt; the
+    capped backoff retries and the replica still comes up."""
+    faultinject.reset_stats()
+    sup = _mk_sup(tmp_path, n=1)
+    try:
+        with faultinject.inject("fleet.replica_spawn", "raise", prob=1.0,
+                                seed=9, times=1):
+            sup.start()
+            sup.wait_ready(1, timeout_s=15)
+        assert faultinject.stats().get("fleet.replica_spawn:raise") == 1
+        assert sup.states()[0]["restarts"] >= 1  # the failed attempt
+    finally:
+        sup.stop()
+
+
+def test_supervisor_backoff_is_capped(tmp_path):
+    sup = _mk_sup(tmp_path, n=1, restart_backoff_ms=100,
+                  restart_backoff_max_ms=250)
+    h = sup._handles[0]
+    now = time.perf_counter()
+    delays = []
+    with sup._lock:
+        for _ in range(5):
+            sup._note_death_locked(h, "test", now)
+            delays.append(h.next_spawn_t - now)
+    assert delays[0] == pytest.approx(0.1, abs=0.02)
+    assert delays[-1] == pytest.approx(0.25, abs=0.02)  # capped
+    assert all(b >= a - 1e-9 for a, b in zip(delays, delays[1:]))
+
+
+# ------------------------------------------- engine health() staleness
+def test_engine_health_seq_and_snapshot_ms_are_monotonic():
+    """The satellite contract: every health() snapshot carries a strictly
+    increasing seq and a wall-clock snapshot_ms — the fields the router's
+    staleness check keys on."""
+    from mxnet_tpu.serving import InferenceEngine, PersistentExecutableCache
+    import mxnet_tpu as mx
+
+    net = mx.sym.FullyConnected(mx.sym.Variable("data"), num_hidden=4,
+                                name="fc")
+    rs = np.random.RandomState(0)
+    cache = PersistentExecutableCache(
+        net, {"fc_weight": rs.randn(4, 6).astype("float32"),
+              "fc_bias": np.zeros(4, "float32")})
+    eng = InferenceEngine(cache, {"data": (6,)}, buckets=(1, 2))
+    eng.start()
+    try:
+        t0 = time.time() * 1000.0
+        h1 = eng.health()
+        h2 = eng.health()
+        assert h2["seq"] == h1["seq"] + 1
+        assert t0 - 5000 < h1["snapshot_ms"] <= h2["snapshot_ms"]
+        assert h2["snapshot_ms"] <= time.time() * 1000.0 + 5000
+    finally:
+        eng.close()
+
+
+@pytest.mark.slow
+def test_fleet_end_to_end_real_processes(tmp_path):
+    """Full stack: 2 real replica subprocesses (jax + engine + RPC),
+    routed inference, a hitless rollout, a SIGKILL + supervised restart,
+    and zero lost requests throughout."""
+    import mxnet_tpu as mx
+    from mxnet_tpu import models
+    from mxnet_tpu.serving.fleet import Fleet, save_params_npz
+
+    item = (784,)
+    net = models.get_symbol("mlp", num_classes=10)
+    probe = net.simple_bind(mx.cpu(), grad_req="null", data=(1,) + item)
+    rs = np.random.RandomState(0)
+    arg_params = {k: (rs.randn(*a.shape) * 0.1).astype("float32")
+                  for k, a in probe.arg_dict.items()
+                  if k not in ("data", "softmax_label")}
+    pp = str(tmp_path / "params.npz")
+    save_params_npz(pp, arg_params)
+    spec = {"model": "mlp", "model_kwargs": {"num_classes": 10},
+            "item_shapes": {"data": list(item)}, "buckets": [1, 2, 4],
+            "params": pp, "heartbeat_ms": 300}
+    with Fleet(spec, n_replicas=2, workdir=str(tmp_path),
+               router_kwargs=dict(health_interval_ms=100)) as fl:
+        out = fl.router.infer({"data": rs.rand(2, 784).astype("float32")},
+                              timeout=30)
+        assert out[0].shape == (2, 10)
+        new = {k: (v * 1.01).astype("float32")
+               for k, v in arg_params.items()}
+        res = fl.router.rollout(new)
+        assert sorted(res["applied"]) == [0, 1]
+        assert fl.supervisor.kill_replica(0) is not None
+        for _ in range(10):
+            fl.router.infer({"data": rs.rand(1, 784).astype("float32")},
+                            timeout=30)
+        fl.supervisor.wait_ready(2, timeout_s=120)
+        assert fl.supervisor.states()[0]["restarts"] >= 1
+        counts = fl.router.health()["counts"]
+        assert counts["completed"] == counts["submitted"]
+
+
+def test_fleet_rollout_recycles_unrolled_replicas(tmp_path):
+    """Fleet.rollout closes the restart/mixed-weights hole: on success
+    it rewrites the spec param file with the NEW weights and recycles
+    every replica the router-level rollout could not swap, so a replica
+    that restarts at any later point loads the rolled-out weights."""
+    from mxnet_tpu.serving import fleet as fleet_mod
+    from mxnet_tpu.serving.fleet import load_params_npz, save_params_npz
+
+    params_path = str(tmp_path / "p.npz")
+    save_params_npz(params_path, {"w": np.zeros(2, np.float32)})
+
+    class StubSup:
+        n_replicas = 3
+        base_spec = {"params": params_path}
+        killed = []
+
+        def kill_replica(self, rid):
+            self.killed.append(rid)
+
+    class StubRouter:
+        def rollout(self, arg_params, aux_params=None, **kw):
+            # replica 0 was dead/mid-restart: router could not see it
+            return {"applied": [1, 2], "skipped": []}
+
+    f = object.__new__(fleet_mod.Fleet)
+    f.supervisor = StubSup()
+    f.router = StubRouter()
+    res = f.rollout({"w": np.ones(2, np.float32)})
+    assert res == {"applied": [1, 2], "recycled": [0]}
+    assert f.supervisor.killed == [0]  # recycled onto the new file
+    arg, _ = load_params_npz(params_path)
+    np.testing.assert_array_equal(arg["w"], np.ones(2, np.float32))

@@ -159,3 +159,137 @@ def test_serving_symbols_share_training_weight_names():
          for i in range(CFG["num_layers"])}
     assert (pf_args - {"data"}) <= train_args
     assert (dec_args - serving_only) <= train_args
+
+
+# ----------------------------------------------------------- paged decode
+def test_page_pool_accounting_and_reuse():
+    """Allocator unit contract (no device work): frames hand out LIFO
+    (non-contiguous physical placement is routine), release returns them,
+    the global budget caps acquisitions, and page_size must divide the
+    slot count."""
+    from mxnet_tpu.serving.kv_decode import _PagePool, PagedKVExhausted
+
+    pool = _PagePool(lanes=2, slots=16, page_size=4)
+    assert pool.frames_per_lane == 4 and pool.budget == 8
+    a = [pool.acquire(0) for _ in range(4)]
+    assert sorted(a) == [0, 1, 2, 3] and pool.in_use == 4
+    with pytest.raises(PagedKVExhausted, match="no free page frame"):
+        pool.acquire(0)  # lane 0 exhausted; lane 1 still has frames
+    pool.release(0, a[:2])
+    b = pool.acquire(0)
+    assert b in a[:2] and pool.in_use == 3  # freed frames come back LIFO
+    # global budget below the physical frame count gates admission
+    tight = _PagePool(lanes=2, slots=16, page_size=4, budget=1)
+    tight.acquire(0)
+    with pytest.raises(PagedKVExhausted, match="budget"):
+        tight.acquire(1)
+    with pytest.raises(MXNetError, match="divide"):
+        _PagePool(lanes=1, slots=10, page_size=4)
+
+
+def test_paged_multiplexed_token_identical():
+    """The acceptance bar: >=2 concurrent sequences served from ONE
+    decode batch, admitted at different times and advancing at different
+    positions, produce token-identical output to sequential per-request
+    decode — and the multiplexed path never retraces."""
+    from mxnet_tpu.serving import PagedKVDecoder
+
+    telemetry.reset()
+    telemetry.set_mode("counters")
+    try:
+        S = 16
+        _, _, params = _trained_params(S)
+        rs = np.random.RandomState(7)
+        prompts = [rs.randint(1, CFG["vocab_size"], (n,)).astype(np.float32)
+                   for n in (3, 5, 2)]
+
+        # oracle: each prompt decoded alone through a batch-1 ring decoder
+        def solo(prompt, n_tok):
+            dec = KVCacheDecoder(params, max_len=S, prefill_len=8,
+                                 pos_len=S, batch=1, **CFG)
+            return dec.greedy(prompt[None], n_tok)[0]
+
+        want = [solo(p, 6) for p in prompts]
+
+        paged = PagedKVDecoder(params, max_len=S, page_size=4, lanes=3,
+                               prefill_len=8, pos_len=S, **CFG)
+        # staggered admission: two sequences run for 2 steps before the
+        # third joins — three lanes at three different positions in every
+        # later dispatch
+        sids, logits, toks = [], {}, {}
+        for p in prompts[:2]:
+            sid, lg = paged.admit(p)
+            sids.append(sid)
+            logits[sid] = lg
+            toks[sid] = []
+        c0 = telemetry.counters()
+        for _ in range(2):
+            nxt = {s: int(np.argmax(logits[s])) for s in sids}
+            for s in sids:
+                toks[s].append(nxt[s])
+            logits = paged.step(nxt)
+        sid3, lg3 = paged.admit(prompts[2])
+        sids.append(sid3)
+        logits[sid3] = lg3
+        toks[sid3] = []
+        for _ in range(6):
+            need = [s for s in sids if len(toks[s]) < 6]
+            if not need:
+                break
+            nxt = {s: int(np.argmax(logits[s])) for s in need}
+            for s in need:
+                toks[s].append(nxt[s])
+            step_ids = {s: nxt[s] for s in need if len(toks[s]) < 6}
+            if step_ids:
+                logits.update(paged.step(step_ids))
+        for sid, w in zip(sids, want):
+            np.testing.assert_array_equal(np.asarray(toks[sid]), w)
+        # one decode executable, replayed for every multiplexed step
+        c1 = telemetry.counters()
+        assert c1.get("executor.retrace", 0) == c0.get("executor.retrace", 0)
+        assert c1.get("executor.compile", 0) == c0.get("executor.compile", 0)
+        assert paged.stats()["active"] == 3
+        for sid in sids:
+            paged.retire(sid)
+        assert paged.stats()["pages_in_use"] == 0
+    finally:
+        telemetry.set_mode(None)
+        telemetry.reset()
+
+
+def test_paged_admission_backpressure_and_reuse():
+    """Lane exhaustion and page-budget exhaustion raise the structured
+    PagedKVExhausted (admission backpressure); retiring frees the lane
+    and its pages for the next sequence, which lands on recycled
+    (non-contiguous) frames and still decodes identically."""
+    from mxnet_tpu.serving import PagedKVDecoder, PagedKVExhausted
+
+    S = 16
+    _, _, params = _trained_params(S)
+    rs = np.random.RandomState(11)
+    prompt = rs.randint(1, CFG["vocab_size"], (4,)).astype(np.float32)
+
+    paged = PagedKVDecoder(params, max_len=S, page_size=4, lanes=2,
+                           prefill_len=8, pos_len=S, **CFG)
+    s0, _ = paged.admit(prompt)
+    s1, _ = paged.admit(prompt)
+    with pytest.raises(PagedKVExhausted, match="lanes occupied"):
+        paged.admit(prompt)
+    paged.retire(s0)
+    s2, lg = paged.admit(prompt)  # recycled lane + frames
+    dec = KVCacheDecoder(params, max_len=S, prefill_len=8, pos_len=S,
+                         batch=1, **CFG)
+    want = dec.greedy(prompt[None], 4)[0]
+    toks = []
+    for _ in range(4):
+        t = int(np.argmax(lg))
+        toks.append(t)
+        lg = paged.step({s2: t})[s2]
+    np.testing.assert_array_equal(np.asarray(toks), want)
+
+    # a page budget below the physical capacity sheds admissions
+    tight = PagedKVDecoder(params, max_len=S, page_size=4, lanes=2,
+                           page_budget=1, prefill_len=8, pos_len=S, **CFG)
+    tight.admit(prompt)  # 4 tokens -> exactly 1 page
+    with pytest.raises(PagedKVExhausted, match="budget"):
+        tight.admit(prompt)

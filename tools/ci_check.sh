@@ -33,7 +33,14 @@
 # fault injection on the dispatch path + a mid-run hitless weight reload,
 # gated on zero hung futures, zero retraces, and recovery to `healthy`
 # (docs/RESILIENCE.md).
-# Step 8 runs the elastic fault-tolerance chaos smoke
+# Step 8 runs the serving FLEET chaos smoke (serve_bench --fleet,
+# docs/SERVING.md §Fleet): open-loop load through the replica router over
+# 4 replica processes with injected dispatch faults, a mid-run replica
+# SIGKILL (supervised restart), and a mid-run fleet-wide hitless rollout —
+# gated on zero hung/lost requests, aggregate QPS above the single-replica
+# closed-loop baseline, recovery to healthy, and paged-KV multiplexed
+# decode parity.
+# Step 9 runs the elastic fault-tolerance chaos smoke
 # (tests/nightly/dist_elastic_chaos.py --orchestrate): an 8-process
 # Module.fit in sharded-update mode with periodic async checkpoints, one
 # worker killed mid-run — the survivors must re-form to 7, reseed from the
@@ -41,11 +48,11 @@
 # 7-process control run; it also asserts checkpoint.inflight was observed
 # > 0 mid-fit, i.e. the async write really overlapped the step
 # (docs/FAULT_TOLERANCE.md).
-# Step 9 is the repo's tier-1 pytest command (ROADMAP.md).
+# Step 10 is the repo's tier-1 pytest command (ROADMAP.md).
 set -uo pipefail
 cd "$(dirname "$0")/.."
 
-echo "== [1/9] graphlint: all bundled models (plain + sharding-plan sweep) =="
+echo "== [1/10] graphlint: all bundled models (plain + sharding-plan sweep) =="
 JAX_PLATFORMS=cpu python tools/graphlint --all-models --min-severity warning \
     || { echo "graphlint FAILED"; exit 1; }
 # the same zoo under an abstract dp=8,model=2 mesh: the GL4xx sharding-plan
@@ -106,7 +113,7 @@ print("autoplan sweep OK: %d models planned (%d pipelined); transformer "
 PYEOF
 rm -f "$AUTOPLAN_SWEEP"
 
-echo "== [2/9] source lint (ruff/pyflakes if available) =="
+echo "== [2/10] source lint (ruff/pyflakes if available) =="
 if command -v ruff >/dev/null 2>&1; then
     ruff check mxnet_tpu/ || { echo "ruff FAILED"; exit 1; }
 elif python -c 'import pyflakes' >/dev/null 2>&1; then
@@ -115,7 +122,7 @@ else
     echo "(neither ruff nor pyflakes installed; compile-check runs in pytest)"
 fi
 
-echo "== [3/9] fused conv+BN: interpret-mode autotune smoke + bwd parity subset =="
+echo "== [3/10] fused conv+BN: interpret-mode autotune smoke + bwd parity subset =="
 FUSED_TABLE="$(mktemp /tmp/fused_conv_bn_table_ci.XXXXXX.py)"
 JAX_PLATFORMS=cpu python tools/fused_stats_bench.py --interpret --emit-table \
     --table-out "$FUSED_TABLE" \
@@ -178,7 +185,7 @@ PYEOF
 done
 rm -rf "$TUNE_DIR"
 
-echo "== [4/9] telemetry: trace-on fit smoke + mxtrace schema gate =="
+echo "== [4/10] telemetry: trace-on fit smoke + mxtrace schema gate =="
 TRACE_DIR="$(mktemp -d /tmp/mxtrace_ci.XXXXXX)"
 JAX_PLATFORMS=cpu MXNET_DEFAULT_CONTEXT=cpu MXNET_TELEMETRY=trace \
 python - "$TRACE_DIR" <<'PYEOF' || { echo "telemetry fit smoke FAILED"; rm -rf "$TRACE_DIR"; exit 1; }
@@ -219,7 +226,7 @@ python tools/mxtrace "$TRACE_DIR/profile.json" --check \
     || { echo "mxtrace --check FAILED"; rm -rf "$TRACE_DIR"; exit 1; }
 rm -rf "$TRACE_DIR"
 
-echo "== [5/9] kvstore: 8-process bucket/overlap smoke (docs/PERF.md §11) =="
+echo "== [5/10] kvstore: 8-process bucket/overlap smoke (docs/PERF.md §11) =="
 # functional leg: overlap counters fire during Module.fit on the per-key
 # priority path, and sharded-update weights bit-match replicated (atol 1e-6)
 JAX_PLATFORMS=cpu MXNET_DEFAULT_CONTEXT=cpu \
@@ -240,7 +247,7 @@ JAX_PLATFORMS=cpu MXNET_DEFAULT_CONTEXT=cpu MXNET_KVSTORE_BUCKET_MB=16 \
     "${BW_CMD[@]}" || { echo "kvstore bandwidth smoke FAILED"; exit 1; }
 }
 
-echo "== [6/9] sparse kvstore: 2-proc recommender smoke (docs/SPARSE.md) =="
+echo "== [6/10] sparse kvstore: 2-proc recommender smoke (docs/SPARSE.md) =="
 # sparse-push fit weight-parity with the dense-push control (atol 1e-6) AND
 # kvstore.bytes.sparse strictly below the control's table allreduce bytes;
 # both gates assert inside the script on every rank
@@ -271,7 +278,7 @@ print("recommender autoplan OK: mesh %s, sharded tables %s, comm %.2f KiB "
 PYEOF
 rm -f "$SPARSE_PLAN"
 
-echo "== [7/9] serving: serve_bench smoke (docs/SERVING.md) =="
+echo "== [7/10] serving: serve_bench smoke (docs/SERVING.md) =="
 # tiny-model CPU serving smoke: sustained QPS > 0, finite p99, ZERO
 # post-warmup retraces/compiles (the sealed executable-cache contract,
 # gated via the GL201-203 guard + executor compile/cache-hit telemetry),
@@ -294,7 +301,21 @@ python tools/serve_bench.py --model mlp --chaos --qps 150 --duration 2 \
     --check \
     || { echo "serve_bench chaos smoke FAILED"; exit 1; }
 
-echo "== [8/9] elastic: 8-proc chaos smoke (docs/FAULT_TOLERANCE.md) =="
+echo "== [8/10] serving fleet: 4-replica router chaos smoke (docs/SERVING.md §Fleet) =="
+# open-loop load through the Router over 4 replica PROCESSES with the
+# seeded chaos plan: injected fleet.dispatch faults (re-dispatch path),
+# one replica SIGKILLed mid-run (supervisor restart with capped backoff),
+# and one mid-run fleet-wide hitless rollout. The gate asserts zero
+# hung/lost requests (every request reaches a terminal state),
+# completed>0, the rollout applied, the fleet back to healthy, aggregate
+# QPS above the single-replica closed-loop baseline, p99 in bound, and
+# paged-KV multiplexed decode token-identical to sequential decode.
+JAX_PLATFORMS=cpu MXNET_DEFAULT_CONTEXT=cpu \
+python tools/serve_bench.py --model mlp --fleet --fleet-replicas 4 \
+    --qps 100 --duration 4 --check \
+    || { echo "serve_bench fleet smoke FAILED"; exit 1; }
+
+echo "== [9/10] elastic: 8-proc chaos smoke (docs/FAULT_TOLERANCE.md) =="
 # kill 1 of 8 workers mid-fit: survivors pause, re-form to 7, reseed from
 # the sharded async checkpoint, resume — and must reach weight parity with
 # an uninterrupted 7-proc control run; checkpoint.inflight must have been
@@ -306,7 +327,7 @@ python tests/nightly/dist_elastic_chaos.py --orchestrate "$CHAOS_DIR" \
     || { echo "elastic chaos smoke FAILED"; rm -rf "$CHAOS_DIR"; exit 1; }
 rm -rf "$CHAOS_DIR"
 
-echo "== [9/9] tier-1 tests =="
+echo "== [10/10] tier-1 tests =="
 rm -f /tmp/_t1.log
 timeout -k 10 870 env JAX_PLATFORMS=cpu python -m pytest tests/ -q -m 'not slow' \
     --continue-on-collection-errors -p no:cacheprovider -p no:xdist -p no:randomly \
