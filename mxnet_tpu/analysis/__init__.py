@@ -31,12 +31,17 @@ from ..base import MXNetError
 from .diagnostics import CODES, Diagnostic, Report, Severity, describe_code
 from .engine_race import RecordingEngine, ScheduleTrace, analyze_trace
 from .manager import GraphContext, graph_pass, list_passes, run_graph_passes
+from .rewrite import (RewritePass, RewriteResult, graphrewrite_mode,
+                      pattern_site_counts, rewrite, rewrite_pass_names,
+                      verify_rewrite)
 
 __all__ = [
     "CODES", "Diagnostic", "Report", "Severity", "describe_code",
     "GraphContext", "graph_pass", "list_passes", "run_graph_passes",
     "RecordingEngine", "ScheduleTrace", "analyze_trace",
     "lint", "lint_bind", "graphlint_mode",
+    "rewrite", "verify_rewrite", "graphrewrite_mode", "RewritePass",
+    "RewriteResult", "rewrite_pass_names", "pattern_site_counts",
 ]
 
 _LOG = logging.getLogger("mxnet_tpu.graphlint")

@@ -13,6 +13,8 @@ Examples::
     python tools/graphlint --list-codes
     python tools/graphlint resnet-50 --shape data=32,3,224,224 \
         --mesh dp=8,model=2 --budget-gb 16   # sharding-plan + HBM planner
+    python tools/graphlint transformer --rewrite       # GL6xx rewrite dump
+    python tools/graphlint --all-models --rewrite --format json
 """
 from __future__ import annotations
 
@@ -240,6 +242,131 @@ def _run_autoplan(args, targets, shapes, types, devices) -> int:
     return 1 if plan_failed else 0
 
 
+def _format_rewrite(label, res, report, sites_before, sites_after) -> str:
+    """Human block for one target's rewrite run: per-pass node-count table,
+    fired-rule histogram, fusion-site delta, verifier outcome."""
+    lines = ["== graphrewrite: %s ==" % label]
+    lines.append("nodes %d -> %d (%d folded, %d merged, %d removed, "
+                 "%d casts) rounds=%d fixpoint=%s"
+                 % (res.nodes_before, res.nodes_after,
+                    res.counts["folded"], res.counts["merged"],
+                    res.counts["removed"], res.counts["casts"],
+                    res.rounds, "yes" if res.fixpoint else "NO"))
+    if res.pass_rows:
+        rows = [("round", "pass", "fired", "nodes before", "nodes after")]
+        for r in res.pass_rows:
+            rows.append((str(r["round"]), r["pass"], str(r["fired"]),
+                         str(r["nodes_before"]), str(r["nodes_after"])))
+        widths = [max(len(x[i]) for x in rows) for i in range(5)]
+        lines.extend("  " + "  ".join(c.ljust(w)
+                                      for c, w in zip(r, widths)).rstrip()
+                     for r in rows)
+    rules = res.rule_table()
+    if rules:
+        lines.append("fired rules:")
+        lines.extend("  %-32s %d" % (k, v) for k, v in sorted(rules.items()))
+    if sites_before != sites_after:
+        names = sorted(set(sites_before) | set(sites_after))
+        lines.append("fusion sites: " + ", ".join(
+            "%s %d -> %d" % (n, sites_before.get(n, 0), sites_after.get(n, 0))
+            for n in names))
+    if report is not None:
+        bad = [d for d in report
+               if d.code in ("GL601", "GL602", "GL603", "GL604")]
+        if bad:
+            lines.extend(d.format() for d in bad)
+        else:
+            lines.append("verify: clean (0 errors)")
+        for d in report.by_code("GL605"):
+            lines.append(d.format())
+    return "\n".join(lines)
+
+
+def _format_rewrite_table(rows) -> str:
+    """The --rewrite --all-models summary: one rewrite row per target."""
+    table = [("model", "nodes", "folded/merged/removed", "norm_residual",
+              "verdict")]
+    for label, res, report, sb, sa, err in rows:
+        if res is None:
+            table.append((label, "-", "-", "-", "ERROR: %s" % err))
+            continue
+        codes = sorted({d.code for d in report.errors}) if report else []
+        table.append((
+            label, "%d->%d" % (res.nodes_before, res.nodes_after),
+            "%d/%d/%d" % (res.counts["folded"], res.counts["merged"],
+                          res.counts["removed"]),
+            "%d->%d" % (sb.get("norm_residual", 0),
+                        sa.get("norm_residual", 0)),
+            "ok" if not codes else ",".join(codes)))
+    widths = [max(len(r[i]) for r in table) for i in range(len(table[0]))]
+    out = ["== graphrewrite summary =="]
+    for r in table:
+        out.append("  ".join(c.ljust(w) for c, w in zip(r, widths)).rstrip())
+    return "\n".join(out)
+
+
+def _run_rewrite(args, targets, shapes, types) -> int:
+    """The --rewrite mode: rewrite every target (analysis/rewrite.py), run
+    the GL6xx verifier, dump per-pass node counts + the fired-rule table +
+    the fusion-site delta. ``--rewrite-json`` adds the full provenance
+    record list to the JSON payload.
+
+    Exit 0 when every target rewrites and verifies with zero
+    GL601/GL602/GL604; 1 on any verifier error (or rewrite crash); 2 on
+    load failure."""
+    from . import verify_rewrite
+    from .rewrite import pattern_site_counts, rewrite as run_rewrite
+
+    rows, payload = [], []
+    load_failed = verify_failed = False
+    for target in targets:
+        try:
+            label, sym, sh, ty = _load_target(
+                target, shapes, types, not args.no_default_shapes)
+        except Exception as exc:
+            print("graphlint: cannot load %r: %s: %s"
+                  % (target, type(exc).__name__, exc), file=sys.stderr)
+            rows.append((target, None, None, {}, {}, str(exc)))
+            payload.append({"target": target, "load_error": str(exc)})
+            load_failed = True
+            continue
+        try:
+            res = run_rewrite(sym, shapes=sh, types=ty, label=label)
+            report = verify_rewrite(res, target=label)
+            sites_before = pattern_site_counts(sym)
+            sites_after = pattern_site_counts(res.symbol)
+        except Exception as exc:
+            print("graphlint: rewrite of %r failed: %s: %s"
+                  % (label, type(exc).__name__, exc), file=sys.stderr)
+            rows.append((label, None, None, {}, {}, str(exc)))
+            payload.append({"target": label, "rewrite_error": str(exc)})
+            verify_failed = True
+            continue
+        if report.errors:
+            verify_failed = True
+        rows.append((label, res, report, sites_before, sites_after, None))
+        entry = {"target": label, "rewrite": res.to_dict(),
+                 "fusion_sites_before": sites_before,
+                 "fusion_sites_after": sites_after,
+                 "verify": json.loads(report.to_json())}
+        if args.rewrite_json:
+            entry["records"] = res.records
+        payload.append(entry)
+    if args.format == "json" or args.rewrite_json:
+        print(json.dumps(payload, indent=2))
+    else:
+        for label, res, report, sb, sa, err in rows:
+            if res is None:
+                continue
+            print(_format_rewrite(label, res, report, sb, sa))
+            print()
+        if len(rows) > 1:
+            print(_format_rewrite_table(rows))
+    if load_failed:
+        return 2
+    return 1 if verify_failed else 0
+
+
 def main(argv=None) -> int:
     ap = argparse.ArgumentParser(
         prog="graphlint",
@@ -264,6 +391,18 @@ def main(argv=None) -> int:
                          "(GL4xx) and per-device memory planning, e.g. "
                          "dp=8,model=2 — first axis is the batch axis, "
                          "'model' (or the second axis) the tensor axis")
+    ap.add_argument("--rewrite", action="store_true",
+                    help="run the Symbol->Symbol rewrite pipeline "
+                         "(analysis/rewrite.py: const fold, CSE, "
+                         "canonicalize, DCE) + the GL6xx provenance "
+                         "verifier instead of the lint passes, and dump "
+                         "per-pass node counts, the fired-rule table and "
+                         "the fusion-site delta per target "
+                         "(docs/static_analysis.md §GL6xx)")
+    ap.add_argument("--rewrite-json", action="store_true",
+                    help="with --rewrite: emit the machine-readable plan "
+                         "dump as JSON, including the full provenance "
+                         "record list")
     ap.add_argument("--autoplan", action="store_true",
                     help="run the cost-model auto-parallel planner "
                          "(parallel.autoplan) instead of the lint passes: "
@@ -327,6 +466,9 @@ def main(argv=None) -> int:
         except ValueError as exc:
             print("graphlint: %s" % exc, file=sys.stderr)
             return 2
+
+    if args.rewrite or args.rewrite_json:
+        return _run_rewrite(args, targets, shapes, types)
 
     if args.autoplan:
         devices = args.mesh_devices

@@ -17,6 +17,7 @@ Codes are grouped by pass family:
   * ``GL3xx`` — fusion eligibility explainer (``fusion_explain.py``)
   * ``GL4xx`` — sharding-plan lint (``shard_lint.py``)
   * ``GL5xx`` — static memory-liveness / peak-HBM planner (``memory_plan.py``)
+  * ``GL6xx`` — graph-rewrite provenance verifier (``rewrite.py``)
 """
 from __future__ import annotations
 
@@ -99,6 +100,20 @@ CODES = {
               "predicted peak HBM per device exceeds the configured budget"),
     "GL502": (Severity.WARNING,
               "a single activation dominates the predicted memory peak"),
+    # --- graph-rewrite verifier (rewrite.py) -------------------------------
+    "GL601": (Severity.ERROR,
+              "rewrite changed an output's inferred shape/dtype (or the "
+              "argument interface)"),
+    "GL602": (Severity.ERROR,
+              "provenance gap: a rewritten node with no originating rule"),
+    "GL603": (Severity.WARNING,
+              "rewrite pipeline did not reach a fixpoint within its round "
+              "budget"),
+    "GL604": (Severity.ERROR,
+              "rewrite-eliminated argument still referenced by a grad_req"),
+    "GL605": (Severity.INFO,
+              "rewrite summary: nodes folded/merged/removed with bytes-saved "
+              "estimates"),
 }
 
 
@@ -176,6 +191,10 @@ class Report:
         # machine consumer (parallel.autoplan, JSON) must never see a
         # truncated total. None when the shard_lint pass did not run.
         self.reshard_total_bytes: Optional[int] = None
+        # the GL6xx rewrite verifier's machine summary (nodes before/after,
+        # per-action counts, bytes-saved estimate) — set by
+        # rewrite.verify_rewrite; the GL605 diagnostic is its human line
+        self.rewrite_summary: Optional[dict] = None
 
     def add(self, diag: Diagnostic):
         self.diagnostics.append(diag)
@@ -236,4 +255,6 @@ class Report:
             payload["memory_plan"] = self.memory_plan
         if self.reshard_total_bytes is not None:
             payload["reshard_total_bytes"] = self.reshard_total_bytes
+        if self.rewrite_summary is not None:
+            payload["rewrite_summary"] = self.rewrite_summary
         return json.dumps(payload, indent=2)

@@ -71,6 +71,16 @@ class _GraphProgram:
             # fusion.gate_pattern_explain).
             self._infer_fusion = bool(self._fusion_plan) \
                 and _fusion.infer_default()
+        # the plan's per-pattern site inventory, computed ONCE here — the
+        # serving cache, health probes and the graphlint --rewrite dump all
+        # read this instead of re-walking the directive map per call
+        if self._fusion_plan:
+            from . import fusion as _fusion
+
+            self.pattern_sites, self.conv_bn_directives = \
+                _fusion.plan_sites(self._fusion_plan)
+        else:
+            self.pattern_sites, self.conv_bn_directives = {}, 0
         # PlaceDevice-pass analogue (reference: graph_executor.cc:242
         # AssignContext → nnvm PlaceDevice inserting _CrossDeviceCopy): map
         # each node carrying a __ctx_group__ attr to its concrete device;
@@ -501,7 +511,11 @@ class Executor:
                 new_grads.append(zeros(na.shape, ctx=self._ctx, dtype=garr.dtype) if changed else garr)
         for name, arr, shape in zip(self._prog.aux_names, self.aux_arrays, aux_shapes):
             new_aux.append(_renew(arr, shape, name)[0])
-        return Executor(self._symbol, self._ctx, new_args, new_grads, self._grad_req, new_aux, program=self._prog)
+        exe = Executor(self._symbol, self._ctx, new_args, new_grads, self._grad_req, new_aux, program=self._prog)
+        # keep the pre-rewrite symbol identity: a reshaped executor handed
+        # to bind(shared_exec=...) must still match the user's symbol
+        exe._orig_symbol = getattr(self, "_orig_symbol", self._symbol)
+        return exe
 
     def set_monitor_callback(self, callback):
         self._monitor_callback = callback
@@ -545,11 +559,46 @@ def _lint_at_bind(symbol, arg_arrays, arg_names, aux_arrays, aux_names,
     lint_bind(symbol, shapes, types, mode, target="bind", train=train)
 
 
+def _rewrite_at_bind(symbol, args, grad_req, aux_states):
+    """MXNET_GRAPHREWRITE=on|verify hook: run the Symbol→Symbol rewrite
+    pipeline (analysis/rewrite.py — const fold, CSE, canonicalize, DCE,
+    optional bf16 legalization) with the concrete bind shapes/dtypes and
+    bind the REWRITTEN graph. Under ``verify`` the GL6xx provenance
+    verifier gates the result (GL601/602/604 raise). Any failure falls
+    back to the original symbol — a rewrite must never sink a bind."""
+    from .analysis.rewrite import graphrewrite_mode, rewrite_for_bind
+
+    if graphrewrite_mode() is None:
+        return symbol
+    shapes, types = {}, {}
+    named = (dict(args) if isinstance(args, dict)
+             else dict(zip(symbol.list_arguments(), args or [])))
+    if isinstance(aux_states, dict):
+        named.update(aux_states)
+    elif aux_states:
+        named.update(zip(symbol.list_auxiliary_states(), aux_states))
+    for n, a in named.items():
+        if a is not None:
+            shapes[n] = tuple(a.shape)
+            types[n] = np.dtype(a.dtype)
+    return rewrite_for_bind(symbol, shapes, types, grad_req=grad_req,
+                            target="bind")[0]
+
+
 def bind(symbol, ctx, args, args_grad=None, grad_req="write", aux_states=None, shared_exec=None, group2ctx=None):
     """Bind NDArrays to a symbol's arguments (reference: symbol.py:917 bind →
     Executor::Bind, graph_executor.cc:936)."""
     if _tm.enabled():
         _tm.counter("executor.bind").inc()
+    orig_symbol = symbol
+    if shared_exec is not None and (
+            shared_exec._symbol is symbol
+            or getattr(shared_exec, "_orig_symbol", None) is symbol):
+        # reuse the shared program's (possibly rewritten) symbol so the
+        # jit cache and fusion plan carry over (reshape/bucketing path)
+        symbol = shared_exec._symbol
+    else:
+        symbol = _rewrite_at_bind(symbol, args, grad_req, aux_states)
     with _tm.span("executor.bind", symbol=symbol.name,
                   shared=shared_exec is not None):
         if shared_exec is not None and shared_exec._symbol is symbol \
@@ -598,7 +647,11 @@ def bind(symbol, ctx, args, args_grad=None, grad_req="write", aux_states=None, s
     _lint_at_bind(symbol, arg_arrays, arg_names, aux_arrays, aux_names,
                   train=any(r != "null" and g is not None
                             for r, g in zip(reqs, grad_arrays)))
-    return Executor(symbol, ctx, arg_arrays, grad_arrays, reqs, aux_arrays, program=prog)
+    exe = Executor(symbol, ctx, arg_arrays, grad_arrays, reqs, aux_arrays, program=prog)
+    # the caller's symbol, pre-rewrite: reshape()/shared_exec identity
+    # checks and debugging compare against what the user actually built
+    exe._orig_symbol = orig_symbol
+    return exe
 
 
 def simple_bind(symbol, ctx, grad_req="write", type_dict=None, group2ctx=None, shared_exec=None, **kwargs):

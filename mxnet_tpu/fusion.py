@@ -58,10 +58,10 @@ from .ops.pallas_conv_bn import (_xla_conv, conv_block, conv_block_infer,
                                  supported)
 from . import telemetry as _tm
 
-__all__ = ["plan", "execute", "resolve", "gate", "gate_explain", "bwd_mode",
-           "conv_reject_reason", "bn_reject_reason", "infer_default",
-           "quant_mode", "enabled_patterns", "gate_pattern_explain",
-           "CONV_BN_KINDS"]
+__all__ = ["plan", "plan_sites", "execute", "resolve", "gate",
+           "gate_explain", "bwd_mode", "conv_reject_reason",
+           "bn_reject_reason", "infer_default", "quant_mode",
+           "enabled_patterns", "gate_pattern_explain", "CONV_BN_KINDS"]
 
 #: directive kinds owned by the conv+BN machinery — the executor masks these
 #: (only) on inference executions where ``infer_default()`` declined, keeping
@@ -272,7 +272,12 @@ def enabled_patterns(infer=False):
     ``MXNET_FUSED_PATTERNS_INFER`` is set it overrides the training map on
     inference executions only (same grammar), so a serving fleet can pin
     its own pattern set — e.g. disable a pattern whose inference shapes
-    were never tuned — without touching training behavior."""
+    were never tuned — without touching training behavior.
+
+    The parse is memoized on the raw env string (the faultinject idiom):
+    the per-site gate consults this map on every pattern execution during
+    trace, so re-splitting the grammar there would be pure overhead.
+    Callers get a fresh copy — ``plan()`` mutates its map."""
     from .ops.fusion_patterns import pattern_names
 
     names = pattern_names()
@@ -280,6 +285,15 @@ def enabled_patterns(infer=False):
     if infer:
         env = os.environ.get("MXNET_FUSED_PATTERNS_INFER",
                              env).strip().lower() or env
+    cached = _patterns_env_memo.get(env)
+    if cached is not None:
+        return dict(cached)
+    modes = _parse_patterns_env(env, names)
+    _patterns_env_memo[env] = modes
+    return dict(modes)
+
+
+def _parse_patterns_env(env, names):
     if env in ("", "auto", "all", "1"):
         return {n: "auto" for n in names}
     if env in ("0", "off", "none"):
@@ -308,6 +322,23 @@ def enabled_patterns(infer=False):
 
 
 _warned_patterns_env = False
+_patterns_env_memo = {}
+
+
+def plan_sites(directives):
+    """Static per-pattern site inventory of one fusion plan:
+    ``(pattern_sites, conv_bn_directive_count)``. Computed ONCE per bound
+    program (``_GraphProgram.pattern_sites``) — consumers (serving cache,
+    health probes, the graphlint --rewrite dump) read the cached inventory
+    instead of re-walking the directive map."""
+    sites, conv_bn = {}, 0
+    for d in directives.values():
+        if d["kind"] == "pattern":
+            name = d["pat"].name
+            sites[name] = sites.get(name, 0) + 1
+        elif d["kind"] != "lazy":
+            conv_bn += 1
+    return sites, conv_bn
 
 
 class _PlanCtx:

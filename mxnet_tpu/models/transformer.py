@@ -11,9 +11,21 @@ from .. import symbol as sym
 
 
 def _layer_norm(x, name, dim):
+    # Deliberately the naive frontend composition: the variance branch
+    # recomputes its own mean/centering, and the square is spelled as a
+    # self-multiply. Bit-identical to the canonical single-chain form (XLA
+    # CSEs the duplicates; x*x IS jnp.square), but the norm_residual fusion
+    # matcher cannot root it until the bind-time rewrite pipeline
+    # (MXNET_GRAPHREWRITE: cse merges the duplicate mean/center,
+    # canonicalize turns the self-multiply into square) normalizes it —
+    # the sloppy-frontend contract docs/static_analysis.md §GL6xx gates.
+    # Default-config perf is unaffected: pattern sites only ENGAGE via the
+    # opt-in autotuner (MXNET_FUSION_TUNE_DIR) or a force, and a tuned
+    # deployment turns rewrites on alongside it.
     mean = sym.mean(x, axis=-1, keepdims=True)
     cent = sym.broadcast_sub(x, mean, name="%s_cent" % name)
-    var = sym.mean(sym.square(cent), axis=-1, keepdims=True)
+    cent_v = sym.broadcast_sub(x, sym.mean(x, axis=-1, keepdims=True))
+    var = sym.mean(cent_v * cent_v, axis=-1, keepdims=True)
     inv = sym.rsqrt(var + 1e-5)
     normed = sym.broadcast_mul(cent, inv)
     gamma = sym.Variable("%s_gamma" % name, shape=(dim,))

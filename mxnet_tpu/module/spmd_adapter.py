@@ -38,7 +38,7 @@ class SPMDStepAdapter:
         self._data_names = list(module._data_names)
         self._label_names = list(module._label_names)
         self.trainer = SPMDTrainer(
-            module._symbol,
+            self._rewrite_symbol(module),
             mesh,
             data_names=tuple(self._data_names),
             label_names=tuple(self._label_names),
@@ -57,6 +57,33 @@ class SPMDStepAdapter:
             self.adopt_params(module._arg_params, module._aux_params)
         self._lint_plan(module)
 
+    @staticmethod
+    def _bind_hints(module):
+        """The module's concrete bind shapes/dtypes — one derivation shared
+        by the rewrite hook and the lint hook."""
+        shapes, types = {}, {}
+        for desc in list(module._data_shapes or []) + list(
+                module._label_shapes or []):
+            name, shape = desc[0], desc[1]
+            shapes[name] = tuple(shape)
+            dt = getattr(desc, "dtype", None)
+            if dt is not None:
+                types[name] = np.dtype(dt)
+        return shapes, types
+
+    def _rewrite_symbol(self, module):
+        """MXNET_GRAPHREWRITE hook on the fused-step bind path: the SPMD
+        trainer compiles the REWRITTEN graph (weight names are preserved by
+        contract, so params/checkpoints/kvstore keys are unaffected). Same
+        verify/fallback semantics as ``executor.bind``."""
+        from ..analysis.rewrite import graphrewrite_mode, rewrite_for_bind
+
+        if graphrewrite_mode() is None:
+            return module._symbol
+        shapes, types = self._bind_hints(module)
+        return rewrite_for_bind(module._symbol, shapes, types,
+                                grad_req="write", target="spmd_bind")[0]
+
     def _lint_plan(self, module):
         """MXNET_GRAPHLINT hook on the fused-step bind path. Unlike the
         single-device ``executor.bind`` lint, this one hands the passes the
@@ -68,14 +95,7 @@ class SPMDStepAdapter:
         mode = graphlint_mode()
         if mode is None:
             return
-        shapes, types = {}, {}
-        for desc in list(module._data_shapes or []) + list(
-                module._label_shapes or []):
-            name, shape = desc[0], desc[1]
-            shapes[name] = tuple(shape)
-            dt = getattr(desc, "dtype", None)
-            if dt is not None:
-                types[name] = np.dtype(dt)
+        shapes, types = self._bind_hints(module)
         lint_bind(self.trainer.symbol, shapes, types, mode,
                   target="spmd_bind", mesh=self.trainer.mesh,
                   rules=self.trainer.rules, train=True)

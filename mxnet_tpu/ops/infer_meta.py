@@ -73,10 +73,11 @@ EMBEDDING_RULES = ("embedding", "row_sparse_embedding")
 
 class OpMeta:
     __slots__ = ("name", "input_ranks", "dtype_policy", "param_slots",
-                 "shard_rule")
+                 "shard_rule", "bf16_slots")
 
     def __init__(self, name: str, input_ranks=None, dtype_policy: str = "promote",
-                 param_slots: Tuple[str, ...] = (), shard_rule: str = "batch0"):
+                 param_slots: Tuple[str, ...] = (), shard_rule: str = "batch0",
+                 bf16_slots: Tuple[str, ...] = ()):
         self.name = name
         self.input_ranks: Dict[str, Tuple[int, int]] = {
             slot: rank_range(r) for slot, r in (input_ranks or {}).items()
@@ -87,6 +88,12 @@ class OpMeta:
             raise ValueError("unknown shard_rule %r for op %r (have: %s)"
                              % (shard_rule, name, SHARD_RULES))
         self.shard_rule = shard_rule
+        # input slots the bf16-legalization rewrite pass may cast to
+        # bfloat16 (analysis/rewrite.py): the MXU-bound operands of ops
+        # whose f32 accumulate makes reduced-precision inputs safe. Empty =
+        # the op is never legalized. Every listed slot is cast together
+        # (a bf16 data against an f32 bias would just promote back).
+        self.bf16_slots = tuple(bf16_slots)
 
 
 _META: Dict[str, OpMeta] = {}
@@ -95,9 +102,11 @@ _DEFAULT = OpMeta("<default>")
 
 
 def register_meta(name, input_ranks=None, dtype_policy="promote",
-                  param_slots=(), aliases=(), shard_rule="batch0"):
+                  param_slots=(), aliases=(), shard_rule="batch0",
+                  bf16_slots=()):
     meta = OpMeta(name, input_ranks=input_ranks, dtype_policy=dtype_policy,
-                  param_slots=param_slots, shard_rule=shard_rule)
+                  param_slots=param_slots, shard_rule=shard_rule,
+                  bf16_slots=bf16_slots)
     for n in (name,) + tuple(aliases):
         _META[n] = meta
     return meta
@@ -122,13 +131,16 @@ def backward_shape_rule(op_name: str):
 # ---------------------------------------------------------------------------
 register_meta("Convolution",
               input_ranks={"data": 4, "weight": 4, "bias": 1},
-              param_slots=("weight", "bias"), shard_rule="conv")
+              param_slots=("weight", "bias"), shard_rule="conv",
+              bf16_slots=("data", "weight", "bias"))
 register_meta("Deconvolution",
               input_ranks={"data": 4, "weight": 4, "bias": 1},
-              param_slots=("weight", "bias"), shard_rule="conv")
+              param_slots=("weight", "bias"), shard_rule="conv",
+              bf16_slots=("data", "weight", "bias"))
 register_meta("FullyConnected",
               input_ranks={"data": (1, None), "weight": 2, "bias": 1},
-              param_slots=("weight", "bias"), shard_rule="fc")
+              param_slots=("weight", "bias"), shard_rule="fc",
+              bf16_slots=("data", "weight", "bias"))
 register_meta("BatchNorm",
               input_ranks={"data": (2, 5), "gamma": 1, "beta": 1,
                            "moving_mean": 1, "moving_var": 1},
@@ -182,9 +194,9 @@ register_meta("MakeLoss", dtype_policy="first", shard_rule="elementwise")
 register_meta("BlockGrad", dtype_policy="first", shard_rule="elementwise")
 register_meta("Concat", dtype_policy="promote", shard_rule="concat")
 register_meta("batch_dot", input_ranks={"lhs": 3, "rhs": 3},
-              shard_rule="batch_dot")
+              shard_rule="batch_dot", bf16_slots=("lhs", "rhs"))
 register_meta("dot", input_ranks={"lhs": (1, 2), "rhs": (1, 2)},
-              shard_rule="dot")
+              shard_rule="dot", bf16_slots=("lhs", "rhs"))
 
 # elementwise binaries/unaries preserve every input dim, so they preserve
 # the full PartitionSpec, not just the batch dim (the "batch0" default);

@@ -425,6 +425,26 @@ def _arange(attrs):
     return out
 
 
+@register(
+    "_graph_const",
+    attrs={
+        # raw little-endian bytes of the folded value — bytes are hashable,
+        # so the node freezes cleanly into symbol._eval_node_shape's cache
+        # key (an ndarray attr would not)
+        "data": AttrSpec("any", required=True),
+        "shape": AttrSpec("shape", default=()),
+        "dtype": AttrSpec("dtype", default=np.float32),
+    },
+    input_names=(),
+)
+def _graph_const(attrs):
+    """A constant materialized by the graph-rewrite constant-folding pass
+    (analysis/rewrite.py): the one-time host-side evaluation of a subgraph
+    whose leaves were all init ops. Never written by frontends directly."""
+    arr = np.frombuffer(attrs["data"], dtype=attrs["dtype"])
+    return jnp.asarray(arr.reshape(attrs["shape"]))
+
+
 @register("zeros_like")
 def _zeros_like(attrs, data):
     return jnp.zeros_like(data)

@@ -96,17 +96,12 @@ def _plan_pattern_sites(exe):
     site counts, conv+BN directive count, and whether the conv+BN plan is
     ACTIVE at inference — what a serving operator needs to know about the
     fusion surface of a warmed bucket (per-site engage decisions land on
-    the ``fusion.pattern_*`` counters and trace events)."""
+    the ``fusion.pattern_*`` counters and trace events). Reads the
+    inventory the program computed once at plan time
+    (``_GraphProgram.pattern_sites``) — never re-walks the directive map."""
     try:
-        plan = exe._prog._fusion_plan
-        sites, conv_bn = {}, 0
-        for d in plan.values():
-            if d["kind"] == "pattern":
-                name = d["pat"].name
-                sites[name] = sites.get(name, 0) + 1
-            elif d["kind"] != "lazy":
-                conv_bn += 1
-        return {"pattern_sites": sites, "conv_bn_directives": conv_bn,
+        return {"pattern_sites": dict(exe._prog.pattern_sites),
+                "conv_bn_directives": exe._prog.conv_bn_directives,
                 "conv_bn_infer_active": bool(exe._prog._infer_fusion)}
     except Exception:  # observability must never sink a warmup
         return {}

@@ -1,0 +1,110 @@
+#!/usr/bin/env python
+"""Bit-parity gate for the graph-rewrite pipeline (ISSUE 14 / CI).
+
+For each representative model, build the symbol, run the rewrite pipeline
+(analysis/rewrite.py) + the GL6xx verifier, then run an identical
+forward+backward on the RAW and the REWRITTEN graph (same params, same
+seed) and compare:
+
+* forward outputs must be BITWISE identical (the fold/CSE/DCE/canonicalize
+  contract — every rule preserves the compiled computation);
+* backward gradients must be bitwise identical when no CSE merge fired,
+  and within atol 1e-6 when one did (the vjp of a merged graph sums
+  cotangents in a different order than the duplicated one — single-ulp
+  reassociation, documented in docs/static_analysis.md §GL6xx).
+
+Exit 0 on full parity + zero GL601/GL602/GL604, 1 otherwise. Run by
+tools/ci_check.sh alongside the `graphlint --all-models --rewrite` sweep.
+"""
+import os
+import sys
+
+sys.path.insert(0, os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                                "..", ".."))
+os.environ.setdefault("MXNET_DEFAULT_CONTEXT", "cpu")
+
+import numpy as np  # noqa: E402
+
+import mxnet_tpu as mx  # noqa: E402
+from mxnet_tpu import analysis  # noqa: E402
+
+# (label, builder kwargs, bind shapes, bind dtypes)
+MODELS = [
+    ("mlp", ("mlp", {"num_classes": 10}),
+     {"data": (8, 784), "softmax_label": (8,)}, {}),
+    ("resnet-18", ("resnet-18", {"num_classes": 10,
+                                 "image_shape": "3,32,32"}),
+     {"data": (2, 3, 32, 32), "softmax_label": (2,)}, {}),
+    ("transformer", ("transformer", {"vocab_size": 50, "model_dim": 32,
+                                     "num_heads": 2, "num_layers": 2,
+                                     "ffn_dim": 64, "seq_len": 8}),
+     {"data": (2, 8), "softmax_label": (2, 8)}, {"data": "int32"}),
+]
+
+
+def run_once(sym, shapes, types, seed=1):
+    mx.random.seed(7)
+    ex = sym.simple_bind(mx.cpu(), type_dict=dict(types), grad_req="write",
+                         **shapes)
+    rs = np.random.RandomState(seed)
+    for n, a in zip(ex._prog.arg_names, ex.arg_arrays):
+        if np.issubdtype(np.dtype(a.dtype), np.integer):
+            a[:] = rs.randint(0, 50, a.shape).astype(a.dtype)
+        elif "label" in n:
+            a[:] = rs.randint(0, 10, a.shape).astype(a.dtype)
+        else:
+            a[:] = rs.uniform(-0.1, 0.1, a.shape).astype(a.dtype)
+    ex.forward(is_train=True)
+    ex.backward()
+    outs = [o.asnumpy() for o in ex.outputs]
+    grads = {n: g.asnumpy() for n, g in zip(ex._prog.arg_names,
+                                            ex.grad_arrays)
+             if g is not None}
+    return outs, grads
+
+
+def main():
+    failed = False
+    for label, (zoo, kw), shapes, types in MODELS:
+        sym = mx.models.get_symbol(zoo, **kw)
+        res = analysis.rewrite(sym, shapes=shapes, types=types, label=label)
+        report = analysis.verify_rewrite(res, grad_req="write",
+                                         target=label)
+        hard = [d for d in report.errors
+                if d.code in ("GL601", "GL602", "GL604")]
+        if hard:
+            print("[%s] VERIFY FAILED:\n%s" % (label, report.format()))
+            failed = True
+            continue
+        o_raw, g_raw = run_once(sym, shapes, types)
+        o_rw, g_rw = run_once(res.symbol, shapes, types)
+        fwd_ok = all(np.array_equal(a, b) for a, b in zip(o_raw, o_rw))
+        cse_fired = res.counts["merged"] > 0
+        bwd_max = 0.0
+        bwd_ok = True
+        for k, ga in g_raw.items():
+            gb = g_rw[k]
+            if cse_fired or "rsqrt_compose" in res.rule_table():
+                d = float(np.max(np.abs(ga - gb))) if ga.size else 0.0
+                bwd_max = max(bwd_max, d)
+                bwd_ok = bwd_ok and d <= 1e-6
+            else:
+                bwd_ok = bwd_ok and np.array_equal(ga, gb)
+        verdict = "OK" if (fwd_ok and bwd_ok) else "FAIL"
+        if verdict == "FAIL":
+            failed = True
+        print("[%s] nodes %d->%d (%d merged, %d removed) fwd_bitwise=%s "
+              "bwd_%s=%s (max %.2e) %s"
+              % (label, res.nodes_before, res.nodes_after,
+                 res.counts["merged"], res.counts["removed"], fwd_ok,
+                 "atol1e-6" if cse_fired else "bitwise", bwd_ok, bwd_max,
+                 verdict))
+    if failed:
+        print("rewrite parity gate FAILED")
+        return 1
+    print("rewrite parity gate OK")
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

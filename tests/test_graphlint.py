@@ -450,8 +450,176 @@ def test_shipped_python_engine_passes_discipline_shim():
     assert "GL105" not in analyze_trace(e.trace).codes()
 
 
+# --------------------------------------------------------------------------
+# GL6xx: graph-rewrite verifier codes (analysis/rewrite.py). These come from
+# verify_rewrite over a RewriteResult, not from lint() — each case returns
+# the code set the verifier produced. Deliberately-buggy custom passes
+# exercise the contract a correct pass must uphold.
+# --------------------------------------------------------------------------
+def _rw_codes(sym, passes=None, grad_req=None, max_rounds=None, shapes=None,
+              types=None):
+    res = analysis.rewrite(sym, shapes=shapes, types=types, passes=passes,
+                           max_rounds=max_rounds)
+    return set(analysis.verify_rewrite(res, grad_req=grad_req).codes())
+
+
+class _OncePass(analysis.RewritePass):
+    """Base for the buggy test passes: fires exactly once."""
+
+    def __init__(self):
+        self._done = False
+
+    def run(self, g):
+        if self._done:
+            return 0
+        self._done = True
+        return self._fire(g)
+
+
+class _ShapeBreakingPass(_OncePass):
+    """Replaces the output with its whole-array sum — shape drift."""
+
+    name = "badshape"
+
+    def _fire(self, g):
+        node, oi = g.outputs[0]
+        new = g.new_node("sum", node.name + "_collapsed", {}, [(node, oi)])
+        g.outputs[0] = (new, 0)
+        g.note(self.name, "collapse", "replace", node=new.name,
+               origins=[node.name])
+        g.invalidate()
+        return 1
+
+
+class _NoProvenancePass(_OncePass):
+    """Inserts an identity node but never notes it — a provenance gap."""
+
+    name = "noprov"
+
+    def _fire(self, g):
+        node, oi = g.outputs[0]
+        new = g.new_node("_copy", node.name + "_id", {}, [(node, oi)])
+        g.outputs[0] = (new, 0)
+        g.invalidate()
+        return 1
+
+
+class _NeverConvergesPass(analysis.RewritePass):
+    """Claims a firing every round without changing the graph."""
+
+    name = "pingpong"
+
+    def run(self, g):
+        return 1
+
+
+class _ArgDroppingPass(_OncePass):
+    """Replaces the output with a literal of the same shape/dtype — every
+    argument becomes unreachable while shapes/dtypes stay intact."""
+
+    name = "argdrop"
+
+    def _fire(self, g):
+        import numpy as _np
+
+        arr = _np.zeros((2,), "float32")
+        lit = g.new_node("_graph_const", "lit",
+                         {"data": arr.tobytes(), "shape": (2,),
+                          "dtype": "float32"}, [])
+        g.outputs[0] = (lit, 0)
+        g.note(self.name, "drop", "replace", node=lit.name,
+               origins=[g.topo()[0].name])
+        g.invalidate()
+        return 1
+
+
+def _scalar_chain():
+    return mx.sym.Variable("x") * 2.0, {"shapes": {"x": (2,)}}
+
+
+def _gl601_broken_rw():
+    sym, kw = _scalar_chain()
+    return _rw_codes(sym, passes=[_ShapeBreakingPass()], **kw)
+
+
+def _gl601_clean_rw():
+    sym, kw = _scalar_chain()
+    return _rw_codes(sym, **kw)
+
+
+def _gl602_broken_rw():
+    sym, kw = _scalar_chain()
+    return _rw_codes(sym, passes=[_NoProvenancePass()], **kw)
+
+
+def _gl602_clean_rw():
+    # the builtin pipeline notes every node it creates
+    d = mx.sym.Variable("data")
+    net = mx.sym.Activation(d * d, act_type="relu")  # fires canonicalize
+    return _rw_codes(net, shapes={"data": (2, 3)})
+
+
+def _gl603_broken_rw():
+    sym, kw = _scalar_chain()
+    return _rw_codes(sym, passes=[_NeverConvergesPass()], max_rounds=2,
+                     **kw)
+
+
+def _gl603_clean_rw():
+    net = mx.models.get_symbol("transformer", vocab_size=20, model_dim=16,
+                               num_heads=2, num_layers=1, ffn_dim=16,
+                               seq_len=4)
+    return _rw_codes(net)  # real multi-pass run converges in budget
+
+
+def _gl604_broken_rw():
+    sym, kw = _scalar_chain()
+    return _rw_codes(sym, passes=[_ArgDroppingPass()], grad_req="write",
+                     **kw)
+
+
+def _gl604_clean_rw():
+    sym, kw = _scalar_chain()
+    return _rw_codes(sym, passes=[_ArgDroppingPass()], grad_req="null",
+                     **kw)
+
+
+def _gl605_broken_rw():
+    # "broken" here = the summary fires whenever the pipeline changed
+    # anything: a graph with a common subexpression
+    a, b = mx.sym.Variable("a"), mx.sym.Variable("b")
+    net = (a + b) * (a + b)
+    return _rw_codes(net, shapes={"a": (2,), "b": (2,)})
+
+
+def _gl605_clean_rw():
+    # an already-canonical graph: zero records, no summary
+    return _rw_codes(mx.models.get_symbol("mlp", num_classes=10),
+                     shapes={"data": (2, 784)})
+
+
+REWRITE_CODE_CASES = {
+    "GL601": (_gl601_broken_rw, _gl601_clean_rw),
+    "GL602": (_gl602_broken_rw, _gl602_clean_rw),
+    "GL603": (_gl603_broken_rw, _gl603_clean_rw),
+    "GL604": (_gl604_broken_rw, _gl604_clean_rw),
+    "GL605": (_gl605_broken_rw, _gl605_clean_rw),
+}
+
+
+@pytest.mark.parametrize("code", sorted(REWRITE_CODE_CASES))
+def test_rewrite_code_triggers_on_broken_rewrite(code):
+    assert code in REWRITE_CODE_CASES[code][0]()
+
+
+@pytest.mark.parametrize("code", sorted(REWRITE_CODE_CASES))
+def test_rewrite_code_silent_on_clean_rewrite(code):
+    assert code not in REWRITE_CODE_CASES[code][1]()
+
+
 def test_every_diagnostic_code_is_tested():
-    covered = set(GRAPH_CODE_CASES) | set(ENGINE_CODE_CASES) | {"GL105"}
+    covered = (set(GRAPH_CODE_CASES) | set(ENGINE_CODE_CASES) | {"GL105"}
+               | set(REWRITE_CODE_CASES))
     assert covered == set(CODES), (
         "codes missing a trigger/clean test pair: %s; stale test entries: %s"
         % (sorted(set(CODES) - covered), sorted(covered - set(CODES))))

@@ -623,6 +623,7 @@ def test_multisample_moments(opname):
 # the dedicated suite that exercises it. Pointers are validated: the file
 # must exist and mention the op.
 EXEMPT = {
+    "_graph_const": "tests/test_graph_rewrite.py",
     "Activation": "tests/test_operator.py",
     "BatchNorm": "tests/test_operator.py",
     "BilinearSampler": "tests/test_vision.py",

@@ -6,7 +6,10 @@
 #
 # Step 1 dogfoods the graphlint subsystem on every bundled model (the
 # acceptance gate: every model must lint with zero error-severity
-# diagnostics). Step 2 lints the package sources with ruff or pyflakes when
+# diagnostics), then runs the graph-rewrite gate: the zoo sweep under
+# MXNET_GRAPHREWRITE=verify (zero GL601/602/604, transformer node-count
+# reduction + strictly more norm_residual fusion sites) and the 3-model
+# raw-vs-rewritten bit-parity subcheck (tests/nightly/rewrite_parity.py). Step 2 lints the package sources with ruff or pyflakes when
 # one is installed (the container image may ship neither; the dependency-free
 # floor — every source compiles — is enforced by
 # tests/test_graphlint.py::test_package_sources_compile either way).
@@ -112,6 +115,50 @@ print("autoplan sweep OK: %d models planned (%d pipelined); transformer "
       % (len(payload), n_pipe, chosen / 2**20, naive / 2**20))
 PYEOF
 rm -f "$AUTOPLAN_SWEEP"
+# graph-rewrite gate (docs/static_analysis.md §GL6xx): the whole zoo must
+# rewrite + verify under MXNET_GRAPHREWRITE=verify with ZERO GL601/602/604,
+# and the transformer must show real gains — nodes merged/removed > 0 AND
+# strictly more norm_residual fusion sites after canonicalization (the
+# sloppy-frontend LN contract, models/transformer.py). The JSON dump is
+# the committed CI record of the per-model rewrite plans.
+REWRITE_SWEEP="$(mktemp /tmp/graphlint_rewrite_ci.XXXXXX.json)"
+JAX_PLATFORMS=cpu MXNET_GRAPHREWRITE=verify \
+python tools/graphlint --all-models --rewrite --format json \
+    > "$REWRITE_SWEEP" \
+    || { echo "graphlint rewrite sweep FAILED"; rm -f "$REWRITE_SWEEP"; exit 1; }
+python - "$REWRITE_SWEEP" <<'PYEOF' || { echo "rewrite sweep gate FAILED"; rm -f "$REWRITE_SWEEP"; exit 1; }
+import json, sys
+payload = json.load(open(sys.argv[1]))
+assert payload, "empty rewrite sweep"
+bad = []
+for entry in payload:
+    if "rewrite" not in entry:
+        bad.append("%s: %s" % (entry["target"],
+                               entry.get("rewrite_error")
+                               or entry.get("load_error")))
+        continue
+    codes = [d["code"] for d in entry["verify"]["diagnostics"]
+             if d["code"] in ("GL601", "GL602", "GL604")]
+    if codes:
+        bad.append("%s: %s" % (entry["target"], codes))
+assert not bad, "rewrite verify errors: %s" % "; ".join(bad)
+tf = next(e for e in payload if e["target"] == "transformer")
+c = tf["rewrite"]["counts"]
+assert c["merged"] + c["removed"] + c["folded"] > 0, c
+before = tf["fusion_sites_before"].get("norm_residual", 0)
+after = tf["fusion_sites_after"].get("norm_residual", 0)
+assert after > before, "norm_residual sites %d -> %d" % (before, after)
+print("rewrite sweep OK: %d models verified; transformer %d->%d nodes, "
+      "norm_residual sites %d->%d"
+      % (len(payload), tf["rewrite"]["nodes_before"],
+         tf["rewrite"]["nodes_after"], before, after))
+PYEOF
+rm -f "$REWRITE_SWEEP"
+# bit-parity subcheck on 3 representative models: forward must be BITWISE
+# identical raw-vs-rewritten, backward bitwise (atol 1e-6 where CSE's
+# cotangent reassociation applies) — docs/static_analysis.md §GL6xx
+JAX_PLATFORMS=cpu python tests/nightly/rewrite_parity.py \
+    || { echo "rewrite bit-parity gate FAILED"; exit 1; }
 
 echo "== [2/10] source lint (ruff/pyflakes if available) =="
 if command -v ruff >/dev/null 2>&1; then
