@@ -50,6 +50,59 @@ BASELINE_IMG_S = 109.0  # reference README.md:149-156, resnet-50, 1x K80, b32
 _TRAIN_FLOPS_PER_IMG = 3 * 4.09e9
 
 
+def _mfu_fields(flops_per_step, step_s, dev):
+    """Analytic-FLOPs MFU for one bench leg (docs/PERF.md §4/§15): the
+    model's training FLOPs per step (2×MACs fwd, ×3 for fwd+bwd+update)
+    over wall step time, as a fraction of the device's bf16 peak. Off-TPU
+    the peak is unknown, so ``mfu`` is None — but the achieved FLOP/s rate
+    still lands in the report, keeping the campaign's round-over-round
+    trajectory trackable on any fabric."""
+    from mxnet_tpu.device_info import bf16_peak_flops
+
+    out = {"model_flops_per_step": int(flops_per_step),
+           "model_tflops_per_s": round(flops_per_step / step_s / 1e12, 5)}
+    peak = (bf16_peak_flops(dev.device_kind)
+            if dev.platform not in ("cpu",) else None)
+    out["mfu"] = (round(flops_per_step / step_s / peak, 4)
+                  if peak else None)
+    return out
+
+
+def _transformer_train_flops(batch, seq, d, heads, layers, ffn, vocab):
+    """Per-step analytic training FLOPs of the decoder-only zoo
+    transformer: per token, the per-layer matmuls (qkv, proj, ffn up/down)
+    plus the attention score/apply contractions (counted dense — the
+    block-causal lowering computes ~half, which MFU deliberately does not
+    credit), plus the vocab head; ×3 for training."""
+    per_tok = layers * (2 * d * 3 * d       # qkv projection
+                        + 2 * d * d         # output projection
+                        + 2 * (d * ffn + ffn * d)   # ffn up + down
+                        + 4 * seq * d)      # scores (2TD) + apply (2TD)
+    per_tok += 2 * d * vocab                # lm head
+    return 3 * batch * seq * per_tok
+
+
+def _lstm_train_flops(batch, seq, hidden, embed, layers, vocab):
+    """PTB-config LSTM: per token, the 4-gate matmuls per layer (input dim
+    = embed for layer 0, hidden above) plus the vocab head; ×3 train."""
+    per_tok = 2 * 4 * hidden * (hidden + embed)
+    per_tok += (layers - 1) * 2 * 4 * hidden * (2 * hidden)
+    per_tok += 2 * hidden * vocab
+    return 3 * batch * seq * per_tok
+
+
+def _recommender_train_flops(batch, embed_dim=64, dense_dim=16,
+                             bottom=(128,), top=(512, 256)):
+    """DLRM-style two-tower click model (models/recommender.py defaults):
+    bottom MLP + top MLP matmuls per sample (embedding lookups move bytes,
+    not FLOPs); ×3 train."""
+    dims = (dense_dim,) + tuple(bottom) + (embed_dim,)
+    mac = sum(a * b for a, b in zip(dims, dims[1:]))
+    tdims = (3 * embed_dim + 1,) + tuple(top) + (1,)
+    mac += sum(a * b for a, b in zip(tdims, tdims[1:]))
+    return 3 * batch * 2 * mac
+
+
 # stderr markers that mean the backend is DEFINITIVELY absent (jax raised
 # cleanly, no tunnel involved): retrying cannot heal these, so the probe
 # stops at the first one instead of burning the whole retry budget —
@@ -274,6 +327,8 @@ def _bench_resnet50(on_tpu, models, parallel, dev):
     res = {"img_s": img_s, "batch": batch, "image": image,
            "step_ms": 1000 * batch / img_s,
            "flops_per_img": _TRAIN_FLOPS_PER_IMG * (image / 224.0) ** 2}
+    res.update(_mfu_fields(res["flops_per_img"] * batch,
+                           batch / img_s, dev))
     try:
         res["fused_conv_bn"] = _fused_report(
             batch, image, "bfloat16" if on_tpu else "float32")
@@ -352,8 +407,12 @@ def _bench_lstm(on_tpu, models, parallel, dev):
         outs = trainer.step(data, {"softmax_label": y})
     _sync(outs)
     dt = time.perf_counter() - t0
-    return {"tokens_per_s": batch * seq * n_steps / dt, "batch": batch,
-            "seq_len": seq, "step_ms": 1000 * dt / n_steps}
+    res = {"tokens_per_s": batch * seq * n_steps / dt, "batch": batch,
+           "seq_len": seq, "step_ms": 1000 * dt / n_steps}
+    res.update(_mfu_fields(
+        _lstm_train_flops(batch, seq, hidden, embed, layers, vocab),
+        dt / n_steps, dev))
+    return res
 
 
 def _bench_allreduce():
@@ -448,10 +507,11 @@ import json, os, sys, time
 import numpy as np
 sys.path.insert(0, sys.argv[1])
 os.environ.setdefault("MXNET_TELEMETRY", "counters")
-mode, tune_dir, steps = sys.argv[2], sys.argv[3], int(sys.argv[4])
+mode, dir_binary, dir_sched, steps = (sys.argv[2], sys.argv[3], sys.argv[4],
+                                      int(sys.argv[5]))
 os.environ["MXNET_FUSED_PATTERNS"] = "0"  # the off-arm bind comes first
 import mxnet_tpu as mx
-from mxnet_tpu import telemetry
+from mxnet_tpu import fusion_tune, telemetry
 
 B, T = 2, 512
 rs = np.random.RandomState(0)
@@ -468,7 +528,7 @@ def build():
     exe.arg_dict["data"][:] = rs.randint(1, 1000, (B, T)).astype("float32")
     exe.arg_dict["softmax_label"][:] = \
         rs.randint(1, 1000, (B, T)).astype("float32")
-    for _ in range(2):  # compile (+ tuning, on the engine arm) + warmup
+    for _ in range(2):  # compile (+ tuning, on the engine arms) + warmup
         outs = exe.forward_backward()
     np.asarray(outs[0].asnumpy())
     return exe
@@ -476,30 +536,39 @@ def build():
 
 if mode == "cold":
     # cold-tune arm: engine on, empty cache — the first trace measures
-    # each pattern site and persists the verdicts
+    # each pattern site and persists the verdicts. The parent sets
+    # MXNET_FUSION_TUNE_SCHEDULES per arm (0 = PR 9 binary verdicts,
+    # default = schedule search); dir_binary carries the arm's cache dir.
     os.environ["MXNET_FUSED_PATTERNS"] = "auto"
-    os.environ["MXNET_FUSION_TUNE_DIR"] = tune_dir
+    os.environ["MXNET_FUSION_TUNE_DIR"] = dir_binary
     build()
     print(json.dumps({"fusion_bench": 1, "mode": mode,
                       "tunes": telemetry.counter("fusion.tune").value}),
           flush=True)
     raise SystemExit(0)
 
-# A/B arm (warm cache): bind BOTH executors in one process — the engine-off
-# bind first (env above), then the engine-on bind against the warmed cache —
-# and time them in interleaved blocks so host-speed drift hits both arms
-# equally (the checkpoint leg's ABBA discipline)
+# A/B arm (warm caches): THREE executors in one process — engine off, the
+# PR 9 binary-verdict engine (warm cache tuned with SCHEDULES=0), and the
+# schedule-search engine (warm cache tuned with the schedule fan-out) —
+# timed in interleaved blocks so host-speed drift hits every arm equally
+# (the checkpoint leg's ABBA discipline)
 exe_off = build()
 os.environ["MXNET_FUSED_PATTERNS"] = "auto"
-os.environ["MXNET_FUSION_TUNE_DIR"] = tune_dir
-exe_on = build()
+os.environ["MXNET_FUSION_TUNE_SCHEDULES"] = "0"
+os.environ["MXNET_FUSION_TUNE_DIR"] = dir_binary
+exe_bin = build()
+fusion_tune.reset()  # drop the in-process memo: next bind reads dir_sched
+del os.environ["MXNET_FUSION_TUNE_SCHEDULES"]
+os.environ["MXNET_FUSION_TUNE_DIR"] = dir_sched
+exe_sched = build()
 tunes_warmup = telemetry.counter("fusion.tune").value
 pre = dict(telemetry.counters())
 
 BLOCK, ROUNDS = max(1, steps // 4), 4
-times = {"off": [], "on": []}
+times = {"off": [], "binary": [], "sched": []}
 for _ in range(ROUNDS):
-    for arm, exe in (("off", exe_off), ("on", exe_on)):
+    for arm, exe in (("off", exe_off), ("binary", exe_bin),
+                     ("sched", exe_sched)):
         t0 = time.perf_counter()
         for _ in range(BLOCK):
             outs = exe.forward_backward()
@@ -507,15 +576,29 @@ for _ in range(ROUNDS):
         times[arm].append((time.perf_counter() - t0) / BLOCK)
 post = dict(telemetry.counters())
 med = {arm: sorted(v)[len(v) // 2] for arm, v in times.items()}
+# the schedule-search cache's per-site winners, for the report
+schedules = {}
+try:
+    payload = json.load(open(fusion_tune.cache_path()))
+    for key, r in payload["entries"].items():
+        if r.get("engage"):
+            schedules[key.split("|", 1)[0]] = {
+                "lowering": r.get("lowering"),
+                "schedule": r.get("schedule"),
+                "schedules_searched": r.get("schedules_searched")}
+except Exception:
+    pass
 rec = {
     "fusion_bench": 1, "mode": mode,
     "step_ms_off": round(med["off"] * 1000, 3),
-    "step_ms_on": round(med["on"] * 1000, 3),
+    "step_ms_binary": round(med["binary"] * 1000, 3),
+    "step_ms_sched": round(med["sched"] * 1000, 3),
     "tunes_warmup": tunes_warmup,
     "tunes_post_warmup": post.get("fusion.tune", 0) - pre.get("fusion.tune", 0),
     "retraces_post_warmup":
         post.get("executor.retrace", 0) - pre.get("executor.retrace", 0),
     "tune_cache_hits": post.get("fusion.tune_cache_hit", 0),
+    "schedules": schedules,
     "pattern_engaged": {
         k.split("fusion.pattern_engaged.", 1)[1]: v
         for k, v in post.items()
@@ -525,37 +608,52 @@ print(json.dumps(rec), flush=True)
 """
 
 
-def _bench_fusion_patterns():
-    """Pattern-engine A/B leg (docs/PERF.md §13): the SAME transformer
-    training step with the generic pattern engine off vs on (tuned), in
-    fresh subprocesses so trace caches and telemetry cannot bleed. Three
-    arms sharing one tune-cache dir:
+def _bench_fusion_patterns(dev):
+    """Pattern-engine A/B leg (docs/PERF.md §13/§15): the SAME transformer
+    training step under three engines, in fresh subprocesses so trace
+    caches and telemetry cannot bleed:
 
-    - ``off``   — ``MXNET_FUSED_PATTERNS=0`` baseline.
-    - ``cold``  — engine on, empty cache: first trace measures each site
-      (``fusion.tune`` > 0) and persists the verdicts.
-    - ``warm``  — engine on, warmed cache: the HEADLINE arm. The gate
-      asserts zero re-tunes and zero post-warmup retraces here — the
-      measure-and-cache contract (tune once per device kind, ever).
+    - ``off``    — ``MXNET_FUSED_PATTERNS=0`` baseline.
+    - ``binary`` — the PR 9 binary-verdict engine: warm cache tuned with
+      ``MXNET_FUSION_TUNE_SCHEDULES=0`` (default candidate only).
+    - ``sched``  — the schedule-search engine (this round's tentpole):
+      warm cache whose winners carry measured block/chunk schedules.
 
-    Reports the per-arm median block step time and the warm-vs-off
-    speedup. On this CPU fabric the win comes from the measured
-    block-causal attention lowering (the masked upper-triangle key blocks
-    are never computed); on TPU the same machinery engages the Pallas
-    kernels where measured faster."""
+    Two cold subprocess runs tune the two caches; the warm A/B process
+    binds all three executors and times them in interleaved blocks. The
+    gate asserts zero re-tunes and zero post-warmup retraces on the warm
+    arms — the measure-and-cache contract — and the report carries the
+    per-site winning schedules plus analytic-FLOPs MFU per arm so the MFU
+    campaign's trajectory is tracked round over round."""
     import tempfile
 
     root = os.path.dirname(os.path.abspath(__file__))
     steps = int(os.environ.get("MXTPU_BENCH_FUSION_STEPS", "12"))
     out = {}
+    env_base = dict(os.environ)
+    # bound the cold arms' measurement cost (schedule search multiplies
+    # the candidate count); both arms tune at the same iters, so the A/B
+    # stays fair
+    env_base.setdefault("MXNET_FUSION_TUNE_ITERS", "4")
     with tempfile.TemporaryDirectory(prefix="mxtpu_fusion_tune") as tdir:
+        dir_binary = os.path.join(tdir, "binary")
+        dir_sched = os.path.join(tdir, "sched")
         script = os.path.join(tdir, "worker.py")
         with open(script, "w") as f:
             f.write(_FUSION_BENCH_WORKER)
-        for mode in ("cold", "ab"):
+        for mode, arm_dir, schedules in (("cold", dir_binary, "0"),
+                                         ("cold", dir_sched, None),
+                                         ("ab", dir_binary, None)):
+            env = dict(env_base)
+            if schedules is not None:
+                env["MXNET_FUSION_TUNE_SCHEDULES"] = schedules
+            else:
+                env.pop("MXNET_FUSION_TUNE_SCHEDULES", None)
             r = subprocess.run(
-                [sys.executable, script, root, mode, tdir, str(steps)],
-                capture_output=True, text=True, timeout=900, cwd=root)
+                [sys.executable, script, root, mode, arm_dir, dir_sched,
+                 str(steps)],
+                capture_output=True, text=True, timeout=1500, cwd=root,
+                env=env)
             rec = None
             for l in r.stdout.splitlines():
                 if l.startswith("{") and "fusion_bench" in l:
@@ -567,20 +665,33 @@ def _bench_fusion_patterns():
                        (r.stderr or r.stdout).strip()[-400:]))
             rec.pop("fusion_bench", None)
             rec.pop("mode", None)
-            out[mode] = rec
+            out[mode + ("" if mode == "ab" else ":" + arm_dir)] = rec
     ab = out["ab"]
     res = {
         "model": "transformer_b2_seq512_d128",
         "step_ms_off": ab["step_ms_off"],
-        "step_ms_on": ab["step_ms_on"],
-        "speedup": round(ab["step_ms_off"] / ab["step_ms_on"], 4),
-        "tunes_cold": out["cold"]["tunes"],
+        "step_ms_binary": ab["step_ms_binary"],
+        "step_ms_sched": ab["step_ms_sched"],
+        "speedup": round(ab["step_ms_off"] / ab["step_ms_sched"], 4),
+        "sched_vs_binary": round(
+            ab["step_ms_binary"] / ab["step_ms_sched"], 4),
+        "tunes_cold_binary": out["cold:" + dir_binary]["tunes"],
+        "tunes_cold_sched": out["cold:" + dir_sched]["tunes"],
         "tunes_warm": ab["tunes_warmup"] + ab["tunes_post_warmup"],
         "tune_cache_hits_warm": ab["tune_cache_hits"],
         "retraces_post_warmup": ab["retraces_post_warmup"],
+        "schedules": ab["schedules"],
         "pattern_engaged": ab["pattern_engaged"],
     }
+    flops = _transformer_train_flops(2, 512, 128, 4, 2, 2048, 1000)
+    for arm in ("off", "binary", "sched"):
+        res["mfu_" + arm] = _mfu_fields(
+            flops, ab["step_ms_" + arm] / 1000.0, dev)
     res["improved"] = bool(res["speedup"] > 1.0)
+    # the campaign acceptance: the schedule-search engine is no worse than
+    # the binary-verdict engine (1% timer-noise band)
+    res["sched_ge_binary"] = bool(
+        res["step_ms_sched"] <= res["step_ms_binary"] * 1.01)
     res["zero_retune_warm"] = bool(res["tunes_warm"] == 0)
     return res
 
@@ -823,6 +934,8 @@ def _bench_recommender(on_tpu, models, parallel, dev):
     dt = time.perf_counter() - t0
     res = {"samples_per_s": round(batch * n_steps / dt, 1), "batch": batch,
            "step_ms": round(1000 * dt / n_steps, 2)}
+    res.update(_mfu_fields(_recommender_train_flops(batch), dt / n_steps,
+                           dev))
 
     # 2-proc sparse-vs-dense wire measurement (parity gated inside)
     root = os.path.dirname(os.path.abspath(__file__))
@@ -865,6 +978,98 @@ def _bench_recommender(on_tpu, models, parallel, dev):
             plan.predicted["comm_bytes"] / max(1, plan.naive["comm_bytes"]),
             6),
     }
+    return res
+
+
+def _bench_input_pipeline(dev):
+    """Double-buffered input pipeline A/B (docs/PERF.md §15): the SAME
+    small-MLP ``Module.fit`` twice from identical initial weights — plain
+    ``NDArrayIter`` (host slicing + transfer inline with the step) vs the
+    iterator wrapped in ``io.DevicePrefetchIter`` (batch N+1 sliced,
+    ``device_put`` and parked by the pump thread while step N runs).
+    Records the ``io.input_bound_pct`` gauge per arm (the fraction of
+    epoch wall time the fit loop spent waiting on input — it must drop
+    with prefetch on) and asserts the final weights are BITWISE identical
+    (device transfer preserves bits; no augment hook here)."""
+    import mxnet_tpu as mx
+    from mxnet_tpu import telemetry
+
+    def mlp():
+        s = mx.sym.Variable("data")
+        s = mx.sym.FullyConnected(s, num_hidden=256, name="ip_fc1")
+        s = mx.sym.Activation(s, act_type="relu")
+        s = mx.sym.FullyConnected(s, num_hidden=64, name="ip_fc2")
+        s = mx.sym.Activation(s, act_type="relu")
+        s = mx.sym.FullyConnected(s, num_hidden=10, name="ip_fc3")
+        return mx.sym.SoftmaxOutput(s, name="softmax")
+
+    rs = np.random.RandomState(11)
+    batch, batches, dim = 128, 24, 128
+    x = rs.rand(batches * batch, dim).astype("float32")
+    y = rs.randint(0, 10, (batches * batch,)).astype("float32")
+    init = {
+        "ip_fc1_weight": mx.nd.array(rs.rand(256, dim).astype("f") * 0.05),
+        "ip_fc1_bias": mx.nd.array(np.zeros(256, "f")),
+        "ip_fc2_weight": mx.nd.array(rs.rand(64, 256).astype("f") * 0.05),
+        "ip_fc2_bias": mx.nd.array(np.zeros(64, "f")),
+        "ip_fc3_weight": mx.nd.array(rs.rand(10, 64).astype("f") * 0.05),
+        "ip_fc3_bias": mx.nd.array(np.zeros(10, "f")),
+    }
+
+    saved = telemetry.current_override()
+    telemetry.set_mode("counters")
+    try:
+        def run(prefetch):
+            it = mx.io.NDArrayIter(x, y, batch_size=batch)
+            if prefetch:
+                it = mx.io.DevicePrefetchIter(it)
+            stamps = []  # epoch-1 batch boundaries: epoch 0 is the
+            # compile warmup, so the median inter-batch gap here is the
+            # STEADY-STATE step time (the other legs' timing contract)
+
+            def cb(param):
+                if param.epoch >= 1:
+                    stamps.append(time.perf_counter())
+
+            t0 = time.perf_counter()
+            mod = mx.mod.Module(mlp(), context=mx.context.current_context())
+            mod.fit(it, num_epoch=2, kvstore="local",
+                    arg_params=dict(init), initializer=None,
+                    batch_end_callback=cb)
+            wall = time.perf_counter() - t0
+            args, _ = mod.get_params()
+            pct = telemetry.gauge("io.input_bound_pct").value
+            gaps = sorted(b - a for a, b in zip(stamps, stamps[1:]))
+            step_s = gaps[len(gaps) // 2] if gaps else wall
+            return pct, wall, step_s, {k: v.asnumpy()
+                                       for k, v in args.items()}
+
+        # warmup pass for BOTH arms: the two fits share this process's
+        # JAX trace/compile caches, so without it the second arm would
+        # inherit the first's compile warmth and the wall/step numbers
+        # would measure run ORDER, not the pipeline (the fusion leg
+        # avoids the same bias with fresh subprocesses)
+        run(False)
+        run(True)
+        pct_off, wall_off, step_off, params_off = run(False)
+        pct_on, wall_on, step_on, params_on = run(True)
+    finally:
+        telemetry.set_mode(saved)
+    res = {
+        "input_bound_pct_off": pct_off,
+        "input_bound_pct_on": pct_on,
+        "input_bound_dropped": bool(pct_on < pct_off),
+        "fit_wall_s_off": round(wall_off, 3),
+        "fit_wall_s_on": round(wall_on, 3),
+        "step_ms_off": round(step_off * 1000, 3),
+        "step_ms_on": round(step_on * 1000, 3),
+        "bitwise_identical": bool(all(
+            np.array_equal(params_off[k], params_on[k])
+            for k in params_off)),
+        "batch": batch, "batches_per_epoch": batches,
+    }
+    flops = 3 * batch * 2 * (dim * 256 + 256 * 64 + 64 * 10)
+    res.update(_mfu_fields(flops, step_on, dev))
     return res
 
 
@@ -956,9 +1161,13 @@ def main():
     except Exception as exc:  # nor may the checkpoint leg
         ckpt = {"error": "%s: %s" % (type(exc).__name__, exc)}
     try:
-        fusion_patterns = _bench_fusion_patterns()
+        fusion_patterns = _bench_fusion_patterns(dev)
     except Exception as exc:  # nor may the pattern-engine leg
         fusion_patterns = {"error": "%s: %s" % (type(exc).__name__, exc)}
+    try:
+        input_pipeline = _bench_input_pipeline(dev)
+    except Exception as exc:  # nor may the input-pipeline leg
+        input_pipeline = {"error": "%s: %s" % (type(exc).__name__, exc)}
     try:
         autoplan_leg = _bench_autoplan()
     except Exception as exc:  # nor may the planner leg
@@ -1040,6 +1249,7 @@ def main():
     result["recommender"] = recommender
     result["checkpoint"] = ckpt
     result["fusion_patterns"] = fusion_patterns
+    result["input_pipeline"] = input_pipeline
     result["autoplan"] = autoplan_leg
     print(json.dumps(result))
 

@@ -44,6 +44,13 @@ _MXU_OPS = frozenset({"Convolution", "Deconvolution", "FullyConnected",
 # GL502 floor: below this a "dominant" activation is not worth a finding
 DOMINANT_FLOOR_BYTES = 1 << 30  # 1 GiB
 
+# the fused attention op: its dense lowering's autodiff stashes the
+# (B, H, T, S) softmax probabilities across fwd→bwd — an OP-INTERNAL
+# residual no graph entry carries, modeled explicitly below (and elided
+# when the flash training path will engage: the online-softmax recompute
+# backward keeps only the (B, H, T, 1) logsumexp)
+_ATTN_OPS = frozenset({"_contrib_MultiHeadAttention", "MultiHeadAttention"})
+
 _TOP_LIVE = 8  # live tensors named at the peak
 
 
@@ -120,6 +127,45 @@ def plan_memory(ctx: GraphContext):
             if stash_all or node.op in _MXU_OPS:
                 stashed.add((id(node), oi))
 
+    # attention score-stash model: the dense lowering's backward needs the
+    # f32 (B, H, T, S) probabilities, held from the op's forward to its
+    # backward — charged per site unless the flash training path engages
+    # for that exact (shape, dtype) site (fusion.attention_trains_flash)
+    attn_stash, attn_info = {}, None
+    if ctx.train:
+        attn_info = {"sites": 0, "score_bytes": 0, "flash_elided_sites": 0}
+        for node in op_nodes:
+            if node.op not in _ATTN_OPS or not node.inputs:
+                continue
+            attn_info["sites"] += 1
+            q_n, q_oi = node.inputs[0]
+            k_n, k_oi = node.inputs[1] if len(node.inputs) > 1 else (None, 0)
+            q_sh = ctx.entry_shape.get((id(q_n), q_oi))
+            k_sh = ctx.entry_shape.get((id(k_n), k_oi)) if k_n is not None \
+                else None
+            if not q_sh or not k_sh or len(q_sh) != 4 or len(k_sh) != 4:
+                continue
+            a = node.parsed_attrs()
+            try:
+                from .. import fusion as _fusion
+
+                flash = _fusion.attention_trains_flash(
+                    q_sh, k_sh, ctx.entry_dtype.get((id(node), 0))
+                    or "float32", a.get("causal"), a.get("scale", -1.0))
+            except Exception:
+                flash = False
+            if flash:
+                attn_info["flash_elided_sites"] += 1
+                continue
+            out_spec = norm_spec(ctx.entry_spec.get((id(node), 0)), 4)
+            score_shape = (q_sh[0], q_sh[1], q_sh[2], k_sh[2])
+            b = entry_bytes(score_shape, "float32",
+                            tuple(out_spec[:3]) + ((),), m)
+            attn_stash[id(node)] = b
+            attn_info["score_bytes"] += int(b)
+        if not attn_info["sites"]:
+            attn_info = None
+
     live = {}  # entry -> bytes
     peak = -1
     peak_node, peak_phase, peak_live = None, "forward", []
@@ -138,10 +184,16 @@ def plan_memory(ctx: GraphContext):
            "__recompute__": "<recomputed operands>"}
     for node, oi in entries:
         lbl[(id(node), oi)] = _entry_label(ctx, node, oi)
+    for node in op_nodes:
+        if id(node) in attn_stash:
+            lbl[("__attn_scores__", id(node))] = \
+                ctx.node_label(node) + "<scores>"
 
     for node in op_nodes:
         for i in range(node.num_outputs()):
             live[(id(node), i)] = sizes[(id(node), i)]
+        if id(node) in attn_stash:
+            live[("__attn_scores__", id(node))] = attn_stash[id(node)]
         note_peak(node, "forward")
         for inp, oi in node.inputs:
             e = (id(inp), oi)
@@ -184,13 +236,14 @@ def plan_memory(ctx: GraphContext):
             live["__cotangents__"] = sum(cot.values())
             note_peak(node, "backward")
             live.pop("__recompute__", None)
-            # this node's backward ran: its output cotangents and stashed
-            # outputs are dead
+            # this node's backward ran: its output cotangents, stashed
+            # outputs and internal score stash are dead
             for i in range(node.num_outputs()):
                 cot.pop((id(node), i), None)
                 e = (id(node), i)
                 if e not in heads:
                     live.pop(e, None)
+            live.pop(("__attn_scores__", id(node)), None)
         live.pop("__cotangents__", None)
 
     act_peak = max(peak, 0)
@@ -218,6 +271,8 @@ def plan_memory(ctx: GraphContext):
     }
     if fusion_info is not None:
         plan["fusion"] = fusion_info
+    if attn_info is not None:
+        plan["attention"] = attn_info
     return plan
 
 

@@ -61,7 +61,8 @@ from . import telemetry as _tm
 __all__ = ["plan", "plan_sites", "execute", "resolve", "gate",
            "gate_explain", "bwd_mode", "conv_reject_reason",
            "bn_reject_reason", "infer_default", "quant_mode",
-           "enabled_patterns", "gate_pattern_explain", "CONV_BN_KINDS"]
+           "enabled_patterns", "gate_pattern_explain", "conv_schedule",
+           "losers_note", "attention_trains_flash", "CONV_BN_KINDS"]
 
 #: directive kinds owned by the conv+BN machinery — the executor masks these
 #: (only) on inference executions where ``infer_default()`` declined, keeping
@@ -106,23 +107,25 @@ class PendingConv:
     """A conv deferred to its consuming residual add."""
 
     __slots__ = ("x", "w", "scale", "shift", "relu", "kernel", "stride",
-                 "bwd")
+                 "bwd", "bn")
 
-    def __init__(self, x, w, scale, shift, relu, kernel, stride, bwd="xla"):
+    def __init__(self, x, w, scale, shift, relu, kernel, stride, bwd="xla",
+                 bn=None):
         self.x, self.w = x, w
         self.scale, self.shift, self.relu = scale, shift, relu
         self.kernel, self.stride = kernel, stride
         self.bwd = bwd
+        self.bn = bn
 
     def run(self, res):
         kind, mesh, _ = _mesh_kind()
         if kind == _MESH_DP:
             return _conv_block_sharded(
                 mesh, self.x, self.w, self.scale, self.shift, res,
-                self.kernel, self.stride, self.relu, self.bwd)
+                self.kernel, self.stride, self.relu, self.bwd, self.bn)
         return conv_block(self.x, self.w, self.scale, self.shift, res,
                           self.kernel, self.stride, self.relu, True,
-                          self.bwd)
+                          self.bwd, self.bn)
 
 
 class Lazy:
@@ -262,11 +265,14 @@ def _bn_ok(node):
 def enabled_patterns(infer=False):
     """Per-pattern mode map from ``MXNET_FUSED_PATTERNS``: name ->
     ``"auto"`` (engage per measured verdict), ``"1"`` (force the first
-    candidate lowering), or ``"0"`` (off). Grammar: ``auto``/``all`` (every
-    pattern in auto, the default), ``0``/``off``/``none``, or a comma list
-    of names with optional forces (``attention,matmul_bias_act=1``) —
-    listed patterns get their mode, unlisted ones are off. The conv+BN
-    pattern is governed by its own ``MXNET_FUSED_CONV_BN[_BWD]`` knobs.
+    candidate lowering), ``"0"`` (off), or a LOWERING NAME (force that
+    specific candidate — ``attention=pallas_flash`` — where it exists for
+    the site; prefix-matched, so a forced name also selects its schedule
+    variants). Grammar: ``auto``/``all`` (every pattern in auto, the
+    default), ``0``/``off``/``none``, or a comma list of names with
+    optional forces (``attention,matmul_bias_act=1``) — listed patterns
+    get their mode, unlisted ones are off. The conv+BN pattern is governed
+    by its own ``MXNET_FUSED_CONV_BN[_BWD]`` knobs.
 
     ``infer=True`` is the serving/grad-less gate: when
     ``MXNET_FUSED_PATTERNS_INFER`` is set it overrides the training map on
@@ -308,7 +314,27 @@ def _parse_patterns_env(env, names):
             continue
         name, _, val = item.partition("=")
         if name in modes:
-            modes[name] = val if val in ("0", "1") else "auto"
+            if val in ("0", "1"):
+                modes[name] = val
+            elif val in ("", "auto"):
+                modes[name] = "auto"
+            else:
+                # a forced lowering NAME (e.g. pallas_flash). A value
+                # matching no known lowering family warns once — a typo'd
+                # value here used to read as "auto", and as a
+                # never-matching name it would silently unfuse every site
+                modes[name] = val
+                if (val not in _warned_forced_vals
+                        and not val.startswith(_LOWERING_FAMILIES)):
+                    _warned_forced_vals.add(val)
+                    import logging
+
+                    logging.getLogger("mxnet_tpu").warning(
+                        "MXNET_FUSED_PATTERNS treats %s=%r as a FORCED "
+                        "lowering name, and it matches no known lowering "
+                        "family %s: every site will run unfused (use "
+                        "auto/0/1 for the mode grammar)",
+                        name, val, list(_LOWERING_FAMILIES))
         else:
             global _warned_patterns_env
             if not _warned_patterns_env:
@@ -322,7 +348,11 @@ def _parse_patterns_env(env, names):
 
 
 _warned_patterns_env = False
+_warned_forced_vals = set()
 _patterns_env_memo = {}
+#: candidate-name families the patterns emit (forced-name validation)
+_LOWERING_FAMILIES = ("pallas", "block_causal", "chunked_kv", "fused",
+                      "onepass", "xla")
 
 
 def plan_sites(directives):
@@ -533,10 +563,15 @@ def _conv_bn_measure(kernel, stride, x_shape, w_shape, dtype, res):
         s, q = _stats_of(c)
         return (c, s, q)
 
-    def fused(x, w, scale, shift, r=None, bwd="xla"):
+    def fused(x, w, scale, shift, r=None, bwd="xla", bn=None):
         return conv_block(x, w, scale, shift, r, kernel, stride, True,
-                          True, bwd)
+                          True, bwd, bn)
 
+    from . import fusion_tune as _tune
+    from .ops.pallas_conv_bn import _conv_geometry, bn_candidates
+
+    geo = _conv_geometry(tuple(x_shape), tuple(w_shape), stride, itemsize)
+    budget = _tune.schedule_budget()
     cands = []
     for policy in ("xla", "recompute", "stash"):
         if policy != "xla":
@@ -550,6 +585,17 @@ def _conv_bn_measure(kernel, stride, x_shape, w_shape, dtype, res):
                 continue
         cands.append(("pallas:" + policy,
                       functools.partial(fused, bwd=policy)))
+        if geo is not None and budget:
+            # the forward stripe's schedule axis (choose_blocks seeds the
+            # bare-name default; the variants carry their measured stripe)
+            B_, K_, N_, HW_, taps_ = geo
+            bns = bn_candidates(B_, K_, N_, HW_, itemsize, taps=taps_,
+                                prologue=True, res=res,
+                                emit_xn=(policy == "stash"))
+            cands.extend(
+                (_tune.sched_name("pallas:" + policy, bn=bn),
+                 functools.partial(fused, bwd=policy, bn=bn))
+                for bn in bns[1:1 + budget])
     return measure_candidates(baseline, cands, tuple(args), train=True)
 
 
@@ -572,6 +618,19 @@ def _conv_bn_peek(kernel, stride, x_shape, w_shape, dtype, res):
 
     return _tune.peek(_conv_bn_key(kernel, stride, x_shape, w_shape, dtype,
                                    res))
+
+
+def conv_schedule(kernel, stride, x_shape, w_shape, dtype, res):
+    """The tuned forward channel-stripe override (``@bn=…``) for an
+    ENGAGED conv+BN site, or None (planner default / no searched winner /
+    v1 binary-verdict record). Cache-only read."""
+    rec = _conv_bn_peek(kernel, stride, x_shape, w_shape, dtype, res)
+    if not rec or not rec.get("engage"):
+        return None
+    sched = rec.get("schedule")
+    if isinstance(sched, dict) and isinstance(sched.get("bn"), int):
+        return sched["bn"]
+    return None
 
 
 def gate_explain(kernel, stride, x_shape, w_shape, dtype, prologue,
@@ -605,8 +664,10 @@ def gate_explain(kernel, stride, x_shape, w_shape, dtype, prologue,
         if rec.get(want):
             times = _rec_best_times(rec)
             return True, ("measured win (tuned: fused %.0fµs vs xla "
-                          "%.0fµs fwd+bwd)" % times if times else
-                          "measured win (tuned)")
+                          "%.0fµs fwd+bwd%s)"
+                          % (times + (losers_note(rec,
+                                                  rec.get("lowering")),))
+                          if times else "measured win (tuned)")
         return False, tuned_reject_note(rec)
     # seed/fallback when tuning is disabled: the committed on-chip table
     if not _table_device_matches():
@@ -742,7 +803,9 @@ def _bwd_mode_impl(kernel, stride, x_shape, w_shape, dtype, prologue,
     rec = _conv_bn_peek(kernel, stride, x_shape, w_shape, dtype, res)
     if rec is not None and rec.get("engage"):
         low = rec.get("lowering") or ""
-        policy = low.partition(":")[2]
+        # "pallas:<policy>[@bn=…]" — the @-suffix is the forward stripe
+        # schedule (conv_schedule reads it), not part of the policy
+        policy = low.partition(":")[2].partition("@")[0]
         if policy in ("recompute", "stash") and _tiles(policy):
             return policy
         return "xla"
@@ -785,6 +848,26 @@ def _rec_best_times(rec):
     return None if best is None else (best, base)
 
 
+def losers_note(rec, winner):
+    """The measured-losers clause of a schedule-search win: up to three
+    runner-up candidates with their fwd(+bwd) totals, fastest first —
+    ``gate_explain``/``gate_pattern_explain`` reasons quote it so the
+    schedule decision is auditable without opening the cache file."""
+    rows = []
+    for name, row in (rec.get("measured") or {}).items():
+        if name == winner or row.get("fwd_us") is None:
+            continue
+        if "rejected" in row or "error" in row:
+            continue  # failed parity / failed to run: not beaten on TIME
+        rows.append((row["fwd_us"] + (row.get("bwd_us") or 0.0), name))
+    if not rows:
+        return ""
+    rows.sort()
+    note = ", ".join("%s %.0fµs" % (n, t) for t, n in rows[:3])
+    extra = "" if len(rows) <= 3 else " +%d more" % (len(rows) - 3)
+    return "; beat %s%s" % (note, extra)
+
+
 def tuned_reject_note(rec):
     """The measured-timings clause for a tuned-and-rejected site (feeds the
     GL302 explainer and ``gate_pattern_explain`` reasons)."""
@@ -825,6 +908,17 @@ def gate_pattern_explain(pat, meta, args, train=True):
                              "not tile / variant unsupported)")
     if mode == "1":
         return True, cands[0], "forced (MXNET_FUSED_PATTERNS)"
+    if mode != "auto":
+        # a forced lowering NAME (prefix-matched so a bare family name
+        # also selects its schedule variants): engage where it exists
+        match = next((c for c in cands if c[0] == mode),
+                     next((c for c in cands if c[0].startswith(mode)),
+                          None))
+        if match is not None:
+            return True, match, ("forced (MXNET_FUSED_PATTERNS %s=%s)"
+                                 % (pat.name, mode))
+        return False, None, ("forced lowering %r has no candidate at "
+                             "this site" % mode)
     if not getattr(pat, "tunable", True):
         return False, None, ("no lowering distinct from the baseline to "
                              "measure (engage via MXNET_FUSED_PATTERNS="
@@ -832,9 +926,14 @@ def gate_pattern_explain(pat, meta, args, train=True):
     key = _tune_key(pat, meta, args)
 
     def _measure():
-        # synthetic concrete inputs: the real args are tracers mid-trace
+        # synthetic concrete inputs: the real args are tracers mid-trace.
+        # tuner_build() keeps force-gated interpret candidates (an
+        # inference-map pin) out of the measured set off-TPU.
+        from .ops.fusion_patterns import tuner_build
+
         sargs = _tune.synth_like(args)
-        sbase, scands = pat.build(meta, sargs)
+        with tuner_build():
+            sbase, scands = pat.build(meta, sargs)
         return _tune.measure_candidates(sbase, scands, sargs, train=True)
 
     rec = _tune.verdict(key, _measure)
@@ -851,10 +950,48 @@ def gate_pattern_explain(pat, meta, args, train=True):
                                  "this site" % low)
         times = _rec_best_times(rec)
         reason = "measured win (%s)" % low if times is None else (
-            "measured win (%s: fused %.0fµs vs baseline %.0fµs fwd+bwd)"
-            % ((low,) + times))
+            "measured win (%s: fused %.0fµs vs baseline %.0fµs fwd+bwd%s)"
+            % ((low,) + times + (losers_note(rec, low),)))
         return True, (low, fn), reason
     return False, None, tuned_reject_note(rec)
+
+
+def attention_trains_flash(q_shape, k_shape, dtype, causal, scale=-1.0):
+    """Whether TRAINING through an attention site with these shapes will
+    statically engage the flash (``pallas_flash``) lowering — whose
+    ``custom_vjp`` online-softmax recompute backward never stashes the
+    (B, H, T, S) probability tensor. Decidable without tracing: the
+    pattern mode force-names a flash lowering, or the tune cache records
+    an engaged ``pallas_flash`` winner for this exact site. The GL5xx
+    memory planner uses it to elide the score-stash charge."""
+    try:
+        from .ops import pallas_attention as pa
+
+        if not pa.supported(tuple(q_shape), tuple(k_shape),
+                            causal=bool(causal)):
+            return False
+        mode = enabled_patterns().get("attention", "0")
+        if mode in ("0", "1"):
+            return False  # "1" engages the FIRST candidate (XLA family)
+        if mode != "auto":
+            return mode.startswith("pallas_flash")
+        from . import fusion_tune as _tune
+        from .ops.fusion_patterns import get_patterns
+
+        class _Arg:  # shape/dtype carrier for the tune-key signature
+            def __init__(self, shape, dtype):
+                self.shape, self.dtype = tuple(shape), dtype
+
+        pat = next(p for p in get_patterns() if p.name == "attention")
+        meta = {"causal": bool(causal), "scale": float(scale)}
+        args = (_Arg(q_shape, dtype), _Arg(k_shape, dtype),
+                _Arg(k_shape, dtype))
+        rec = _tune.peek(_tune_key(pat, meta, args))
+        return bool(rec and rec.get("engage")
+                    and str(rec.get("lowering") or "").startswith(
+                        "pallas_flash"))
+    except Exception:  # a planner refinement must never sink an analysis
+        return False
 
 
 def _exec_pattern(directive, node, ins, is_train):
@@ -984,7 +1121,7 @@ def _mesh_kind():
 
 
 def _conv_block_sharded(mesh, x, w, scale, shift, res, kernel, stride, relu,
-                        bwd="xla"):
+                        bwd="xla", bn=None):
     """Run the kernel per data-shard (pallas_call has no SPMD partitioning
     rule, so GSPMD would gather its operands); the per-shard statistics
     psum over 'data' so the downstream BN sees GLOBAL-batch moments —
@@ -1010,7 +1147,7 @@ def _conv_block_sharded(mesh, x, w, scale, shift, res, kernel, stride, relu,
         sh = next(it) if has_p else None
         r_ = next(it) if has_r else None
         c, s, q = conv_block(x_, w_, sc, sh, r_, kernel, stride, relu,
-                             True, bwd)
+                             True, bwd, bn)
         return (c, jax.lax.psum(s, "data"), jax.lax.psum(q, "data"))
 
     from .parallel.mesh import shard_map_compat
@@ -1048,24 +1185,28 @@ def _exec_conv(directive, node, ins):
                          scale is not None, res=directive["defer"])):
             bwd = bwd_mode(kernel, stride, local_shape, w.shape, x.dtype,
                            scale is not None, res=directive["defer"])
+            bn = conv_schedule(kernel, stride, local_shape, w.shape,
+                               x.dtype, directive["defer"])
             _note_conv(node, local_shape, True, "engaged (dp mesh)", bwd)
             if directive["defer"]:
                 return PendingConv(x, w, scale, shift, relu, kernel, stride,
-                                   bwd)
+                                   bwd, bn)
             c, s, q = _conv_block_sharded(mesh, x, w, scale, shift, None,
-                                          kernel, stride, relu, bwd)
+                                          kernel, stride, relu, bwd, bn)
             return WithStats(c, s, q)
     elif kind == _MESH_NONE and gate(kernel, stride, x.shape, w.shape,
                                      x.dtype, scale is not None,
                                      res=directive["defer"]):
         bwd = bwd_mode(kernel, stride, x.shape, w.shape, x.dtype,
                        scale is not None, res=directive["defer"])
+        bn = conv_schedule(kernel, stride, x.shape, w.shape, x.dtype,
+                           directive["defer"])
         _note_conv(node, x.shape, True, "engaged", bwd)
         if directive["defer"]:
             return PendingConv(x, w, scale, shift, relu, kernel, stride,
-                               bwd)
+                               bwd, bn)
         c, s, q = conv_block(x, w, scale, shift, None, kernel, stride, relu,
-                             True, bwd)
+                             True, bwd, bn)
         return WithStats(c, s, q)
     # kind == _MESH_OTHER (tensor/seq-sharded) always lands here: XLA path
     # fallback: materialize the normalized input (cached on the marker) and

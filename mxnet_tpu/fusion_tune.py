@@ -11,8 +11,17 @@ run, in this process or any other, reuses it with zero re-tunes.
 
 Cache layout: ``$MXNET_FUSION_TUNE_DIR/<device_kind>.json`` holding
 
-    {"version": 1, "device_kind": ..., "digest": sha256(entries-json),
+    {"version": 2, "device_kind": ..., "digest": sha256(entries-json),
      "entries": {"<pattern>|<variant>|<sig>": {record}, ...}}
+
+Schema v2 (this round) upgrades records from a binary engage/fallback
+VERDICT to a measured SCHEDULE: candidate lowerings carry block-size/grid
+variants (``name@k=v,...``), and the winning record stores the parsed
+``schedule`` dict plus ``schedules_searched``. Version-1 files (PR 9's
+binary verdicts) still LOAD — their records are valid verdicts for the
+planner-default schedule, never re-tuned, never misread as a searched
+winner (``schedule`` absent marks them). Files from an UNKNOWN (future)
+version are invalidated with one warning, never a crash.
 
 Writes are atomic (temp + ``os.replace``, the checkpoint.py discipline) and
 merge-on-write, so concurrent processes tuning disjoint sites compose. A
@@ -27,6 +36,10 @@ Gating env (docs/ENV_VARS.md):
 - ``MXNET_FUSION_TUNE_DIR``  — cache directory; setting it ENABLES tuning.
 - ``MXNET_FUSION_TUNE=0``    — kill-switch: never measure, never read.
 - ``MXNET_FUSION_TUNE_ITERS``— timing iterations per measurement (default 10).
+- ``MXNET_FUSION_TUNE_SCHEDULES`` — schedule-search width: how many
+  block-size/grid variants each pattern may enumerate per candidate family
+  beyond the planner-default (default 4); ``0`` restores the PR 9
+  binary-verdict behavior (default candidate only).
 
 Telemetry (docs/OBSERVABILITY.md): ``fusion.tune`` counts actual
 measurements (a warm cache keeps this at zero), ``fusion.tune_cache_hit``
@@ -46,11 +59,15 @@ from . import telemetry as _tm
 
 __all__ = ["enabled", "cache_dir", "device_kind", "lookup", "peek",
            "verdict", "measure_candidates", "synth_like", "reset",
-           "cache_path", "entries_digest"]
+           "cache_path", "entries_digest", "schedule_budget",
+           "parse_schedule", "sched_name"]
 
 log = logging.getLogger("mxnet_tpu")
 
-_VERSION = 1
+_VERSION = 2
+#: prior schema whose entries remain readable: PR 9's binary verdicts are
+#: valid records for the planner-default schedule (no ``schedule`` field)
+_COMPAT_VERSIONS = (1,)
 
 _lock = threading.Lock()
 # device_kind -> {key: record}; None means "not loaded yet"
@@ -79,6 +96,46 @@ def tune_iters():
         return max(1, int(os.environ.get("MXNET_FUSION_TUNE_ITERS", "10")))
     except ValueError:
         return 10
+
+
+def schedule_budget():
+    """How many block-size/grid-shape variants each pattern may enumerate
+    per candidate family beyond the planner-default candidate
+    (``MXNET_FUSION_TUNE_SCHEDULES``, default 4). ``0`` = binary-verdict
+    mode: only the planner-default schedule is measured (the PR 9
+    contract)."""
+    try:
+        return max(0, int(os.environ.get("MXNET_FUSION_TUNE_SCHEDULES",
+                                         "4")))
+    except ValueError:
+        return 4
+
+
+def sched_name(base, **kv):
+    """The canonical schedule-variant candidate name: ``base@k=v,...``
+    (sorted keys, so the name is deterministic and round-trips through
+    ``parse_schedule``)."""
+    return "%s@%s" % (base, ",".join(
+        "%s=%d" % (k, v) for k, v in sorted(kv.items())))
+
+
+def parse_schedule(name):
+    """The schedule dict a candidate name encodes (``base@k=v,...``), or
+    ``"default"`` for a bare (planner-default) candidate name, or None for
+    no lowering at all."""
+    if not name:
+        return None
+    _, sep, tail = str(name).partition("@")
+    if not sep:
+        return "default"
+    out = {}
+    for item in tail.split(","):
+        k, _, v = item.partition("=")
+        try:
+            out[k] = int(v)
+        except ValueError:
+            out[k] = v
+    return out
 
 
 def device_kind():
@@ -132,10 +189,15 @@ def _load_file(path, kind):
     except (OSError, ValueError) as exc:
         _warn_once(path, "unreadable or not JSON (%s)" % exc)
         return {}
-    if not isinstance(payload, dict) or payload.get("version") != _VERSION:
-        _warn_once(path, "unknown schema version %r"
-                   % (payload.get("version") if isinstance(payload, dict)
-                      else type(payload).__name__))
+    version = payload.get("version") if isinstance(payload, dict) else None
+    if version != _VERSION and version not in _COMPAT_VERSIONS:
+        # a FUTURE (or garbage) schema: cleanly invalidate with one warning
+        # — never a crash, and never a silently-misread winner
+        _warn_once(path, "unknown schema version %r (this build reads "
+                   "v%d and the compatible v%s)"
+                   % (version if isinstance(payload, dict)
+                      else type(payload).__name__, _VERSION,
+                      "/v".join(str(v) for v in _COMPAT_VERSIONS)))
         return {}
     if payload.get("device_kind") != kind:
         _warn_once(path, "stamped for device_kind %r, this process runs %r"
@@ -148,6 +210,14 @@ def _load_file(path, kind):
     if payload.get("digest") != entries_digest(entries):
         _warn_once(path, "digest mismatch (torn write or hand edit)")
         return {}
+    if version in _COMPAT_VERSIONS:
+        # v1 (binary-verdict) records load as-is: engage/lowering/timings
+        # keep their meaning, and the ABSENT ``schedule`` field marks them
+        # as default-schedule verdicts — a warm run still does zero
+        # re-tunes, and nothing misreports them as a searched winner
+        log.info("fusion_tune: cache file %s is schema v%s (binary "
+                 "verdicts); records load as default-schedule entries",
+                 path, version)
     return entries
 
 
@@ -242,6 +312,13 @@ def verdict(key, measure):
                "error": "%s: %s" % (type(exc).__name__, exc)}
     rec.setdefault("engage", False)
     rec["tune_s"] = round(time.perf_counter() - t0, 4)
+    # schedule-search annotations (schema v2): the winner's parsed schedule
+    # and how many schedule variants were actually timed at this site
+    sched = parse_schedule(rec.get("lowering"))
+    if sched is not None:
+        rec["schedule"] = sched
+    rec["schedules_searched"] = sum(
+        1 for n in (rec.get("measured") or {}) if "@" in n)
     kind = device_kind()
     with _lock:
         _entries(kind)[key] = rec

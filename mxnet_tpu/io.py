@@ -29,9 +29,22 @@ __all__ = [
     "NDArrayIter",
     "ResizeIter",
     "PrefetchingIter",
+    "DevicePrefetchIter",
+    "device_prefetch_enabled",
     "CSVIter",
     "MNISTIter",
 ]
+
+
+def device_prefetch_enabled():
+    """Whether ``Module.fit`` auto-wraps the training iterator in a
+    ``DevicePrefetchIter`` (``MXNET_IO_DEVICE_PREFETCH=1``,
+    docs/ENV_VARS.md). Off by default: the wrap changes nothing numerically
+    (device transfers are bit-preserving) but adds a pump thread."""
+    import os
+
+    return os.environ.get("MXNET_IO_DEVICE_PREFETCH", "0").strip().lower() \
+        in ("1", "true", "on")
 
 
 class DataDesc(namedtuple("DataDesc", ["name", "shape"])):
@@ -270,6 +283,56 @@ class ResizeIter(DataIter):
         return self.current_batch.pad
 
 
+def _pump_loop(fetch, q, stop, end_sentinel):
+    """The shared prefetch pump body (PrefetchingIter and
+    DevicePrefetchIter): drive ``fetch()`` until epoch end (StopIteration)
+    or a child error (surfaced to the consumer as the end token), with a
+    bounded ``put`` that stays responsive to shutdown. ALWAYS terminates
+    the queue with a sentinel/exception so the consumer can't hang."""
+    end_token = end_sentinel
+    try:
+        while not stop.is_set():
+            try:
+                batch = fetch()
+            except StopIteration:
+                break
+            except BaseException as exc:  # surface child errors
+                end_token = exc
+                break
+            while not stop.is_set():
+                try:
+                    q.put(batch, timeout=0.1)
+                    break
+                except queue.Full:
+                    continue
+    finally:
+        q.put(end_token)
+
+
+def _drain_and_join(queues, threads, stop, end_sentinel, timeout):
+    """The shared bounded teardown: signal stop, drain each queue until
+    its sentinel (unblocking a pump stuck on a full queue), then join
+    every pump against ONE shared deadline. Returns the still-alive
+    (wedged) threads."""
+    import time as _time
+
+    stop.set()
+    for q in queues:
+        while True:
+            try:
+                if q.get_nowait() is end_sentinel:
+                    break
+            except queue.Empty:
+                break
+    deadline = _time.monotonic() + timeout
+    stuck = []
+    for t in threads:
+        t.join(timeout=max(0.0, deadline - _time.monotonic()))
+        if t.is_alive():
+            stuck.append(t)
+    return stuck
+
+
 class PrefetchingIter(DataIter):
     """Background-thread prefetch over one or more iterators (reference:
     io.py PrefetchingIter, C++ PrefetcherIter iter_prefetcher.h:28).
@@ -306,32 +369,16 @@ class PrefetchingIter(DataIter):
     def _pump(self, child, q, stop):
         from . import faultinject as _fi
 
-        end_token = PrefetchingIter._END
-        try:
-            while not stop.is_set():
-                try:
-                    # injection site io.prefetch (docs/RESILIENCE.md): a
-                    # `raise` rides the existing error channel below and
-                    # surfaces to the consumer as the epoch's failure; a
-                    # delay/hang starves the training loop (visible as
-                    # io.prefetch_wait) and, past shutdown_timeout, trips
-                    # the wedge latch
-                    _fi.fire("io.prefetch")
-                    batch = child.next()
-                except StopIteration:
-                    break
-                except BaseException as exc:  # surface child errors to the consumer
-                    end_token = exc
-                    break
-                # bounded put that stays responsive to shutdown
-                while not stop.is_set():
-                    try:
-                        q.put(batch, timeout=0.1)
-                        break
-                    except queue.Full:
-                        continue
-        finally:
-            q.put(end_token)
+        def fetch():
+            # injection site io.prefetch (docs/RESILIENCE.md): a `raise`
+            # rides the error channel and surfaces to the consumer as the
+            # epoch's failure; a delay/hang starves the training loop
+            # (visible as io.prefetch_wait) and, past shutdown_timeout,
+            # trips the wedge latch
+            _fi.fire("io.prefetch")
+            return child.next()
+
+        _pump_loop(fetch, q, stop, PrefetchingIter._END)
 
     def _start_epoch(self):
         self._queues = [queue.Queue(maxsize=self._depth)
@@ -355,25 +402,11 @@ class PrefetchingIter(DataIter):
         iterator latches a hard MXNetError: this reset raises it, and every
         later next()/reset() re-raises until the owner rebuilds the
         pipeline."""
-        import time as _time
-
         if self._stop is None:
             return
-        self._stop.set()
-        # unblock any pump stuck on a full queue, then wait for sentinels
-        for q in self._queues:
-            while True:
-                try:
-                    if q.get_nowait() is PrefetchingIter._END:
-                        break
-                except queue.Empty:
-                    break
-        deadline = _time.monotonic() + self._shutdown_timeout
-        stuck = []
-        for t in self._threads:
-            t.join(timeout=max(0.0, deadline - _time.monotonic()))
-            if t.is_alive():
-                stuck.append(t)
+        stuck = _drain_and_join(self._queues, self._threads, self._stop,
+                                PrefetchingIter._END,
+                                self._shutdown_timeout)
         self._threads = []
         if stuck:
             self._wedged = MXNetError(
@@ -453,6 +486,181 @@ class PrefetchingIter(DataIter):
             data.extend(g.data)
             label.extend(g.label)
         self.current_batch = DataBatch(data, label, pad, got[0].index)
+        return True
+
+    def next(self):
+        if self.iter_next():
+            return self.current_batch
+        raise StopIteration
+
+    def getdata(self):
+        return self.current_batch.data
+
+    def getlabel(self):
+        return self.current_batch.label
+
+    def getindex(self):
+        return self.current_batch.index
+
+    def getpad(self):
+        return self.current_batch.pad
+
+
+class DevicePrefetchIter(DataIter):
+    """Double-buffered device-side prefetch (docs/PERF.md §15).
+
+    One pump thread drives the child iterator AHEAD of the training loop:
+    while step N runs, batch N+1 is host-sliced, ``jax.device_put`` to the
+    target device (the transfer dispatches asynchronously and lands during
+    step N's compute), optionally run through a jitted on-device
+    ``augment`` hook, and parked in a bounded queue. ``next()`` then
+    returns an already-device-resident batch — the ``io.prefetch_wait``
+    seam (and ``Module.fit``'s ``io.input_bound_pct`` gauge) stops gating
+    the step.
+
+    ``augment`` receives the batch's DATA arrays (jax arrays, device
+    resident) positionally and returns the same number of arrays — e.g. a
+    random-crop/flip pipeline compiled once with ``jax.jit``. Labels pass
+    through untouched. With ``augment=None`` the wrap is numerically a
+    no-op: ``device_put`` preserves bits, so training results are
+    bit-identical to the unwrapped iterator.
+
+    The pump/teardown discipline (bounded-queue put, epoch-end sentinel,
+    bounded shutdown join with the wedge latch) is ``PrefetchingIter``'s.
+    """
+
+    _END = object()
+
+    def __init__(self, data_iter, prefetch_depth=2, device=None,
+                 augment=None, shutdown_timeout=5.0):
+        super().__init__()
+        assert not isinstance(data_iter, list), \
+            "DevicePrefetchIter wraps ONE iterator; compose PrefetchingIter for multi-stream"
+        self.data_iter = data_iter
+        self.provide_data = data_iter.provide_data
+        self.provide_label = data_iter.provide_label
+        self.batch_size = data_iter.batch_size
+        self.current_batch = None
+        self._depth = max(1, int(prefetch_depth))
+        self._shutdown_timeout = float(shutdown_timeout)
+        if device is None:
+            from .context import current_context
+
+            device = current_context().jax_device
+        self._device = device
+        self._augment = augment
+        self._augment_jit = None
+        if augment is not None:
+            import jax
+
+            self._augment_jit = jax.jit(lambda *xs: tuple(augment(*xs)))
+        self.wait_s = 0.0  # consumer-side stall, accumulated per epoch
+        self._queue = None
+        self._thread = None
+        self._stop = None
+        self._ended = False
+        self._wedged = None
+        # the pump starts LAZILY on the first consume after construction /
+        # reset(): the fit loop's unconditional end-of-epoch reset() (and
+        # the final one after the last epoch) must not spin up a thread
+        # that eagerly transfers batches nobody will read
+
+    # ------------------------------------------------------------- device side
+    def _put_array(self, a):
+        import jax
+
+        raw = a._jax() if isinstance(a, NDArray) else a
+        return jax.device_put(raw, self._device)
+
+    def _to_device(self, batch):
+        """Transfer (and augment) one host batch; dispatch is async, so the
+        pump returns while the copies are still in flight."""
+        data = [self._put_array(a) for a in (batch.data or [])]
+        if self._augment_jit is not None and data:
+            out = self._augment_jit(*data)
+            assert len(out) == len(data), \
+                "augment must return one array per data input"
+            data = list(out)
+        label = [self._put_array(a) for a in (batch.label or [])]
+        return DataBatch([NDArray(d) for d in data],
+                         [NDArray(lb) for lb in label],
+                         batch.pad, batch.index)
+
+    # ------------------------------------------------------------ pump plumbing
+    def _pump(self, child, q, stop):
+        from . import faultinject as _fi
+
+        def fetch():
+            _fi.fire("io.prefetch")
+            return self._to_device(child.next())
+
+        _pump_loop(fetch, q, stop, DevicePrefetchIter._END)
+
+    def _ensure_started(self):
+        if self._thread is not None:
+            return
+        self._queue = queue.Queue(maxsize=self._depth)
+        self._stop = threading.Event()
+        self._ended = False
+        self.wait_s = 0.0
+        self._thread = threading.Thread(
+            target=self._pump, args=(self.data_iter, self._queue,
+                                     self._stop),
+            daemon=True, name="device-prefetch")
+        self._thread.start()
+
+    def _shutdown(self, strict=True):
+        if self._stop is None or self._thread is None:
+            return
+        stuck = _drain_and_join([self._queue], [self._thread], self._stop,
+                                DevicePrefetchIter._END,
+                                self._shutdown_timeout)
+        self._thread = None
+        if stuck:
+            self._wedged = MXNetError(
+                "DevicePrefetchIter: pump thread still running %gs after "
+                "shutdown — the child iterator is blocked in user code; "
+                "rebuild the data pipeline" % self._shutdown_timeout)
+            if strict:
+                raise self._wedged
+
+    def __del__(self):
+        try:
+            self._shutdown(strict=False)
+        except Exception:
+            pass
+
+    # ------------------------------------------------------------------ DataIter
+    def reset(self):
+        if self._wedged is not None:
+            raise self._wedged
+        self._shutdown()
+        self.data_iter.reset()
+        self._ended = False  # next consume lazily starts a fresh pump
+
+    def iter_next(self):
+        if self._wedged is not None:
+            raise self._wedged
+        if self._ended:
+            return False
+        self._ensure_started()
+        import time as _time
+
+        t0 = _time.perf_counter()
+        if _tm.enabled():
+            with _tm.span("io.prefetch_wait"):
+                got = self._queue.get()
+            _tm.timer("io.prefetch_wait").add(_time.perf_counter() - t0)
+        else:
+            got = self._queue.get()
+        self.wait_s += _time.perf_counter() - t0
+        if isinstance(got, BaseException):
+            self._ended = True
+            raise got
+        if got is DevicePrefetchIter._END:
+            self._ended = True
+            return False
+        self.current_batch = got
         return True
 
     def next(self):

@@ -242,6 +242,17 @@ class BaseModule:
         if initializer is None:
             initializer = Uniform(0.01)
 
+        from ..io import DevicePrefetchIter, device_prefetch_enabled
+
+        if (device_prefetch_enabled()
+                and not isinstance(train_data, DevicePrefetchIter)):
+            # double-buffered device-side prefetch (docs/PERF.md §15):
+            # batch N+1's host slice + device transfer overlap step N
+            self.logger.info(
+                "Module.fit: MXNET_IO_DEVICE_PREFETCH=1 — wrapping the "
+                "training iterator in DevicePrefetchIter")
+            train_data = DevicePrefetchIter(train_data)
+
         self.bind(
             data_shapes=train_data.provide_data,
             label_shapes=train_data.provide_label,
@@ -264,10 +275,25 @@ class BaseModule:
         if not isinstance(eval_metric, metric_mod.EvalMetric):
             eval_metric = metric_mod.create(eval_metric)
 
+        warned_input_bound = False
         for epoch in range(begin_epoch, num_epoch):
             tic = time.time()
             eval_metric.reset()
-            for nbatch, data_batch in enumerate(train_data):
+            # fetch time = what the step pipeline spends WAITING on input
+            # (host slicing, queue stalls, blocking transfers) — the
+            # io.input_bound_pct numerator. Timed here, at the consumer,
+            # so every iterator composition is covered.
+            fetch_s = 0.0
+            nbatch = -1
+            data_source = iter(train_data)
+            while True:
+                t_fetch = time.perf_counter()
+                try:
+                    data_batch = next(data_source)
+                except StopIteration:
+                    break
+                fetch_s += time.perf_counter() - t_fetch
+                nbatch += 1
                 if monitor is not None:
                     monitor.tic()
                 self.forward_backward(data_batch)
@@ -283,6 +309,24 @@ class BaseModule:
                     batch_end_params = BatchEndParam(epoch=epoch, nbatch=nbatch, eval_metric=eval_metric, locals=locals())
                     for callback in _as_list(batch_end_callback):
                         callback(batch_end_params)
+
+            # input-bound fraction of this epoch's wall time
+            # (docs/OBSERVABILITY.md io.input_bound_pct): visible without a
+            # trace, warned once per fit past 10%
+            epoch_wall = time.time() - tic
+            if epoch_wall > 0 and nbatch >= 0:
+                input_pct = 100.0 * fetch_s / epoch_wall
+                if _tm.enabled():
+                    _tm.gauge("io.input_bound_pct").set(round(input_pct, 2))
+                if input_pct > 10.0 and not warned_input_bound:
+                    warned_input_bound = True
+                    self.logger.warning(
+                        "input-bound: %.1f%% of epoch %d's wall time was "
+                        "spent waiting on the data iterator "
+                        "(io.input_bound_pct). Enable device-side prefetch "
+                        "(MXNET_IO_DEVICE_PREFETCH=1 / io.DevicePrefetchIter"
+                        ") or deepen the prefetch queue so input stops "
+                        "gating the step.", input_pct, epoch)
 
             if getattr(eval_metric, "num_inst", 1):
                 for name, val in eval_metric.get_name_value():

@@ -48,7 +48,8 @@ import jax.numpy as jnp
 
 from .registry import get_op
 
-__all__ = ["Match", "Pattern", "get_patterns", "pattern_names", "sig_of"]
+__all__ = ["Match", "Pattern", "get_patterns", "pattern_names", "sig_of",
+           "tuner_build"]
 
 
 class Match:
@@ -84,6 +85,59 @@ def sig_of(args):
     cache key's site component."""
     return ";".join("%s%s" % (str(np.dtype(a.dtype).name),
                               tuple(a.shape)) for a in args)
+
+
+# ------------------------------------------------------------ schedule helpers
+def _sched_budget():
+    """How many schedule variants (beyond the planner default) a pattern
+    may emit per candidate family (``MXNET_FUSION_TUNE_SCHEDULES``)."""
+    from .. import fusion_tune
+
+    return fusion_tune.schedule_budget()
+
+
+def _sname(base, **kv):
+    from .. import fusion_tune
+
+    return fusion_tune.sched_name(base, **kv)
+
+
+import contextlib
+import threading
+
+_tuner_scope = threading.local()
+
+
+@contextlib.contextmanager
+def tuner_build():
+    """Marks a ``build()`` call made to CONSTRUCT MEASUREMENT candidates
+    (the auto-mode tuner): force-gated interpret candidates are excluded
+    inside this scope, so an inference-map force (e.g. a serving pin of
+    ``attention=pallas_flash``) can never leak emulated off-TPU Pallas
+    into a training-side measurement."""
+    _tuner_scope.active = True
+    try:
+        yield
+    finally:
+        _tuner_scope.active = False
+
+
+def _forced_lowering_requested(pattern_name, prefix):
+    """Whether MXNET_FUSED_PATTERNS[_INFER] forces a lowering whose name
+    starts with ``prefix`` for this pattern — the opt-in that makes
+    ``build`` include interpret-mode Pallas candidates off-TPU (auto-mode
+    tuning never measures interpret kernels at real shapes: the emulation
+    is orders of magnitude off the question being asked, which is also
+    why the ``tuner_build`` scope suppresses this check entirely)."""
+    if getattr(_tuner_scope, "active", False):
+        return False
+    from .. import fusion
+
+    for infer in (False, True):
+        m = fusion.enabled_patterns(infer=infer).get(pattern_name, "0")
+        if m not in ("0", "1", "auto") and m.startswith(prefix):
+            return True
+    return False
 
 
 # --------------------------------------------------------------- match helpers
@@ -199,25 +253,47 @@ class MatmulBiasAct(Pattern):
             k = int(x.shape[-1])
         n = int(w.shape[0])
         cands = []
-        if k == int(w.shape[1]) and pk.supported(
-                m, k, n, act, itemsize=jnp.dtype(x.dtype).itemsize):
+        if k == int(w.shape[1]):
+            blocks = pk.block_candidates(
+                m, k, n, act, itemsize=jnp.dtype(x.dtype).itemsize)
 
-            def fused(x, w, b=None, _m=m, _k=k, _n=n):
-                x2 = x.reshape((_m, _k))
-                bb = b if b is not None else jnp.zeros((_n,), x.dtype)
-                y = pk.matmul_bias_act(x2, w, bb, meta["act"])
-                if meta["flatten"]:
-                    return y
-                return y.reshape(x.shape[:-1] + (_n,))
+            def make(bm, bn):
+                def fused(x, w, b=None, _m=m, _k=k, _n=n, _bm=bm, _bn=bn):
+                    x2 = x.reshape((_m, _k))
+                    bb = b if b is not None else jnp.zeros((_n,), x.dtype)
+                    y = pk.matmul_bias_act(x2, w, bb, meta["act"], _bm, _bn)
+                    if meta["flatten"]:
+                        return y
+                    return y.reshape(x.shape[:-1] + (_n,))
 
-            cands.append(("pallas", fused))
+                return fused
+
+            if blocks:
+                # planner default keeps the bare name (v1 cache records
+                # resolve to it); the schedule variants carry their blocks
+                cands.append(("pallas", make(*blocks[0])))
+                for bm, bn in blocks[1:1 + _sched_budget()]:
+                    cands.append((_sname("pallas", bm=bm, bn=bn),
+                                  make(bm, bn)))
         return baseline, cands
 
 
 # ------------------------------------------------------------------ attention
 class Attention(Pattern):
-    """The fused MultiHeadAttention op: block-causal XLA (causal sites) or
-    Pallas flash (TPU), measured against the op's own dense lowering."""
+    """The fused MultiHeadAttention op. Candidate lowerings per site shape:
+
+    - ``block_causal`` (causal, T == S): never computes the masked
+      upper-triangle key blocks — ~half the score FLOPs, exact parity.
+    - ``chunked_kv`` (decode/cross-attention: T_q != T_kv and/or no causal
+      mask): streaming online-softmax over key chunks, so the (T, S) score
+      matrix never materializes whole — the serving-side decode lowering.
+    - ``pallas_flash`` (TPU; off-TPU only when force-named — interpret
+      mode): the hand-tiled flash kernel, fwd AND bwd (``custom_vjp``
+      online-softmax recompute backward), so TRAINING through this site
+      stops stashing the (B, H, T, S) probability tensor.
+
+    Each family fans out over the autotuner's bounded schedule space
+    (block/chunk sizes), measured against the op's own dense lowering."""
 
     name = "attention"
 
@@ -241,12 +317,7 @@ class Attention(Pattern):
     def externals(self, meta, ins, resolve):
         return tuple(resolve(v) for v in ins)  # (q, k, v)
 
-    @classmethod
-    def _block_for(cls, T):
-        for bq in cls._BLOCKS:
-            if T % bq == 0 and T > bq:
-                return bq
-        return None
+    _CHUNKS = (128, 256, 64, 32)
 
     def build(self, meta, args):
         q, k, _ = args
@@ -266,10 +337,7 @@ class Attention(Pattern):
             p = jax.nn.softmax(s, axis=-1)
             return jnp.einsum("bhqk,bhkd->bhqd", p, v32).astype(q.dtype)
 
-        cands = []
-        bq = self._block_for(T) if (causal and T == S) else None
-        if bq is not None:
-
+        def make_block_causal(bq):
             def block_causal(q, k, v, _bq=bq):
                 # query block i attends keys [0, (i+1)*bq): the masked
                 # upper-triangle key blocks are never computed at all
@@ -288,17 +356,90 @@ class Attention(Pattern):
                                            v32[:, :, :end]))
                 return jnp.concatenate(outs, axis=2).astype(q.dtype)
 
-            cands.append(("block_causal", block_causal))
-        if jax.default_backend() == "tpu":
+            return block_causal
+
+        def make_chunked(ck):
+            def chunked(q, k, v, _ck=ck):
+                # streaming online softmax over key chunks: the (T, S)
+                # score matrix exists only one (T, ck) slab at a time.
+                # Bottom-right causal alignment (row r sees cols <= r+S-T)
+                # matches the op; with S >= T the first chunk's lowest
+                # cols are visible to every row, so the running max is
+                # real before any fully-masked tail entry (whose
+                # exp(-1e30 - m) underflows to exactly 0).
+                q32 = q.astype(jnp.float32) * scale
+                k32, v32 = k.astype(jnp.float32), v.astype(jnp.float32)
+                B, H, Tq, D = q.shape
+                Sk = k.shape[2]
+                off = Sk - Tq
+                rows = jnp.arange(Tq)
+                neg = jnp.float32(-1e30)
+
+                def body(carry, i):
+                    m, l, acc = carry
+                    kc = jax.lax.dynamic_slice_in_dim(k32, i * _ck, _ck,
+                                                      axis=2)
+                    vc = jax.lax.dynamic_slice_in_dim(v32, i * _ck, _ck,
+                                                      axis=2)
+                    s = jnp.einsum("bhqd,bhkd->bhqk", q32, kc)
+                    if causal:
+                        cols = i * _ck + jnp.arange(_ck)
+                        s = jnp.where(cols[None, :] <= rows[:, None] + off,
+                                      s, neg)
+                    m_new = jnp.maximum(m, s.max(axis=-1, keepdims=True))
+                    alpha = jnp.exp(m - m_new)
+                    p = jnp.exp(s - m_new)
+                    l_new = l * alpha + p.sum(axis=-1, keepdims=True)
+                    acc_new = acc * alpha + jnp.einsum("bhqk,bhkd->bhqd",
+                                                       p, vc)
+                    return (m_new, l_new, acc_new), None
+
+                init = (jnp.full((B, H, Tq, 1), neg),
+                        jnp.zeros((B, H, Tq, 1), jnp.float32),
+                        jnp.zeros((B, H, Tq, D), jnp.float32))
+                (_, l, acc), _ = jax.lax.scan(body, init,
+                                              jnp.arange(Sk // _ck))
+                return (acc / jnp.maximum(l, 1e-30)).astype(q.dtype)
+
+            return chunked
+
+        budget = _sched_budget()
+        cands = []
+        if causal and T == S:
+            bqs = [b for b in self._BLOCKS if T % b == 0 and T > b]
+            if bqs:
+                cands.append(("block_causal", make_block_causal(bqs[0])))
+                cands.extend((_sname("block_causal", bq=b),
+                              make_block_causal(b))
+                             for b in bqs[1:1 + budget])
+        elif not causal or S >= T:
+            # decode/cross-attention shapes: T_q != T_kv and/or no mask
+            cks = [c for c in self._CHUNKS if S % c == 0 and S > c]
+            if cks:
+                cands.append(("chunked_kv", make_chunked(cks[0])))
+                cands.extend((_sname("chunked_kv", ck=c), make_chunked(c))
+                             for c in cks[1:1 + budget])
+        on_tpu = jax.default_backend() == "tpu"
+        if on_tpu or _forced_lowering_requested(self.name, "pallas_flash"):
             from . import pallas_attention as pa
 
-            if pa.supported(q.shape, k.shape, causal=causal):
+            interp = not on_tpu
 
-                def flash(q, k, v):
-                    return pa.flash_attention(q, k, v, causal=causal,
-                                              scale=max(meta["scale"], 0.0))
+            def make_flash(bq, bk):
+                def flash(q, k, v, _bq=bq, _bk=bk):
+                    return pa.flash_attention(
+                        q, k, v, causal=causal,
+                        scale=max(meta["scale"], 0.0),
+                        block_q=_bq, block_k=_bk, interpret=interp)
 
-                cands.append(("pallas_flash", flash))
+                return flash
+
+            scheds = pa.block_schedules(q.shape, k.shape, causal=causal)
+            if scheds:
+                cands.append(("pallas_flash", make_flash(*scheds[0])))
+                cands.extend((_sname("pallas_flash", q=bq, k=bk),
+                              make_flash(bq, bk))
+                             for bq, bk in scheds[1:1 + budget])
         return baseline, cands
 
 
@@ -438,9 +579,39 @@ class NormResidual(Pattern):
             return (out * gamma + beta).astype(x.dtype)
 
         # "fused" (the identical recomposition, bit-safe under force) is
-        # first so =1 engages it; the tuner measures both and only a real
-        # winner — usually "onepass" — clears the margin
-        return baseline, [("fused", baseline), ("onepass", onepass)]
+        # first so =1 engages it; the tuner measures all and only a real
+        # winner clears the margin
+        cands = [("fused", baseline), ("onepass", onepass)]
+
+        # the Pallas kernel lowering (ops/pallas_norm_residual.py): one
+        # VMEM-resident tile per row block, fwd AND bwd. TPU always;
+        # off-TPU only when force-named (interpret mode, parity tests)
+        from . import pallas_norm_residual as pn
+
+        x = args[0]
+        on_tpu = jax.default_backend() == "tpu"
+        if on_tpu or _forced_lowering_requested(self.name, "pallas"):
+            itemsize = jnp.dtype(x.dtype).itemsize
+            brs = pn.block_candidates(x.shape, itemsize)
+            interp = not on_tpu
+
+            def make_pallas(br):
+                def fused_pallas(x, gamma, beta, _br=br):
+                    # gamma/beta may carry broadcast shapes ((1,1,D)); the
+                    # reshape is traced, so its transpose restores the
+                    # cotangent shape
+                    D = x.shape[-1]
+                    return pn.layer_norm_affine(
+                        x, gamma.reshape(D), beta.reshape(D), eps,
+                        block_rows=_br, interpret=interp)
+
+                return fused_pallas
+
+            if brs:
+                cands.append(("pallas", make_pallas(brs[0])))
+                cands.extend((_sname("pallas", br=b), make_pallas(b))
+                             for b in brs[1:1 + _sched_budget()])
+        return baseline, cands
 
 
 # ------------------------------------------------------------- elemwise_chain

@@ -30,9 +30,27 @@ import jax
 import jax.numpy as jnp
 import numpy as np
 
-__all__ = ["flash_attention", "supported"]
+__all__ = ["flash_attention", "supported", "block_schedules"]
 
 _NEG_INF = -1e30
+
+
+def block_schedules(q_shape, k_shape, causal=False):
+    """Every valid (block_q, block_k) tiling for these shapes, planner
+    default first — the bounded schedule space the autotuner measures
+    (docs/PERF.md §15). Blocks are pre-clamped to (T, S) so each entry is
+    a distinct effective tiling."""
+    T, S = q_shape[2], k_shape[2]
+    seen, out = set(), []
+    for bq, bk in ((128, 128), (128, 256), (256, 128), (64, 128),
+                   (128, 64), (64, 64), (256, 256), (32, 32)):
+        eff = (min(bq, T), min(bk, S))
+        if eff in seen or not supported(q_shape, k_shape, causal=causal,
+                                        block_q=bq, block_k=bk):
+            continue
+        seen.add(eff)
+        out.append(eff)
+    return out
 
 
 def supported(q_shape, k_shape, causal=False, block_q=128, block_k=128):

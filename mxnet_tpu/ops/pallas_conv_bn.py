@@ -68,7 +68,7 @@ import jax.numpy as jnp
 import numpy as np
 
 __all__ = ["conv_block", "supported", "plan_blocks", "choose_blocks",
-           "plan_bwd_blocks", "choose_bwd_blocks"]
+           "bn_candidates", "plan_bwd_blocks", "choose_bwd_blocks"]
 
 _VMEM_BUDGET = 12 * 1024 * 1024
 
@@ -79,6 +79,17 @@ def choose_blocks(B, K, N, HW, itemsize, taps=1, prologue=False, res=False,
     of 8, that keeps the per-instance VMEM working set under budget) for the
     whole-HW tiling. Returns None if no stripe fits. ``emit_xn`` budgets the
     stash policy's extra xn output stream."""
+    cands = bn_candidates(B, K, N, HW, itemsize, taps=taps,
+                          prologue=prologue, res=res, emit_xn=emit_xn)
+    return cands[0] if cands else None
+
+
+def bn_candidates(B, K, N, HW, itemsize, taps=1, prologue=False, res=False,
+                  emit_xn=False):
+    """Every channel-stripe width that tiles within the VMEM budget,
+    largest (the planner default) first — the forward kernel's bounded
+    schedule space the autotuner measures (docs/PERF.md §15)."""
+    out = []
     for bn in (512, 256, 128, 64, 32, 16, 8):
         if N % bn:
             continue
@@ -94,8 +105,8 @@ def choose_blocks(B, K, N, HW, itemsize, taps=1, prologue=False, res=False,
             + (2 * K * HW * itemsize if emit_xn else 0)  # stashed xn out, db
         )
         if est <= _VMEM_BUDGET:
-            return bn
-    return None
+            out.append(bn)
+    return out
 
 
 def strided_dims(H, W, stride):
@@ -287,9 +298,10 @@ def _kernel(*refs, b_steps, bn, hw, taps, shifts, relu, has_prologue,
 
 @functools.partial(jax.jit, static_argnames=("kernel_hw", "stride", "relu",
                                              "interpret", "emit_xn",
-                                             "emit_stats"))
+                                             "emit_stats", "bn_override"))
 def _conv_block_fwd_impl(x, w, scale, shift, res, *, kernel_hw, stride,
-                         relu, interpret, emit_xn=False, emit_stats=True):
+                         relu, interpret, emit_xn=False, emit_stats=True,
+                         bn_override=None):
     """Pallas forward. x (B,K,H,W); w (N,K,kh,kw); scale/shift (K,) or None;
     res (B,N,H',W') or None. Returns (c, ssum, ssq) plus the materialized
     prologue activation xn (post-stride shape) when ``emit_xn`` (the
@@ -309,9 +321,13 @@ def _conv_block_fwd_impl(x, w, scale, shift, res, *, kernel_hw, stride,
     taps = kh * kw
     dt = x.dtype
     has_prologue = scale is not None
-    bn = choose_blocks(B, K, N, HW, dt.itemsize, taps=taps,
-                       prologue=has_prologue, res=res is not None,
-                       emit_xn=emit_xn)
+    cands = bn_candidates(B, K, N, HW, dt.itemsize, taps=taps,
+                          prologue=has_prologue, res=res is not None,
+                          emit_xn=emit_xn)
+    # the autotuner's measured stripe wins when it still tiles; anything
+    # else (stale schedule, flag drift) silently demotes to the planner pick
+    bn = bn_override if bn_override in cands else (
+        cands[0] if cands else None)
     assert bn is not None, (x.shape, w.shape)  # callers gate via plan_blocks
     n_tiles = N // bn
 
@@ -417,9 +433,9 @@ def _stats_of(c):
     return jnp.sum(c32, axis=(0, 2, 3)), jnp.sum(c32 * c32, axis=(0, 2, 3))
 
 
-@functools.partial(jax.custom_vjp, nondiff_argnums=(5, 6, 7, 8, 9))
+@functools.partial(jax.custom_vjp, nondiff_argnums=(5, 6, 7, 8, 9, 10))
 def conv_block(x, w, scale, shift, res, kernel_hw=(1, 1), stride=(1, 1),
-               relu=False, use_pallas=True, bwd="xla"):
+               relu=False, use_pallas=True, bwd="xla", bn=None):
     """Fused (prologue-normalized) conv (+residual) with statistics epilogue.
 
     Returns ``(c, ssum, ssq)``: the conv output (x.dtype) and per-channel
@@ -433,10 +449,12 @@ def conv_block(x, w, scale, shift, res, kernel_hw=(1, 1), stride=(1, 1),
     re-derived in VMEM) or ``"stash"`` (fused Pallas backward streaming the
     forward-materialized xn). Non-"xla" modes silently demote — stash →
     recompute when the forward could not emit xn, and either → "xla" when
-    ``plan_bwd_blocks`` cannot tile the shape.
+    ``plan_bwd_blocks`` cannot tile the shape. ``bn`` overrides the forward
+    channel-stripe width (the autotuner's measured schedule; an invalid
+    override demotes to the planner pick).
     """
     c, s, q = _conv_block_fwd(x, w, scale, shift, res, kernel_hw, stride,
-                              relu, use_pallas, bwd)[0]
+                              relu, use_pallas, bwd, bn)[0]
     return c, s, q
 
 
@@ -458,7 +476,7 @@ def _interpret_mode():
 
 
 def _conv_block_fwd(x, w, scale, shift, res, kernel_hw, stride, relu,
-                    use_pallas, bwd="xla"):
+                    use_pallas, bwd="xla", bn=None):
     planned = use_pallas and plan_blocks(
         x.shape, w.shape, stride, itemsize=x.dtype.itemsize,
         prologue=scale is not None, res=res is not None) is not None
@@ -478,7 +496,8 @@ def _conv_block_fwd(x, w, scale, shift, res, kernel_hw, stride, relu,
     if planned:
         outs = _conv_block_fwd_impl(
             x, w, scale, shift, res, kernel_hw=kernel_hw, stride=stride,
-            relu=relu, interpret=_interpret_mode(), emit_xn=stash)
+            relu=relu, interpret=_interpret_mode(), emit_xn=stash,
+            bn_override=bn)
         if stash:
             c, s, q, xn = outs
         else:
@@ -701,7 +720,8 @@ def _conv_block_bwd_impl(x, w, scale, shift, c, dc, ds, dq, xn, *,
     return dx, dw, dscale, dshift, dres
 
 
-def _conv_block_bwd(kernel_hw, stride, relu, use_pallas, bwd, saved, cts):
+def _conv_block_bwd(kernel_hw, stride, relu, use_pallas, bwd, bn, saved,
+                    cts):
     x, w, scale, shift, res, c, xn = saved
     dc, ds, dq = cts
     has_prologue = scale is not None

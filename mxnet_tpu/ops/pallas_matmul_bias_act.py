@@ -28,7 +28,8 @@ import functools
 import jax
 import jax.numpy as jnp
 
-__all__ = ["matmul_bias_act", "supported", "ACTIVATIONS"]
+__all__ = ["matmul_bias_act", "supported", "block_candidates",
+           "ACTIVATIONS"]
 
 # activation -> (apply on f32, derivative from the ACTIVATED output)
 ACTIVATIONS = {
@@ -97,23 +98,42 @@ def _interpret_mode():
     return jax.default_backend() != "tpu"
 
 
-@functools.partial(jax.custom_vjp, nondiff_argnums=(3,))
-def matmul_bias_act(a, w, b, act="relu"):
+def block_candidates(m, k, n, act, itemsize=2):
+    """The bounded (block_m, block_n) schedule space the autotuner measures
+    for this shape (docs/PERF.md §15): the planner default first, then the
+    supported variants with a DISTINCT effective tiling (a variant that
+    clamps to the same (bm, bn) as the default would measure the identical
+    program twice)."""
+    seen, out = set(), []
+    for bm, bn in ((512, 256), (256, 256), (512, 128), (256, 128),
+                   (128, 256), (1024, 256), (512, 512)):
+        eff = (min(bm, m), min(bn, n))
+        if eff in seen or not supported(m, k, n, act, bm, bn, itemsize):
+            continue
+        seen.add(eff)
+        out.append((bm, bn))
+    return out
+
+
+@functools.partial(jax.custom_vjp, nondiff_argnums=(3, 4, 5))
+def matmul_bias_act(a, w, b, act="relu", block_m=512, block_n=256):
     """``act(a @ w.T + b)`` with the epilogue fused into the matmul tile.
 
     a: (M, K), w: (N, K), b: (N,); output keeps ``a.dtype``, epilogue math
-    in f32 from the MXU accumulator. Callers gate with ``supported()``.
-    Interpret mode engages automatically off-TPU (parity tests on CPU).
+    in f32 from the MXU accumulator. Callers gate with ``supported()``;
+    ``block_m``/``block_n`` are the autotuner's schedule axis (defaults =
+    the planner-default tiling). Interpret mode engages automatically
+    off-TPU (parity tests on CPU).
     """
-    return _fwd_call(a, w, b, act, 512, 256, _interpret_mode())
+    return _fwd_call(a, w, b, act, block_m, block_n, _interpret_mode())
 
 
-def _mba_fwd(a, w, b, act):
-    y = _fwd_call(a, w, b, act, 512, 256, _interpret_mode())
+def _mba_fwd(a, w, b, act, block_m, block_n):
+    y = _fwd_call(a, w, b, act, block_m, block_n, _interpret_mode())
     return y, (a, w, b, y)
 
 
-def _mba_bwd(act, saved, dy):
+def _mba_bwd(act, block_m, block_n, saved, dy):
     a, w, b, y = saved
     dpre = dy.astype(jnp.float32) * ACTIVATIONS[act][1](
         y.astype(jnp.float32))

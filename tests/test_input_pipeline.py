@@ -1,0 +1,161 @@
+"""The double-buffered device-side input pipeline (io.DevicePrefetchIter,
+docs/PERF.md §15): bit-identical training through Module.fit, the
+on-device augment hook, epoch cycling, error propagation, the
+``io.input_bound_pct`` gauge + fit warning, and the
+MXNET_IO_DEVICE_PREFETCH auto-wrap."""
+import logging
+
+import numpy as np
+import pytest
+
+import mxnet_tpu as mx
+from mxnet_tpu import telemetry
+from mxnet_tpu.io import DevicePrefetchIter, device_prefetch_enabled
+
+
+@pytest.fixture(autouse=True)
+def _counters():
+    saved = telemetry.current_override()
+    telemetry.set_mode("counters")
+    telemetry.reset()
+    yield
+    telemetry.reset()
+    telemetry.set_mode(saved)
+
+
+def _mlp():
+    s = mx.sym.Variable("data")
+    s = mx.sym.FullyConnected(s, num_hidden=32, name="fc1")
+    s = mx.sym.Activation(s, act_type="relu")
+    s = mx.sym.FullyConnected(s, num_hidden=10, name="fc2")
+    return mx.sym.SoftmaxOutput(s, name="softmax")
+
+
+def _init_params(rs):
+    return {"fc1_weight": mx.nd.array(rs.rand(32, 16).astype("f") * 0.1),
+            "fc1_bias": mx.nd.array(np.zeros(32, "f")),
+            "fc2_weight": mx.nd.array(rs.rand(10, 32).astype("f") * 0.1),
+            "fc2_bias": mx.nd.array(np.zeros(10, "f"))}
+
+
+def _fit(it, n_epoch=2):
+    mod = mx.mod.Module(_mlp(), context=mx.cpu())
+    mod.fit(it, num_epoch=n_epoch, kvstore="local",
+            arg_params=_init_params(np.random.RandomState(7)),
+            initializer=None)
+    args, _ = mod.get_params()
+    return {k: v.asnumpy() for k, v in args.items()}
+
+
+def _data_iter():
+    rs = np.random.RandomState(0)
+    return mx.io.NDArrayIter(rs.rand(48, 16).astype("f"),
+                             rs.randint(0, 10, (48,)).astype("f"),
+                             batch_size=8)
+
+
+def test_fit_is_bitwise_identical_with_prefetch():
+    plain = _fit(_data_iter())
+    wrapped = _fit(DevicePrefetchIter(_data_iter()))
+    for k in plain:
+        assert np.array_equal(plain[k], wrapped[k]), k
+
+
+def test_input_bound_gauge_set_by_fit():
+    _fit(_data_iter())
+    assert telemetry.gauge("io.input_bound_pct").value >= 0.0
+
+
+def test_augment_hook_runs_on_device():
+    """The jitted augment hook transforms the DATA arrays ahead of the
+    step — training with a scale-by-2 augment must differ from training
+    without it, and match training on pre-scaled host data."""
+    import jax.numpy as jnp
+
+    aug = _fit(DevicePrefetchIter(_data_iter(),
+                                  augment=lambda d: (d * jnp.float32(2),)))
+    plain = _fit(_data_iter())
+    assert not all(np.array_equal(aug[k], plain[k]) for k in aug)
+    rs = np.random.RandomState(0)
+    pre = mx.io.NDArrayIter((rs.rand(48, 16).astype("f") * 2),
+                            rs.randint(0, 10, (48,)).astype("f"),
+                            batch_size=8)
+    ref = _fit(pre)
+    for k in aug:
+        np.testing.assert_allclose(aug[k], ref[k], rtol=0, atol=1e-6)
+
+
+def test_epoch_cycling_and_reset():
+    it = DevicePrefetchIter(_data_iter())
+    for _ in range(2):
+        n = sum(1 for _ in it)
+        assert n == 6
+        it.reset()
+    assert it.wait_s >= 0.0
+
+
+def test_batches_match_child_bitwise():
+    a, b = _data_iter(), _data_iter()
+    wrapped = DevicePrefetchIter(b)
+    for ba, bb in zip(a, wrapped):
+        for x, y in zip(ba.data + ba.label, bb.data + bb.label):
+            assert np.array_equal(x.asnumpy(), y.asnumpy())
+        assert ba.pad == bb.pad
+
+
+def test_child_error_surfaces_to_consumer():
+    class Boom(mx.io.DataIter):
+        provide_data = [mx.io.DataDesc("data", (4, 8))]
+        provide_label = [mx.io.DataDesc("softmax_label", (4,))]
+        batch_size = 4
+
+        def __init__(self):
+            self.n = 0
+
+        def next(self):
+            self.n += 1
+            if self.n > 2:
+                raise RuntimeError("child blew up")
+            rs = np.random.RandomState(self.n)
+            return mx.io.DataBatch([mx.nd.array(rs.rand(4, 8))],
+                                   [mx.nd.array(np.zeros(4, "f"))], 0, None)
+
+    it = DevicePrefetchIter(Boom())
+    assert it.iter_next() and it.iter_next()
+    with pytest.raises(RuntimeError, match="child blew up"):
+        it.iter_next()
+
+
+def test_env_knob_wraps_fit(monkeypatch, caplog):
+    monkeypatch.setenv("MXNET_IO_DEVICE_PREFETCH", "1")
+    assert device_prefetch_enabled()
+    with caplog.at_level(logging.INFO):
+        wrapped = _fit(_data_iter())
+    assert any("DevicePrefetchIter" in r.message for r in caplog.records)
+    monkeypatch.delenv("MXNET_IO_DEVICE_PREFETCH")
+    plain = _fit(_data_iter())
+    for k in plain:
+        assert np.array_equal(plain[k], wrapped[k]), k
+
+
+def test_input_bound_warning_fires(monkeypatch, caplog):
+    """A deliberately slow iterator trips the >10% input-bound warning."""
+    import time as _time
+
+    class Slow(mx.io.DataIter):
+        def __init__(self, child):
+            self.child = child
+            self.provide_data = child.provide_data
+            self.provide_label = child.provide_label
+            self.batch_size = child.batch_size
+
+        def reset(self):
+            self.child.reset()
+
+        def next(self):
+            _time.sleep(0.02)
+            return self.child.next()
+
+    with caplog.at_level(logging.WARNING):
+        _fit(Slow(_data_iter()), n_epoch=1)
+    assert any("input-bound" in r.message for r in caplog.records)

@@ -1,0 +1,137 @@
+"""The Pallas norm+residual kernel (ops/pallas_norm_residual.py): fwd+bwd
+parity against the unfused LayerNorm composition, schedule-override
+invariance, tiling gates, and the pattern-level engagement through the
+``norm_residual`` fusion pattern (forced ``=pallas``, interpret mode)."""
+import numpy as np
+import pytest
+
+import jax
+import jax.numpy as jnp
+
+import mxnet_tpu as mx
+from mxnet_tpu.ops import pallas_norm_residual as pn
+
+_EPS = 1e-5
+
+
+def _ref(x, g, b):
+    m = jnp.mean(x, axis=-1, keepdims=True)
+    c = x - m
+    v = jnp.mean(c * c, axis=-1, keepdims=True)
+    return c * jax.lax.rsqrt(v + _EPS) * g + b
+
+
+def _data(shape, dtype="float32", seed=0):
+    rs = np.random.RandomState(seed)
+    D = shape[-1]
+    return (jnp.asarray(rs.randn(*shape), dtype),
+            jnp.asarray(rs.uniform(0.5, 1.5, (D,)), dtype),
+            jnp.asarray(rs.uniform(-0.2, 0.2, (D,)), dtype))
+
+
+@pytest.mark.parametrize("dtype,tol", [("float32", 2e-6),
+                                       ("bfloat16", 4e-2)])
+def test_kernel_fwd_bwd_parity(dtype, tol):
+    x, g, b = _data((4, 16, 128), dtype)
+    y = pn.layer_norm_affine(x, g, b, _EPS)
+    ref = _ref(x.astype(jnp.float32), g.astype(jnp.float32),
+               b.astype(jnp.float32))
+    assert np.max(np.abs(np.asarray(y, "float32") - np.asarray(ref))) <= tol
+
+    out, vjp = jax.vjp(lambda x, g, b: pn.layer_norm_affine(x, g, b, _EPS),
+                       x, g, b)
+    _, rvjp = jax.vjp(_ref, x, g, b)
+    do = jnp.ones_like(out)
+    for name, a, r in zip(("dx", "dgamma", "dbeta"), vjp(do), rvjp(do)):
+        err = np.max(np.abs(np.asarray(a, "float32")
+                            - np.asarray(r, "float32")))
+        denom = np.max(np.abs(np.asarray(r, "float32"))) + 1e-9
+        assert err / denom <= max(tol, 1e-5), (name, err)
+
+
+def test_schedule_override_is_bitwise_invariant():
+    """A different row-block height changes the grid, never the numbers:
+    rows are independent, so every valid schedule is bit-identical."""
+    x, g, b = _data((4, 16, 128))
+    cands = pn.block_candidates(x.shape, 4)
+    assert len(cands) >= 2
+    ref = np.asarray(pn.layer_norm_affine(x, g, b, _EPS,
+                                          block_rows=cands[0]))
+    for br in cands[1:]:
+        got = np.asarray(pn.layer_norm_affine(x, g, b, _EPS,
+                                              block_rows=br))
+        assert np.array_equal(ref, got), br
+
+
+def test_tiling_gates():
+    assert pn.supported((4, 16, 128))
+    assert not pn.supported((4, 16, 100))    # D not lane-aligned
+    assert not pn.supported((7, 128))        # rows < 8
+    assert pn.choose_block_rows((4, 16, 128)) == 64
+    with pytest.raises(ValueError):
+        pn.layer_norm_affine(*_data((4, 16, 100)), eps=_EPS)
+    with pytest.raises(ValueError):
+        # an override that does not divide the rows is refused, not demoted
+        # (the caller asked for a specific measured schedule)
+        pn.layer_norm_affine(*_data((4, 16, 128)), eps=_EPS, block_rows=48)
+
+
+# ------------------------------------------------------------ pattern level
+def _ln_net(dim):
+    sym = mx.sym
+    x = sym.Variable("data")
+    mean = sym.mean(x, axis=-1, keepdims=True)
+    cent = sym.broadcast_sub(x, mean, name="cent")
+    var = sym.mean(sym.square(cent), axis=-1, keepdims=True)
+    inv = sym.rsqrt(var + _EPS)
+    normed = sym.broadcast_mul(cent, inv)
+    gamma = sym.Variable("ln_gamma", shape=(dim,))
+    beta = sym.Variable("ln_beta", shape=(dim,))
+    out = sym.broadcast_add(sym.broadcast_mul(normed, gamma), beta,
+                            name="ln")
+    fc = sym.FullyConnected(out, num_hidden=4, flatten=True, name="head")
+    return sym.SoftmaxOutput(fc, name="softmax")
+
+
+def _run(net, shapes, env, monkeypatch):
+    monkeypatch.setenv("MXNET_FUSED_PATTERNS", env)
+    monkeypatch.delenv("MXNET_FUSION_TUNE_DIR", raising=False)
+    rs = np.random.RandomState(3)
+    ex = net.simple_bind(mx.cpu(), grad_req="write", **shapes)
+    for name, arr in zip(net.list_arguments(), ex.arg_arrays):
+        arr[:] = (rs.randint(0, 4, arr.shape) if "label" in name
+                  else rs.uniform(-0.5, 0.5, arr.shape)).astype("f")
+    outs = ex.forward(is_train=True)
+    host = [o.asnumpy() for o in outs]
+    ex.backward()
+    grads = {n: (g.asnumpy() if g is not None else None)
+             for n, g in ex.grad_dict.items()}
+    return host, grads
+
+
+def test_pattern_forced_pallas_parity(monkeypatch):
+    """MXNET_FUSED_PATTERNS=norm_residual=pallas engages the kernel at the
+    zoo LayerNorm composition (interpret mode on CPU) with fwd+bwd parity
+    vs the unfused graph."""
+    net = _ln_net(128)
+    shapes = {"data": (4, 8, 128), "softmax_label": (4,)}
+    ref = _run(net, shapes, "0", monkeypatch)
+    got = _run(net, shapes, "norm_residual=pallas", monkeypatch)
+    for a, b in zip(ref[0], got[0]):
+        assert np.max(np.abs(a - b)) / (np.max(np.abs(a)) + 1e-9) <= 1e-5
+    for k in ref[1]:
+        if ref[1][k] is None:
+            continue
+        denom = np.max(np.abs(ref[1][k])) + 1e-9
+        assert np.max(np.abs(ref[1][k] - got[1][k])) / denom <= 1e-5, k
+
+
+def test_pattern_untileable_dim_falls_back_clean(monkeypatch):
+    """A force-named pallas lowering at a shape the kernel cannot tile
+    (D=32) falls back to the unfused graph — never a crash."""
+    net = _ln_net(32)
+    shapes = {"data": (4, 8, 32), "softmax_label": (4,)}
+    ref = _run(net, shapes, "0", monkeypatch)
+    got = _run(net, shapes, "norm_residual=pallas", monkeypatch)
+    for a, b in zip(ref[0], got[0]):
+        assert np.array_equal(a, b)

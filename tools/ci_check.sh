@@ -189,45 +189,61 @@ rm -f "$FUSED_TABLE"
 JAX_PLATFORMS=cpu python -m pytest tests/test_pallas_conv_bn_bwd.py -q \
     -m 'not slow' -p no:cacheprovider \
     || { echo "bwd parity subset FAILED"; exit 1; }
-# pattern-engine autotune-cache smoke (docs/PERF.md §13): tune ONE tiny
-# matmul+bias+act site into a temp dir, then re-run the SAME fit against
-# the warmed cache — fusion.tune must fire exactly once ACROSS both runs
-# (cold run: 1, warm run: 0) and fusion.tune_cache_hit must fire in the
-# second. This is the measure-and-cache contract: tune once per device
-# kind, ever.
+# pattern-engine schedule-cache smoke (docs/PERF.md §13/§15): tune ONE
+# matmul+bias+act site — large enough that the (bm, bn) schedule fan-out
+# has >1 distinct effective tiling — into a temp dir, then re-run the SAME
+# fit against the warmed cache. Gate: the cold run tunes exactly once AND
+# searches ≥1 schedule variant (the persisted record carries
+# schedules_searched ≥ 1); the warm run is all cache hits with ZERO
+# re-tunes and ZERO post-warmup retraces. This is the measure-and-cache
+# contract: tune once per device kind, ever — now per SCHEDULE.
 TUNE_DIR="$(mktemp -d /tmp/fusion_tune_ci.XXXXXX)"
 for run in 1 2; do
 JAX_PLATFORMS=cpu MXNET_DEFAULT_CONTEXT=cpu MXNET_TELEMETRY=counters \
 MXNET_FUSION_TUNE_DIR="$TUNE_DIR" MXNET_FUSED_PATTERNS=matmul_bias_act \
 MXNET_FUSION_TUNE_ITERS=2 \
-python - "$run" <<'PYEOF' || { echo "autotune-cache smoke FAILED (run $run)"; rm -rf "$TUNE_DIR"; exit 1; }
-import sys
+python - "$run" <<'PYEOF' || { echo "schedule-cache smoke FAILED (run $run)"; rm -rf "$TUNE_DIR"; exit 1; }
+import json, sys
 import numpy as np
 import mxnet_tpu as mx
-from mxnet_tpu import telemetry
+from mxnet_tpu import fusion_tune, telemetry
 
 run = int(sys.argv[1])
 x = mx.sym.Variable("data")
-h = mx.sym.FullyConnected(x, num_hidden=128, name="fc1")
+h = mx.sym.FullyConnected(x, num_hidden=256, name="fc1")
 h = mx.sym.Activation(h, act_type="relu", name="act1")
 net = mx.sym.SoftmaxOutput(
     mx.sym.FullyConnected(h, num_hidden=4, name="fc2"), name="softmax")
 rs = np.random.RandomState(0)
-ex = net.simple_bind(mx.cpu(), data=(8, 32), softmax_label=(8,),
+ex = net.simple_bind(mx.cpu(), data=(256, 32), softmax_label=(256,),
                      grad_req="write")
 for name, arr in zip(net.list_arguments(), ex.arg_arrays):
     arr[:] = (rs.randint(0, 4, arr.shape) if "label" in name
               else rs.uniform(-0.5, 0.5, arr.shape)).astype("f")
 ex.forward(is_train=True)
 ex.backward()
+# a second execution through the same executor: any retrace here would
+# break the warm-run zero-retrace contract
+ex.forward(is_train=True)
+ex.backward()
 tunes = telemetry.counter("fusion.tune").value
 hits = telemetry.counter("fusion.tune_cache_hit").value
+retraces = telemetry.counter("executor.retrace").value
+sched = 0
+payload = json.load(open(fusion_tune.cache_path()))
+assert payload["version"] == 2, payload.get("version")
+for rec in payload["entries"].values():
+    sched = max(sched, rec.get("schedules_searched", 0))
 if run == 1:
     assert tunes == 1, "cold run must tune exactly once, got %d" % tunes
+    assert sched >= 1, "cold run must search >=1 schedule variant"
 else:
     assert tunes == 0, "warm run must NOT re-tune, got %d" % tunes
     assert hits >= 1, "warm run must serve the verdict from the cache"
-print("autotune smoke run %d OK: tunes=%d cache_hits=%d" % (run, tunes, hits))
+assert retraces == 0, "post-warmup retraces: %d" % retraces
+print("schedule-cache smoke run %d OK: tunes=%d cache_hits=%d "
+      "schedules_searched=%d retraces=%d" % (run, tunes, hits, sched,
+                                             retraces))
 PYEOF
 done
 rm -rf "$TUNE_DIR"
