@@ -881,7 +881,8 @@ def _bench_serving():
                     ("qps", "p50_ms", "p99_ms", "batch_occupancy",
                      "retraces_post_warmup", "batching_speedup",
                      "qps_single_replica_closed", "replicas",
-                     "redispatches", "replica_restarts", "paged_kv")
+                     "redispatches", "replica_restarts", "paged_kv",
+                     "host_gap_ms", "host_gap_per_token", "host_argmax")
                     if rec.get(k) is not None}
             if name == "fleet":
                 keep["resolved"] = rec.get("resolved")

@@ -29,6 +29,8 @@ import os
 
 from ..base import MXNetError
 from .diagnostics import CODES, Diagnostic, Report, Severity, describe_code
+from .dispatch_lint import (dispatch_gap_pct, lint_dispatch_gaps,
+                            lint_dispatch_paths, lint_dispatch_source)
 from .engine_race import RecordingEngine, ScheduleTrace, analyze_trace
 from .manager import GraphContext, graph_pass, list_passes, run_graph_passes
 from .rewrite import (RewritePass, RewriteResult, graphrewrite_mode,
@@ -42,6 +44,8 @@ __all__ = [
     "lint", "lint_bind", "graphlint_mode",
     "rewrite", "verify_rewrite", "graphrewrite_mode", "RewritePass",
     "RewriteResult", "rewrite_pass_names", "pattern_site_counts",
+    "lint_dispatch_paths", "lint_dispatch_source", "lint_dispatch_gaps",
+    "dispatch_gap_pct",
 ]
 
 _LOG = logging.getLogger("mxnet_tpu.graphlint")

@@ -15,6 +15,9 @@ Examples::
         --mesh dp=8,model=2 --budget-gb 16   # sharding-plan + HBM planner
     python tools/graphlint transformer --rewrite       # GL6xx rewrite dump
     python tools/graphlint --all-models --rewrite --format json
+    python tools/graphlint --dispatch                  # GL7xx host-sync lint
+    python tools/graphlint --dispatch mxnet_tpu/serving --format json
+    python tools/graphlint --dispatch --trace profile.json   # + GL705
 """
 from __future__ import annotations
 
@@ -22,7 +25,7 @@ import argparse
 import json
 import sys
 
-from .diagnostics import CODES, Severity, describe_code
+from .diagnostics import CODES, describe_code
 
 # Default lint shapes/dtypes per zoo model: enough hints that the full
 # shape/dtype propagation runs end to end (labels backward-derive via
@@ -367,6 +370,73 @@ def _run_rewrite(args, targets, shapes, types) -> int:
     return 1 if verify_failed else 0
 
 
+def _format_dispatch_table(sites) -> str:
+    """The --dispatch per-site table: one row per finding, waiver column."""
+    rows = [("code", "site", "function", "waived", "finding")]
+    for s in sites:
+        msg = s["message"]
+        if len(msg) > 56:
+            msg = msg[:53] + "..."
+        rows.append((s["code"], "%s:%d" % (s["file"], s["line"]),
+                     s["function"], "waived" if s["waived"] else "-", msg))
+    widths = [max(len(r[i]) for r in rows) for i in range(len(rows[0]))]
+    out = ["== dispatch sites =="]
+    for r in rows:
+        out.append("  ".join(c.ljust(w) for c, w in zip(r, widths)).rstrip())
+    return "\n".join(out)
+
+
+def _run_dispatch(args, targets) -> int:
+    """The --dispatch mode: the source-level dispatch-discipline lint
+    (GL701-GL704, analysis/dispatch_lint.py) over Python files and
+    directories instead of Symbol graphs. Targets are *paths*; with none
+    given, the default scan surface is the serving hot paths plus the
+    benches that drive them (``dispatch_lint.DEFAULT_SCAN_PATHS``).
+    ``--trace DUMP.json`` additionally prices a telemetry capture: GL705
+    for any span whose measured host gap exceeds
+    ``MXNET_DISPATCHLINT_GAP_PCT`` of its device busy time.
+
+    A finding acknowledged with ``# graphlint: waive GL70x -- reason``
+    stays in the site table (column ``waived``) but does not fail the
+    run. Exit 0 when every static finding is waived (or none) and no
+    GL705 fired; 1 otherwise; 2 on an unreadable path or trace."""
+    from .dispatch_lint import (DEFAULT_SCAN_PATHS, lint_dispatch_gaps,
+                                lint_dispatch_paths)
+
+    try:
+        report, sites = lint_dispatch_paths(targets or None)
+    except OSError as exc:
+        print("graphlint: --dispatch: %s" % exc, file=sys.stderr)
+        return 2
+    gap_diags = []
+    if args.trace:
+        from ..telemetry.trace import gap_summary
+
+        try:
+            with open(args.trace) as f:
+                trace = json.load(f)
+        except (OSError, ValueError) as exc:
+            print("graphlint: cannot load --trace %s: %s"
+                  % (args.trace, exc), file=sys.stderr)
+            return 2
+        gap_diags = lint_dispatch_gaps(gap_summary(trace=trace, top=1000))
+        report.extend(gap_diags)
+    failed = any(not s["waived"] for s in sites) or bool(gap_diags)
+    if args.format == "json":
+        payload = {"target": "dispatch",
+                   "paths": list(targets) or list(DEFAULT_SCAN_PATHS),
+                   "sites": sites,
+                   "gaps": [d.to_dict() for d in gap_diags],
+                   "report": json.loads(report.to_json())}
+        print(json.dumps(payload, indent=2))
+    else:
+        print(report.format(min_severity=args.min_severity))
+        if sites:
+            print()
+            print(_format_dispatch_table(sites))
+    return 1 if failed else 0
+
+
 def main(argv=None) -> int:
     ap = argparse.ArgumentParser(
         prog="graphlint",
@@ -399,6 +469,20 @@ def main(argv=None) -> int:
                          "per-pass node counts, the fired-rule table and "
                          "the fusion-site delta per target "
                          "(docs/static_analysis.md §GL6xx)")
+    ap.add_argument("--dispatch", action="store_true",
+                    help="run the source-level dispatch-discipline lint "
+                         "(GL7xx: host sync inside dispatch loops, "
+                         "scan-able loops, host-side reductions, premature "
+                         "pulls) over Python files/dirs instead of Symbol "
+                         "graphs. Targets are paths; default: the serving "
+                         "hot paths. Findings carry file:line provenance "
+                         "and honor '# graphlint: waive GL70x -- reason' "
+                         "comments (docs/static_analysis.md)")
+    ap.add_argument("--trace", default=None, metavar="DUMP.json",
+                    help="with --dispatch: also price a telemetry "
+                         "chrome-trace dump — GL705 when a span's measured "
+                         "host gap exceeds MXNET_DISPATCHLINT_GAP_PCT of "
+                         "its device busy time")
     ap.add_argument("--rewrite-json", action="store_true",
                     help="with --rewrite: emit the machine-readable plan "
                          "dump as JSON, including the full provenance "
@@ -441,6 +525,9 @@ def main(argv=None) -> int:
         for code in sorted(CODES):
             print(describe_code(code))
         return 0
+
+    if args.dispatch:
+        return _run_dispatch(args, list(args.targets))
 
     targets = list(args.targets)
     if args.all_models:

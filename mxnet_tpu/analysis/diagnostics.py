@@ -18,6 +18,7 @@ Codes are grouped by pass family:
   * ``GL4xx`` — sharding-plan lint (``shard_lint.py``)
   * ``GL5xx`` — static memory-liveness / peak-HBM planner (``memory_plan.py``)
   * ``GL6xx`` — graph-rewrite provenance verifier (``rewrite.py``)
+  * ``GL7xx`` — dispatch-discipline analyzer (``dispatch_lint.py``)
 """
 from __future__ import annotations
 
@@ -114,6 +115,23 @@ CODES = {
     "GL605": (Severity.INFO,
               "rewrite summary: nodes folded/merged/removed with bytes-saved "
               "estimates"),
+    # --- dispatch-discipline analyzer (dispatch_lint.py) -------------------
+    "GL701": (Severity.WARNING,
+              "host sync inside a dispatch loop: a device->host pull feeds "
+              "the next iteration's dispatch"),
+    "GL702": (Severity.INFO,
+              "scan-able per-iteration dispatch: N identical executable "
+              "calls with loop-carried state could be one lax.scan megastep"),
+    "GL703": (Severity.WARNING,
+              "host-side reduction of a device output where an on-device "
+              "lowering exists (argmax/top-k/sampling)"),
+    "GL704": (Severity.WARNING,
+              "premature blocking pull serializes an in-flight async "
+              "dispatch chain"),
+    "GL705": (Severity.WARNING,
+              "measured dispatch gap: host time between executable return "
+              "and next enqueue exceeds the threshold fraction of device "
+              "time"),
 }
 
 

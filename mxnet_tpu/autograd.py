@@ -16,10 +16,10 @@ are not tracked as the marked variable.
 from __future__ import annotations
 
 import contextlib
-from typing import List, Optional
+from typing import List
 
 from .base import MXNetError
-from .ndarray import NDArray, _Chunk
+from .ndarray import NDArray
 
 __all__ = [
     "set_is_training",

@@ -20,14 +20,12 @@ Semantics kept from the reference:
 """
 from __future__ import annotations
 
-
-import os
 from typing import Dict, List, Optional
 
 import numpy as np
 
 from .base import MXNetError, np_dtype
-from .context import Context, current_context
+from .context import Context
 from .ndarray import NDArray, _Chunk, zeros
 from .ops.registry import get_op
 from . import telemetry as _tm

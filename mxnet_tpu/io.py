@@ -18,7 +18,6 @@ from collections import namedtuple
 import numpy as np
 
 from .base import MXNetError
-from . import ndarray as nd
 from . import telemetry as _tm
 from .ndarray import NDArray, array
 

@@ -7,7 +7,6 @@ deciding where the optimizer runs and moving gradients through the store.
 from __future__ import annotations
 
 from . import kvstore as kvs
-from .base import MXNetError
 
 __all__ = [
     "create_kvstore",

@@ -9,11 +9,7 @@ asnumpy).
 """
 from __future__ import annotations
 
-import math
-
 import numpy
-
-from .base import MXNetError
 
 __all__ = [
     "EvalMetric",

@@ -209,7 +209,7 @@ def get_prefill_symbol(vocab_size=32000, num_layers=6, num_heads=8,
 
 def get_decode_symbol(vocab_size=32000, num_layers=6, num_heads=8,
                       model_dim=512, ffn_dim=2048, max_len=64, pos_len=None,
-                      per_stream_slots=False, **kwargs):
+                      per_stream_slots=False, token_out=True, **kwargs):
     """Serving single-token decode graph (docs/SERVING.md): ONE token per
     stream through the ``get_symbol`` stack, attending over a preallocated
     ring KV buffer of ``max_len`` slots per layer. Compiles ONCE — every
@@ -247,7 +247,12 @@ def get_decode_symbol(vocab_size=32000, num_layers=6, num_heads=8,
     of the fused MultiHeadAttention op — same math, fp32-exact against the
     full-sequence forward at matching positions.
 
-    Outputs: ``[logits (B, vocab), k'_0, v'_0, ..., k'_{L-1}, v'_{L-1}]``.
+    Outputs: ``[logits (B, vocab), k'_0, v'_0, ..., k'_{L-1}, v'_{L-1}]``,
+    plus — with ``token_out=True`` (the default) — a trailing
+    ``greedy_token (B,)`` head: ``argmax(logits, axis=-1)`` lowered ON
+    DEVICE, so a greedy driver pulls one id per stream instead of the
+    full (B, vocab) logits row (GL703; the KV outputs keep their
+    ``1 + 2*i`` positions either way).
     """
     pos_len = pos_len or max_len
     dh = model_dim // num_heads
@@ -301,7 +306,10 @@ def get_decode_symbol(vocab_size=32000, num_layers=6, num_heads=8,
     logits = sym.FullyConnected(
         data=sym.Reshape(x, shape=(-1, model_dim)), num_hidden=vocab_size,
         name="lm_head")
-    return sym.Group([logits] + kv_outs)
+    outs = [logits] + kv_outs
+    if token_out:
+        outs.append(sym.argmax(logits, axis=-1, name="greedy_token"))
+    return sym.Group(outs)
 
 
 def get_symbol(vocab_size=32000, num_layers=6, num_heads=8, model_dim=512,

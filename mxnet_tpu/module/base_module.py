@@ -11,7 +11,6 @@ import logging
 import time
 from collections import namedtuple
 
-import numpy as np
 
 from .. import metric as metric_mod
 from .. import ndarray as nd

@@ -11,7 +11,6 @@ from __future__ import annotations
 
 import logging
 
-from ..base import MXNetError
 from .base_module import BaseModule
 from .module import Module
 

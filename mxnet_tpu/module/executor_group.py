@@ -11,7 +11,7 @@ the common one on TPU, where *mesh* parallelism supersedes device lists
 """
 from __future__ import annotations
 
-from typing import List, Optional
+from typing import List
 
 import numpy as np
 

@@ -11,13 +11,12 @@ import logging
 
 import numpy as np
 
-from .. import ndarray as nd
 from .. import optimizer as opt
 from .. import telemetry as _tm
 from ..base import MXNetError, anomaly_guard_mode
-from ..context import Context, cpu, current_context
+from ..context import Context, current_context
 from ..initializer import InitDesc, Uniform
-from ..ndarray import NDArray, zeros
+from ..ndarray import zeros
 from .base_module import BaseModule
 from .executor_group import DataParallelExecutorGroup
 

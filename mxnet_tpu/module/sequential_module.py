@@ -9,7 +9,6 @@ from __future__ import annotations
 
 import logging
 
-from ..base import MXNetError
 from ..io import DataBatch, DataDesc
 from .base_module import BaseModule
 

@@ -7,9 +7,6 @@ from __future__ import annotations
 
 import logging
 import re
-from math import sqrt
-
-from .ndarray import NDArray
 
 __all__ = ["Monitor"]
 

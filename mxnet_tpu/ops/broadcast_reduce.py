@@ -9,7 +9,6 @@ broadcast_reduce-inl.cuh kernels have no TPU analogue to write.
 from __future__ import annotations
 
 import jax.numpy as jnp
-import numpy as np
 
 from .registry import AttrSpec, register
 

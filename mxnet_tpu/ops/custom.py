@@ -9,7 +9,6 @@ is wired through ``jax.custom_vjp`` calling the user's ``backward``.
 """
 from __future__ import annotations
 
-import functools
 
 import numpy as np
 

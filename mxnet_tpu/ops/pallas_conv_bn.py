@@ -61,7 +61,6 @@ activations — they differ at the bf16-epsilon level, inside BN's eps regime.
 from __future__ import annotations
 
 import functools
-import os
 
 import jax
 import jax.numpy as jnp

@@ -14,9 +14,7 @@ from __future__ import annotations
 
 import jax
 import jax.numpy as jnp
-import numpy as np
 
-from ..base import MXNetError
 from .registry import AttrSpec, register
 
 

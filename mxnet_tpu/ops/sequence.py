@@ -6,7 +6,6 @@ sequence_length vector, matching the reference's SequenceXxxParam.
 """
 from __future__ import annotations
 
-import jax
 import jax.numpy as jnp
 
 from .registry import AttrSpec, register

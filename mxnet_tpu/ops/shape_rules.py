@@ -14,7 +14,6 @@ the list with any deducible entries filled in.
 """
 from __future__ import annotations
 
-import numpy as np
 
 from .rnn import rnn_param_size
 

@@ -28,13 +28,11 @@ from __future__ import annotations
 
 import functools
 import math
-from typing import Optional
 
 import numpy as np
 
-from .base import MXNetError
 from . import ndarray as nd
-from .ndarray import NDArray, imperative_invoke, zeros
+from .ndarray import imperative_invoke, zeros
 
 __all__ = [
     "Optimizer",

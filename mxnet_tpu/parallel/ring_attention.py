@@ -13,7 +13,6 @@ Usage: arrays sharded (B, T/N, H, D) on a mesh with a ``seq`` axis; call
 """
 from __future__ import annotations
 
-import functools
 
 import numpy as np
 

@@ -9,7 +9,6 @@ import random as _pyrandom
 
 import numpy as np
 
-from ..base import MXNetError
 from ..io import DataBatch, DataDesc, DataIter
 from ..ndarray import array
 

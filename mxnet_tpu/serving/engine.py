@@ -270,6 +270,7 @@ class InferenceEngine:
         self._shed_count = 0
         self._submit_count = 0
         self._health_seq = 0  # monotonic snapshot counter; see health()
+        self._last_return_t = None  # dispatch.host_gap interval start
 
     @staticmethod
     def _parse_shed(raw):
@@ -693,12 +694,20 @@ class InferenceEngine:
             qw = _tm.timer("serving.queue_wait")
             for r in batch:
                 qw.add(t0 - r.t_enq)
+            # dispatch.host_gap: batching/padding/queue host time between
+            # the previous batch's return and this enqueue
+            if self._last_return_t is not None:
+                gap = time.perf_counter() - self._last_return_t
+                _tm.timer("dispatch.host_gap").add(gap)
+                _tm.timer("dispatch.host_gap.serving.dispatch").add(gap)
         with _tm.span("serving.dispatch", model=self.name, bucket=bucket,
                       rows=rows, requests=len(batch)):
             _fi.fire("serving.dispatch")
             outs = self.cache.run(padded)
         if _tm.enabled():
-            _tm.timer("serving.dispatch").add(time.perf_counter() - t0)
+            now = time.perf_counter()
+            self._last_return_t = now
+            _tm.timer("serving.dispatch").add(now - t0)
         # slice each output back out by its statically classified
         # rows-per-item factor (non-batch-major outputs replicate whole)
         per_row = self._row_factors

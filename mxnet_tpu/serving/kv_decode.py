@@ -19,6 +19,7 @@ and the oldest token is simply the one overwritten.
 """
 from __future__ import annotations
 
+import time
 from typing import Dict, Optional
 
 import numpy as np
@@ -30,6 +31,29 @@ from .cache import PersistentExecutableCache
 __all__ = ["KVCacheDecoder", "PagedKVDecoder", "PagedKVExhausted"]
 
 _NEG = np.float32(-1e9)
+
+
+def _gap_mark(dec, site):
+    """``dispatch.host_gap``: host time from the previous executable's
+    return (the blocking pull) to this dispatch's enqueue — the seam the
+    GL7xx analyzer prices (docs/OBSERVABILITY.md). Recorded per call site
+    and in aggregate. Off-mode cost is one predicate — no span objects,
+    no clock reads."""
+    if not _tm.enabled():
+        return
+    now = time.perf_counter()
+    last = dec._last_return_t
+    if last is not None:
+        dt = now - last
+        _tm.timer("dispatch.host_gap").add(dt)
+        _tm.timer("dispatch.host_gap." + site).add(dt)
+
+
+def _gap_return(dec):
+    """Stamp the executable-return side of the ``dispatch.host_gap``
+    interval (called right after the blocking pull completes)."""
+    if _tm.enabled():
+        dec._last_return_t = time.perf_counter()
 
 
 class KVCacheDecoder:
@@ -74,6 +98,8 @@ class KVCacheDecoder:
         self._dec_exe = None
         self._pos = 0
         self._warm = False
+        self._token_out = False
+        self._last_return_t = None  # dispatch.host_gap interval start
 
     # ------------------------------------------------------------ lifecycle
     def _decode_shapes(self):
@@ -93,6 +119,11 @@ class KVCacheDecoder:
         self._pf_cache.warmup([{"data": (self.batch, self.prefill_len)}])
         self._dec_cache.warmup([self._decode_shapes()])
         self._dec_exe = self._dec_cache.executable(self._decode_shapes())
+        # trailing greedy_token head (transformer.get_decode_symbol
+        # token_out=True)? A stale on-disk cache may hold the old program,
+        # so trust the compiled executable, not the symbol we asked for
+        self._token_out = \
+            len(self._dec_exe.outputs) == 2 + 2 * self.num_layers
         self._warm = True
         return self
 
@@ -100,6 +131,7 @@ class KVCacheDecoder:
         """Forget all context (the KV slots are masked out, not zeroed —
         the mask is the source of truth for validity)."""
         self._pos = 0
+        self._last_return_t = None
 
     @property
     def position(self):
@@ -149,16 +181,16 @@ class KVCacheDecoder:
                     exe.arg_dict[tag]._set_jax(
                         ring.at[:, :, 0:P, :].set(out._jax()))
         self._pos = L
+        self._last_return_t = None  # new sequence: no prior decode return
         if _tm.enabled():
             _tm.counter("serving.prefill_tokens").inc(B * L)
         return logits
 
     # --------------------------------------------------------------- decode
-    def decode_step(self, tokens):
-        """One token per stream through the decode executable. ``tokens``
-        is (B,) or (B, 1); returns (B, vocab) logits for the NEXT
-        position. The ring KV update happens in-graph; host-side this is
-        arg/output pointer swaps only."""
+    def _stage_step(self, tokens):
+        """Host-side staging shared by ``decode_step``/``greedy_step``:
+        validate position, write the step's inputs, note the host gap.
+        Returns ``(exe, position)`` ready to dispatch."""
         self.warmup()
         p, S = self._pos, self.max_len
         if p >= self.pos_len:
@@ -177,29 +209,67 @@ class KVCacheDecoder:
         exe.arg_dict["pos_idx"][:] = np.full((self.batch, 1), p, np.float32)
         exe.arg_dict["slot_onehot"][:] = oh
         exe.arg_dict["kv_mask"][:] = mask
-        with _tm.span("serving.decode_step", rows=self.batch, pos=p):
-            exe.forward(is_train=False)
-            logits = exe.outputs[0].asnumpy()
+        _gap_mark(self, "serving.decode_step")
+        return exe, p
+
+    def _finish_step(self, exe):
+        """Post-pull bookkeeping: ring KV write-back (device pointer
+        swaps), position advance, counters."""
         for i in range(self.num_layers):
             exe.arg_dict["kv_k_%d" % i]._set_jax(
                 exe.outputs[1 + 2 * i]._jax())
             exe.arg_dict["kv_v_%d" % i]._set_jax(
                 exe.outputs[2 + 2 * i]._jax())
-        self._pos = p + 1
+        self._pos += 1
         if _tm.enabled():
             _tm.counter("serving.decode_tokens").inc(self.batch)
+
+    def decode_step(self, tokens):
+        """One token per stream through the decode executable. ``tokens``
+        is (B,) or (B, 1); returns (B, vocab) logits for the NEXT
+        position. The ring KV update happens in-graph; host-side this is
+        arg/output pointer swaps only."""
+        exe, p = self._stage_step(tokens)
+        with _tm.span("serving.decode_step", rows=self.batch, pos=p):
+            exe.forward(is_train=False)
+            logits = exe.outputs[0].asnumpy()
+        _gap_return(self)
+        self._finish_step(exe)
         return logits
+
+    def greedy_step(self, tokens):
+        """One GREEDY token per stream: same dispatch as ``decode_step``
+        but only the on-device ``greedy_token`` head crosses to the host —
+        (B,) int64 ids, one scalar per stream, instead of the full
+        (B, vocab) logits row (the first GL703 fix). Falls back to a host
+        argmax when the compiled decode program has no token head."""
+        if not self._token_out:
+            self.warmup()
+            if not self._token_out:
+                # graphlint: waive GL703 -- fallback for stale token-less programs
+                return np.argmax(self.decode_step(tokens), axis=-1)
+        exe, p = self._stage_step(tokens)
+        with _tm.span("serving.decode_step", rows=self.batch, pos=p,
+                      greedy=True):
+            exe.forward(is_train=False)
+            nxt = exe.outputs[-1].asnumpy()
+        _gap_return(self)
+        self._finish_step(exe)
+        return nxt.astype(np.int64)
 
     def greedy(self, prompt, n_tokens):
         """Greedy-decode ``n_tokens`` continuations of a (B, L) prompt.
         Returns (B, n_tokens) int64 token ids."""
         logits = self.prefill(prompt)
+        # prompt-head argmax: once per SEQUENCE, and the prefill API hands
+        # these logits to the host anyway; the per-token loop below stays
+        # on device via greedy_step
+        nxt = np.argmax(logits, axis=-1)  # graphlint: waive GL703 -- once per sequence, logits already host-side
         out = np.zeros((self.batch, n_tokens), np.int64)
         for t in range(n_tokens):
-            nxt = np.argmax(logits, axis=-1)
             out[:, t] = nxt
             if t + 1 < n_tokens:
-                logits = self.decode_step(nxt)
+                nxt = self.greedy_step(nxt)
         return out
 
 
@@ -331,6 +401,7 @@ class PagedKVDecoder:
         self._seq_lane: Dict[int, int] = {}  # seq_id -> lane index
         self._next_seq = 0
         self._warm = False
+        self._last_return_t = None  # dispatch.host_gap interval start
 
     # ------------------------------------------------------------ lifecycle
     def _decode_shapes(self):
@@ -430,6 +501,7 @@ class PagedKVDecoder:
             raise
         lane.pos = L
         lane.valid_slots = phys
+        self._last_return_t = None  # admit breaks the steady decode chain
         if _tm.enabled():
             _tm.counter("serving.paged_admits").inc()
             _tm.counter("serving.prefill_tokens").inc(L)
@@ -498,10 +570,12 @@ class PagedKVDecoder:
         exe.arg_dict["pos_idx"][:] = pos_idx
         exe.arg_dict["slot_onehot"][:] = oh
         exe.arg_dict["kv_mask"][:] = mask
+        _gap_mark(self, "serving.paged_step")
         with _tm.span("serving.decode_step", rows=len(stepped),
                       paged=True):
             exe.forward(is_train=False)
             logits = exe.outputs[0].asnumpy()
+        _gap_return(self)
         for i in range(self.num_layers):
             exe.arg_dict["kv_k_%d" % i]._set_jax(
                 exe.outputs[1 + 2 * i]._jax())

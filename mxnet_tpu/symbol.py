@@ -25,7 +25,7 @@ import numpy as np
 
 from .attribute import AttrScope
 from .base import MXNetError, np_dtype
-from .context import Context, current_context
+from .context import current_context
 from .name import NameManager
 from .ops import registry as _registry
 from .ops.registry import get_op, parse_attrs

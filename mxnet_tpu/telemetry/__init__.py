@@ -21,7 +21,7 @@ from .spans import (MODE_COUNTERS, MODE_OFF, MODE_TRACE, NULL_SPAN,
                     clear_events, current_override, drain_events, enabled,
                     event, mode, set_mode, span, tracing)
 from .trace import (SCHEMA_VERSION, build_trace, export_chrome_trace,
-                    span_summary, summarize)
+                    gap_summary, span_summary, summarize)
 
 __all__ = [
     # registry
@@ -34,5 +34,5 @@ __all__ = [
     "span", "event", "drain_events", "clear_events",
     # export
     "SCHEMA_VERSION", "build_trace", "export_chrome_trace",
-    "span_summary", "summarize",
+    "gap_summary", "span_summary", "summarize",
 ]

@@ -17,7 +17,7 @@ import argparse
 import json
 import sys
 
-from .trace import SCHEMA_VERSION, span_summary
+from .trace import SCHEMA_VERSION, gap_summary, span_summary
 
 # per-step table columns: (header, counter name in the step row)
 _STEP_COLS = [
@@ -123,6 +123,27 @@ def spans_table(trace, top):
           "%.3f" % (r["ms"] / r["count"])] for r in rows])
 
 
+def gaps_table(trace, top):
+    """Host-gap attribution: per span name, the host time between one
+    span's end and the next one's start on the same thread (negative
+    overlaps from threaded interleaving clamp to zero; the ``clamp``
+    column counts them). ``gap%%`` is gap/busy — the GL705 ratio."""
+    rows = [r for r in gap_summary(trace=trace, top=top)
+            if r["intervals"] > 0]
+    if not rows:
+        return "(no repeated spans — gap attribution needs >= 2 spans " \
+               "of a name on one thread)"
+    return _fmt_table(
+        ["span", "gap_ms", "busy_ms", "gap%", "gap/iv", "max_gap",
+         "ivs", "clamp"],
+        [[r["name"], "%.3f" % r["gap_ms"], "%.3f" % r["busy_ms"],
+          ("%.0f%%" % (100.0 * r["gap_ms"] / r["busy_ms"])
+           if r["busy_ms"] > 0 else "-"),
+          "%.3f" % (r["gap_ms"] / r["intervals"]),
+          "%.3f" % r["max_gap_ms"], str(r["intervals"]),
+          str(r["clamped"])] for r in rows])
+
+
 def main(argv=None):
     ap = argparse.ArgumentParser(
         prog="mxtrace", description="inspect/validate a mxnet_tpu telemetry "
@@ -164,6 +185,7 @@ def main(argv=None):
             "counters": other.get("counters", {}),
             "num_steps": len(other.get("steps") or []),
             "spans": span_summary(trace=trace, top=args.top),
+            "gaps": gap_summary(trace=trace, top=args.top),
             "xla_trace_dir": other.get("xla_trace_dir"),
         }))
         return 0
@@ -173,6 +195,9 @@ def main(argv=None):
     print()
     print("== top %d spans ==" % args.top)
     print(spans_table(trace, args.top))
+    print()
+    print("== host-gap attribution (span end -> next same-name start) ==")
+    print(gaps_table(trace, args.top))
     counters = other.get("counters") or {}
     if counters:
         print()

@@ -17,7 +17,7 @@ import os
 from . import registry, spans
 
 __all__ = ["export_chrome_trace", "summarize", "span_summary",
-           "SCHEMA_VERSION"]
+           "gap_summary", "SCHEMA_VERSION"]
 
 SCHEMA_VERSION = 1
 
@@ -100,6 +100,67 @@ def span_summary(trace=None, top=25):
     rows = [{"name": n, "ms": round(ms, 3), "count": cnt}
             for n, (ms, cnt) in acc.items()]
     rows.sort(key=lambda r: -r["ms"])
+    return rows[:top]
+
+
+def gap_summary(trace=None, prefix=None, top=25):
+    """Inter-span host-gap attribution per span name: the time between one
+    span's END and the NEXT same-name span's START on the same thread —
+    for dispatch-shaped spans (``serving.decode_step``,
+    ``serving.dispatch``) that is exactly the host time between an
+    executable's return and the next enqueue, the seam the GL7xx
+    dispatch lint prices (docs/static_analysis.md).
+
+    Threaded spans interleave non-monotonically: a batcher's span can
+    overlap the step span that contains it, so a successor may START
+    before its predecessor ENDED and the raw gap goes negative. Negative
+    gaps CLAMP TO ZERO per interval — they must not cancel real gaps
+    elsewhere in the chain (the mxtrace gap-math fix).
+
+    Accepts a loaded chrome-trace dict (mxtrace) or None for the live
+    buffer (drains it, like ``span_summary``). ``prefix`` filters span
+    names (``prefix="serving."``). Rows: ``{"name", "count", "intervals",
+    "busy_ms", "gap_ms", "max_gap_ms", "clamped"}``, largest gap first.
+    """
+    per_site = {}  # (name, tid) -> list[(start_ms, dur_ms)]
+    if trace is None:
+        for name, t0, dur, ident, _attrs in spans.drain_events():
+            if prefix and not name.startswith(prefix):
+                continue
+            per_site.setdefault((name, ident), []).append(
+                (t0 * 1000.0, dur * 1000.0))
+    else:
+        for ev in trace.get("traceEvents", []):
+            if ev.get("ph") != "X":
+                continue
+            name = ev.get("name", "?")
+            if prefix and not name.startswith(prefix):
+                continue
+            per_site.setdefault((name, ev.get("tid", 0)), []).append(
+                (ev.get("ts", 0) / 1000.0, ev.get("dur", 0) / 1000.0))
+    acc = {}  # name -> [count, intervals, busy, gap, max_gap, clamped]
+    for (name, _tid), evs in per_site.items():
+        evs.sort(key=lambda e: e[0])
+        row = acc.setdefault(name, [0, 0, 0.0, 0.0, 0.0, 0])
+        prev_end = None
+        for start, dur in evs:
+            row[0] += 1
+            row[2] += dur
+            if prev_end is not None:
+                raw = start - prev_end
+                row[1] += 1
+                if raw < 0.0:
+                    row[5] += 1  # clamped interval, not a negative credit
+                else:
+                    row[3] += raw
+                    row[4] = max(row[4], raw)
+            prev_end = max(prev_end, start + dur) if prev_end is not None \
+                else start + dur
+    rows = [{"name": n, "count": c, "intervals": it,
+             "busy_ms": round(busy, 3), "gap_ms": round(gap, 3),
+             "max_gap_ms": round(mx, 3), "clamped": cl}
+            for n, (c, it, busy, gap, mx, cl) in acc.items()]
+    rows.sort(key=lambda r: -r["gap_ms"])
     return rows[:top]
 
 

@@ -11,13 +11,10 @@ The reference's core oracles, reproduced for the TPU build:
 """
 from __future__ import annotations
 
-from typing import Dict, List, Optional
-
 import numpy as np
 
-from .base import MXNetError
 from .context import cpu, current_context
-from .ndarray import NDArray, array, zeros
+from .ndarray import array, zeros
 
 __all__ = [
     "default_context",

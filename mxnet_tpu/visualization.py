@@ -5,7 +5,6 @@ output shapes and parameter counts; ``plot_network`` renders via graphviz
 when available."""
 from __future__ import annotations
 
-import json
 
 from .base import MXNetError
 

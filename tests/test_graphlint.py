@@ -617,9 +617,189 @@ def test_rewrite_code_silent_on_clean_rewrite(code):
     assert code not in REWRITE_CODE_CASES[code][1]()
 
 
+# --------------------------------------------------------------------------
+# dispatch-discipline codes (GL7xx): source snippets through the AST lint
+# (GL701-GL704), synthetic gap rows through the measured lint (GL705)
+# --------------------------------------------------------------------------
+from mxnet_tpu.analysis import dispatch_lint  # noqa: E402
+
+_GL701_BROKEN = """
+def greedy(dec, tok, n):
+    for _ in range(n):
+        logits = dec.decode_step(tok)
+        tok = logits.asnumpy()
+    return tok
+"""
+
+_GL701_CLEAN = """
+def drain(dec, toks):
+    outs = []
+    for t in toks:
+        outs.append(dec.decode_step(t))
+    return outs[-1].asnumpy()
+"""
+
+_GL702_BROKEN = """
+def decode(dec, tok, n):
+    for _ in range(n):
+        tok = dec.decode_step(tok)
+    return tok
+"""
+
+# the lax.scan rewrite of _GL702_BROKEN: the loop state rides as the scan
+# carry and the host dispatches ONE megastep — exactly the fix GL702 asks for
+_GL702_CLEAN = """
+def decode(dec, tok, n):
+    def megastep(carry, _):
+        return dec.scan_body(carry), None
+    final, _ = lax.scan(megastep, tok, None, length=n)
+    return final
+"""
+
+_GL703_BROKEN = """
+def pick(dec, x):
+    logits = dec.decode_step(x)
+    return np.argmax(logits, axis=-1)
+"""
+
+_GL703_CLEAN = """
+def pick(dec, x):
+    ids = dec.greedy_step(x)
+    return ids
+"""
+
+_GL703_WAIVED = """
+def pick(dec, x):
+    logits = dec.decode_step(x)
+    return np.argmax(logits, axis=-1)  # graphlint: waive GL703 -- acknowledged
+"""
+
+_GL704_BROKEN = """
+def run2(a, b, x):
+    ya = a.forward(x)
+    out = ya.asnumpy()
+    yb = b.forward(x)
+    return out, yb.asnumpy()
+"""
+
+_GL704_CLEAN = """
+def run2(a, b, x):
+    ya = a.forward(x)
+    yb = b.forward(x)
+    return ya.asnumpy(), yb.asnumpy()
+"""
+
+
+def _dl_codes(src):
+    return {f.code
+            for f in dispatch_lint.lint_dispatch_source("<case>", text=src)}
+
+
+def _gl705_rows(gap_ms):
+    return [{"name": "serving.decode_step", "count": 10, "intervals": 9,
+             "busy_ms": 10.0, "gap_ms": gap_ms, "max_gap_ms": gap_ms / 2.0,
+             "clamped": 0}]
+
+
+def _gl705_codes(gap_ms):
+    return {d.code
+            for d in dispatch_lint.lint_dispatch_gaps(_gl705_rows(gap_ms),
+                                                      pct=0.25)}
+
+
+DISPATCH_CODE_CASES = {
+    "GL701": (lambda: _dl_codes(_GL701_BROKEN),
+              lambda: _dl_codes(_GL701_CLEAN)),
+    "GL702": (lambda: _dl_codes(_GL702_BROKEN),
+              lambda: _dl_codes(_GL702_CLEAN)),
+    "GL703": (lambda: _dl_codes(_GL703_BROKEN),
+              lambda: _dl_codes(_GL703_CLEAN)),
+    "GL704": (lambda: _dl_codes(_GL704_BROKEN),
+              lambda: _dl_codes(_GL704_CLEAN)),
+    # 8 ms host gap against 10 ms busy = 80% >> the 25% threshold; the
+    # clean side's 1 ms = 10% stays under it
+    "GL705": (lambda: _gl705_codes(8.0), lambda: _gl705_codes(1.0)),
+}
+
+
+@pytest.mark.parametrize("code", sorted(DISPATCH_CODE_CASES))
+def test_dispatch_code_triggers_on_broken_source(code):
+    assert code in DISPATCH_CODE_CASES[code][0]()
+
+
+@pytest.mark.parametrize("code", sorted(DISPATCH_CODE_CASES))
+def test_dispatch_code_silent_on_clean_source(code):
+    assert code not in DISPATCH_CODE_CASES[code][1]()
+
+
+def test_dispatch_waived_site_reported_but_not_failing():
+    """A '# graphlint: waive GL703 -- reason' comment keeps the finding in
+    the site table (waived=True, severity info, '[waived]' marker) instead
+    of failing the run."""
+    findings = dispatch_lint.lint_dispatch_source("<case>",
+                                                  text=_GL703_WAIVED)
+    f = next(f for f in findings if f.code == "GL703")
+    assert f.waived
+    d = f.to_diagnostic()
+    assert d.severity == "info"
+    assert d.message.endswith("[waived]")
+    # the same site without the waiver is a warning
+    g = next(f for f in dispatch_lint.lint_dispatch_source(
+        "<case>", text=_GL703_BROKEN) if f.code == "GL703")
+    assert not g.waived
+    assert g.to_diagnostic().severity == "warning"
+
+
+def test_dispatch_family_waiver_covers_every_gl7xx_code():
+    src = _GL701_BROKEN.replace(
+        "tok = logits.asnumpy()",
+        "tok = logits.asnumpy()  # graphlint: waive GL7xx -- family waiver")
+    findings = dispatch_lint.lint_dispatch_source("<case>", text=src)
+    waived_lines = {f.line for f in findings if f.waived}
+    assert waived_lines, [f.to_dict() for f in findings]
+
+
+def test_gl705_needs_two_intervals():
+    rows = _gl705_rows(8.0)
+    rows[0]["intervals"] = 1
+    assert not dispatch_lint.lint_dispatch_gaps(rows, pct=0.25)
+
+
+def test_repo_dispatch_scan_flags_kv_decode_host_sync_sites():
+    """Acceptance: the default-surface scan flags the known kv_decode
+    host-sync sites (GL701 in both decoders' greedy loops) with file:line
+    provenance."""
+    report, sites = dispatch_lint.lint_dispatch_paths()
+    kv = [s for s in sites if s["file"].endswith("serving/kv_decode.py")
+          and s["code"] == "GL701"]
+    assert len(kv) >= 2, sites
+    assert {s["function"] for s in kv} >= {"KVCacheDecoder.greedy",
+                                           "PagedKVDecoder.greedy"}
+    assert all(s["line"] > 0 and s["provenance"] for s in kv)
+
+
+def test_graph_gl703_fires_on_tokenless_decode_symbol_only():
+    """Graph-side GL703: the decode-signature symbol WITHOUT the on-device
+    greedy head triggers; token_out=True (the default) is clean."""
+    from mxnet_tpu.models import transformer as tf
+
+    cfg = dict(vocab_size=64, num_layers=2, num_heads=2, model_dim=32,
+               ffn_dim=64)
+    B, S, H, dh = 2, 8, 2, 16
+    sh = {"data": (B, 1), "pos_idx": (B, 1), "slot_onehot": (S,),
+          "kv_mask": (S,)}
+    for i in range(cfg["num_layers"]):
+        sh["kv_k_%d" % i] = (B, H, S, dh)
+        sh["kv_v_%d" % i] = (B, H, S, dh)
+    bare = tf.get_decode_symbol(max_len=S, pos_len=S, token_out=False, **cfg)
+    assert "GL703" in _codes(bare, shapes=sh)
+    headed = tf.get_decode_symbol(max_len=S, pos_len=S, **cfg)
+    assert "GL703" not in _codes(headed, shapes=sh)
+
+
 def test_every_diagnostic_code_is_tested():
     covered = (set(GRAPH_CODE_CASES) | set(ENGINE_CODE_CASES) | {"GL105"}
-               | set(REWRITE_CODE_CASES))
+               | set(REWRITE_CODE_CASES) | set(DISPATCH_CODE_CASES))
     assert covered == set(CODES), (
         "codes missing a trigger/clean test pair: %s; stale test entries: %s"
         % (sorted(set(CODES) - covered), sorted(covered - set(CODES))))

@@ -167,6 +167,81 @@ def test_chrome_trace_schema(tm, tmp_path):
     assert cli.check(bad)
 
 
+# ------------------------------------------------- host-gap attribution
+def _gap_trace(spans_us, name="serving.decode_step", tid=1):
+    """Minimal chrome-trace dict: one thread, one span name."""
+    events = [{"ph": "M", "pid": 1, "name": "process_name",
+               "args": {"name": "t"}}]
+    events += [{"ph": "X", "pid": 1, "tid": tid, "name": name,
+                "cat": "serving", "ts": ts, "dur": dur}
+               for ts, dur in spans_us]
+    return {"traceEvents": events, "otherData": {}}
+
+
+def test_gap_summary_clamps_negative_interleaved_gaps(tm):
+    """The mxtrace gap-math regression: threaded spans interleave
+    non-monotonically, so a successor can START before its predecessor
+    ENDED. The negative raw gap must clamp to zero (counted in
+    ``clamped``) — NOT subtract from the real gaps in the chain."""
+    # end 10ms; +5ms gap; span ending 25ms; OVERLAP (starts 20 < 25, raw
+    # gap -5ms); then a +10ms gap after the running max end (30ms)
+    rows = telemetry.gap_summary(trace=_gap_trace(
+        [(0, 10000), (15000, 10000), (20000, 10000), (40000, 5000)]))
+    assert len(rows) == 1
+    r = rows[0]
+    assert r["name"] == "serving.decode_step"
+    assert r["count"] == 4 and r["intervals"] == 3
+    assert r["clamped"] == 1
+    # 5 + 10 — a buggy negative credit would report 10 (or less)
+    assert r["gap_ms"] == pytest.approx(15.0)
+    assert r["max_gap_ms"] == pytest.approx(10.0)
+    assert r["busy_ms"] == pytest.approx(35.0)
+
+
+def test_gap_summary_separates_threads_and_live_buffer(tm):
+    # same name on two tids: gaps attribute per thread, never across
+    tr = _gap_trace([(0, 1000), (5000, 1000)])
+    tr["traceEvents"] += _gap_trace([(2000, 1000), (9000, 1000)],
+                                    tid=2)["traceEvents"][1:]
+    r = telemetry.gap_summary(trace=tr)[0]
+    assert r["count"] == 4 and r["intervals"] == 2
+    assert r["gap_ms"] == pytest.approx(4.0 + 6.0)
+    # live-buffer form drains real spans, like span_summary
+    tm.set_mode("trace")
+    for _ in range(3):
+        with tm.span("t.gap"):
+            pass
+    rows = telemetry.gap_summary()
+    mine = [x for x in rows if x["name"] == "t.gap"]
+    assert mine and mine[0]["intervals"] == 2
+    assert mine[0]["gap_ms"] >= 0.0
+
+
+def test_mxtrace_reports_gap_attribution(tm, tmp_path):
+    from mxnet_tpu.telemetry import cli
+
+    path = str(tmp_path / "gap_trace.json")
+    with open(path, "w") as f:
+        json.dump(_gap_trace([(0, 10000), (15000, 10000)]), f)
+    out = subprocess.run(
+        [sys.executable, os.path.join(ROOT, "tools", "mxtrace"), path,
+         "--json"], capture_output=True, text=True)
+    assert out.returncode == 0, out.stderr
+    payload = json.loads(out.stdout)
+    assert payload["gaps"][0]["name"] == "serving.decode_step"
+    assert payload["gaps"][0]["gap_ms"] == pytest.approx(5.0)
+    # the human table renders the same attribution section
+    assert "host-gap attribution" in subprocess.run(
+        [sys.executable, os.path.join(ROOT, "tools", "mxtrace"), path],
+        capture_output=True, text=True).stdout
+    # cli-level: the GL705 lint consumes these rows directly
+    from mxnet_tpu.analysis import dispatch_lint
+    diags = dispatch_lint.lint_dispatch_gaps(
+        [{"name": "serving.decode_step", "intervals": 9, "busy_ms": 10.0,
+          "gap_ms": 9.0}], pct=0.5)
+    assert [d.code for d in diags] == ["GL705"]
+
+
 # ------------------------------------------------------ executor counters
 def test_retrace_counter_on_cache_busting_rebind(tm):
     tm.set_mode("counters")

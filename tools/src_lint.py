@@ -1,0 +1,226 @@
+#!/usr/bin/env python
+"""Dependency-free source lint: the pure-python fallback for the pinned
+ruff gate in tools/ci_check.sh.
+
+The container image may not ship ruff (and CI must not pip install), so
+step 2 can no longer be skip-when-absent: this script implements the
+subset of the pinned rule set (ruff.toml) that can be checked with the
+stdlib alone, and CI runs it whenever `ruff` is not on PATH.  The codes
+mirror ruff/pyflakes so a waiver written for one tool works for the
+other:
+
+  E9    syntax / indentation errors (compile())
+  F401  module-level import bound but never used (__init__.py exempt —
+        re-export surface; names listed in __all__ count as used)
+  F811  function/class redefinition shadowing an earlier def in the same
+        body (@overload / @prop.setter-style decorators exempt)
+  E711  comparison to None with == or !=
+  E712  comparison to True / False with == or !=
+
+A trailing ``# noqa`` (bare, or with the matching code:
+``# noqa: F401``) on the flagged line suppresses the finding, exactly as
+ruff treats it.
+
+Usage:  python tools/src_lint.py PATH [PATH ...]
+Exit codes: 0 clean, 1 findings, 2 unreadable target.
+"""
+from __future__ import annotations
+
+import ast
+import re
+import sys
+from pathlib import Path
+
+_NOQA_RE = re.compile(r"#\s*noqa(?::\s*(?P<codes>[A-Z0-9 ,]+))?", re.I)
+
+# decorator names that legitimately redefine a binding (pyflakes' list)
+_REDEF_OK = {"overload", "setter", "getter", "deleter", "register"}
+
+
+def _noqa_map(text):
+    """line -> set of suppressed codes (empty set = suppress everything)."""
+    out = {}
+    for i, line in enumerate(text.splitlines(), 1):
+        m = _NOQA_RE.search(line)
+        if m:
+            codes = m.group("codes")
+            out[i] = ({c.strip().upper() for c in codes.split(",") if c.strip()}
+                      if codes else set())
+    return out
+
+
+def _suppressed(noqa, line, code):
+    codes = noqa.get(line)
+    return codes is not None and (not codes or code in codes)
+
+
+def _module_import_bindings(tree):
+    """Module-level imports: bound name -> (line, code-visible label)."""
+    bound = {}
+    for node in tree.body:
+        if isinstance(node, ast.Import):
+            for alias in node.names:
+                name = alias.asname or alias.name.split(".")[0]
+                bound[name] = node.lineno
+        elif isinstance(node, ast.ImportFrom):
+            if node.module == "__future__":
+                continue
+            for alias in node.names:
+                if alias.name == "*":
+                    continue
+                bound[alias.asname or alias.name] = node.lineno
+    return bound
+
+
+def _used_names(tree):
+    used = set()
+    for node in ast.walk(tree):
+        if isinstance(node, ast.Name) and isinstance(node.ctx, (ast.Load,
+                                                                ast.Del)):
+            used.add(node.id)
+        elif isinstance(node, ast.Attribute):
+            # `a.b.c` loads the base name `a`
+            base = node
+            while isinstance(base, ast.Attribute):
+                base = base.value
+            if isinstance(base, ast.Name):
+                used.add(base.id)
+        elif (isinstance(node, ast.Assign)
+              and any(isinstance(t, ast.Name) and t.id == "__all__"
+                      for t in node.targets)):
+            for elt in ast.walk(node.value):
+                if isinstance(elt, ast.Constant) and isinstance(elt.value,
+                                                                str):
+                    used.add(elt.value)
+        elif isinstance(node, ast.Constant) and isinstance(node.value, str):
+            # string annotations / TYPE_CHECKING forward refs: any dotted
+            # identifier inside a string counts as a (conservative) use
+            for tok in re.findall(r"[A-Za-z_][A-Za-z0-9_]*", node.value):
+                used.add(tok)
+    return used
+
+
+def _check_f401(path, tree, noqa, findings):
+    if path.name == "__init__.py":
+        return
+    bound = _module_import_bindings(tree)
+    if not bound:
+        return
+    used = _used_names(tree)
+    for name, line in sorted(bound.items(), key=lambda kv: kv[1]):
+        if name in used or _suppressed(noqa, line, "F401"):
+            continue
+        findings.append((path, line, "F401",
+                         "%r imported but unused" % name))
+
+
+def _decorator_names(node):
+    out = set()
+    for dec in getattr(node, "decorator_list", []):
+        base = dec.func if isinstance(dec, ast.Call) else dec
+        if isinstance(base, ast.Attribute):
+            out.add(base.attr)
+        elif isinstance(base, ast.Name):
+            out.add(base.id)
+    return out
+
+
+def _check_f811(path, tree, noqa, findings):
+    for scope in ast.walk(tree):
+        if not isinstance(scope, (ast.Module, ast.ClassDef)):
+            continue
+        seen = {}
+        for node in scope.body:
+            if not isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef,
+                                     ast.ClassDef)):
+                continue
+            if _decorator_names(node) & _REDEF_OK:
+                seen[node.name] = node.lineno
+                continue
+            if node.name in seen and not _suppressed(noqa, node.lineno,
+                                                     "F811"):
+                findings.append((path, node.lineno, "F811",
+                                 "redefinition of %r from line %d"
+                                 % (node.name, seen[node.name])))
+            seen[node.name] = node.lineno
+
+
+def _check_e711_e712(path, tree, noqa, findings):
+    for node in ast.walk(tree):
+        if not isinstance(node, ast.Compare):
+            continue
+        for op, comp in zip(node.ops, node.comparators):
+            if not isinstance(op, (ast.Eq, ast.NotEq)):
+                continue
+            if isinstance(comp, ast.Constant) and comp.value is None:
+                code, what = "E711", "None"
+            elif isinstance(comp, ast.Constant) and isinstance(comp.value,
+                                                               bool):
+                code, what = "E712", repr(comp.value)
+            else:
+                continue
+            if _suppressed(noqa, node.lineno, code):
+                continue
+            fix = "is" if isinstance(op, ast.Eq) else "is not"
+            findings.append((path, node.lineno, code,
+                             "comparison to %s should be `%s %s`"
+                             % (what, fix, what)))
+
+
+def _iter_py_files(targets):
+    for raw in targets:
+        p = Path(raw)
+        if not p.exists():
+            raise OSError("no such file or directory: %s" % raw)
+        if p.is_dir():
+            yield from sorted(p.rglob("*.py"))
+        elif p.suffix == ".py":
+            yield p
+        else:
+            # extensionless launcher scripts (tools/graphlint, tools/mxtrace)
+            with open(p, "rb") as f:
+                if b"python" in f.readline():
+                    yield p
+
+
+def lint_paths(targets):
+    findings = []
+    for path in _iter_py_files(targets):
+        try:
+            text = path.read_text()
+        except (OSError, UnicodeDecodeError) as exc:
+            findings.append((path, 0, "E902", str(exc)))
+            continue
+        try:
+            tree = ast.parse(text, filename=str(path))
+        except SyntaxError as exc:
+            findings.append((path, exc.lineno or 0, "E999",
+                             "syntax error: %s" % exc.msg))
+            continue
+        noqa = _noqa_map(text)
+        _check_f401(path, tree, noqa, findings)
+        _check_f811(path, tree, noqa, findings)
+        _check_e711_e712(path, tree, noqa, findings)
+    return findings
+
+
+def main(argv=None):
+    args = list(sys.argv[1:] if argv is None else argv)
+    if not args or "-h" in args or "--help" in args:
+        print(__doc__)
+        return 0 if args else 2
+    try:
+        findings = lint_paths(args)
+    except OSError as exc:
+        print("src_lint: %s" % exc, file=sys.stderr)
+        return 2
+    for path, line, code, msg in findings:
+        print("%s:%d: %s %s" % (path, line, code, msg))
+    if findings:
+        print("src_lint: %d finding(s)" % len(findings), file=sys.stderr)
+        return 1
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())
