@@ -856,7 +856,8 @@ def _bench_serving():
                 "--compare-batch1"],
         "lenet": ["--model", "lenet", "--qps", "40", "--duration", "2"],
         "transformer_decode": ["--model", "transformer-decode", "--qps",
-                               "30", "--duration", "2", "--rows", "4"],
+                               "30", "--duration", "2", "--rows", "4",
+                               "--megastep-k", "8"],
         "fleet": ["--model", "mlp", "--fleet", "--fleet-replicas", "4",
                   "--qps", "80", "--duration", "3"],
     }
@@ -882,7 +883,8 @@ def _bench_serving():
                      "retraces_post_warmup", "batching_speedup",
                      "qps_single_replica_closed", "replicas",
                      "redispatches", "replica_restarts", "paged_kv",
-                     "host_gap_ms", "host_gap_per_token", "host_argmax")
+                     "host_gap_ms", "host_gap_per_token", "host_argmax",
+                     "megastep")
                     if rec.get(k) is not None}
             if name == "fleet":
                 keep["resolved"] = rec.get("resolved")

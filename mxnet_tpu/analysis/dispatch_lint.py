@@ -52,9 +52,13 @@ __all__ = ["lint_dispatch_paths", "lint_dispatch_source",
 _log = logging.getLogger("mxnet_tpu.graphlint")
 
 # call-site vocabulary ------------------------------------------------------
-# a method call by one of these names enqueues device work
+# a method call by one of these names enqueues device work. The megastep
+# entry points (serving/kv_decode.py decode_megastep/step_megastep) are
+# dispatches too — K tokens per call, but still one host round-trip each,
+# so a loop over them is a (K-amortized) GL701 site.
 _DISPATCH_NAMES = frozenset({"forward", "decode_step", "greedy_step",
-                             "step", "prefill", "run"})
+                             "step", "prefill", "run",
+                             "decode_megastep", "step_megastep"})
 # a call by one of these names blocks on a device->host transfer
 _PULL_NAMES = frozenset({"asnumpy", "block_until_ready", "item", "tolist"})
 # host reductions numpy performs that sym.* can lower on device instead

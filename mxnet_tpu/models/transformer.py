@@ -252,7 +252,19 @@ def get_decode_symbol(vocab_size=32000, num_layers=6, num_heads=8,
     ``greedy_token (B,)`` head: ``argmax(logits, axis=-1)`` lowered ON
     DEVICE, so a greedy driver pulls one id per stream instead of the
     full (B, vocab) logits row (GL703; the KV outputs keep their
-    ``1 + 2*i`` positions either way).
+    ``1 + 2*i`` positions either way). The ``greedy_token`` NAME is a
+    detection contract: ``KVCacheDecoder.warmup`` decides whether a
+    (possibly disk-cached) compiled program carries the head by looking
+    for it in ``output_dict`` by name — rename it and stale caches start
+    masquerading as token-less programs.
+
+    This graph is also the megastep building block
+    (serving/kv_decode.py ``_DecodeMegastep``): the per-stream variant is
+    pure in its (data, pos_idx, slot_onehot, kv_mask, kv_*) inputs, so K
+    decode steps compose as a ``lax.scan`` over ONE compiled body — the
+    scan carries the KV outputs back into the KV inputs and feeds each
+    step's sampled token to the next, keeping the whole K-token loop
+    device-resident (docs/SERVING.md §megasteps).
     """
     pos_len = pos_len or max_len
     dh = model_dim // num_heads

@@ -309,6 +309,13 @@ class BaseModule:
                     for callback in _as_list(batch_end_callback):
                         callback(batch_end_params)
 
+            # training megastep (MXNET_TRAIN_MEGASTEP_N>1): dispatch the
+            # partial final buffer and drain its metric rows before the
+            # epoch metric is logged or validation runs
+            flush_pending = getattr(self, "flush_pending_steps", None)
+            if flush_pending is not None:
+                flush_pending(eval_metric)
+
             # input-bound fraction of this epoch's wall time
             # (docs/OBSERVABILITY.md io.input_bound_pct): visible without a
             # trace, warned once per fit past 10%

@@ -352,14 +352,20 @@ class Module(BaseModule):
     def forward(self, data_batch, is_train=None):
         assert self.binded and self.params_initialized
         if self._spmd is not None:
+            # any batches still buffered for a training megastep must land
+            # before we read params for a plain forward
+            self._spmd.flush()
             if self._spmd.params_dirty:
                 # SPMD steps update the trainer's params; refresh the bound
                 # executors before a plain forward (score/predict after fit)
                 self._sync_params_from_devices()
                 self._exec_group.set_params(self._arg_params, self._aux_params)
             # this forward's outputs now own get_outputs/update_metric —
-            # drop the stale fused-step outputs
+            # drop the stale fused-step outputs and any undrained train
+            # metric pairs (they must not leak into a validation metric;
+            # fit() drains them via flush_pending_steps before scoring)
             self._spmd._outputs = None
+            self._spmd._metric_pairs = []
         self._exec_group.forward(data_batch, is_train)
 
     def backward(self, out_grads=None):
@@ -500,10 +506,20 @@ class Module(BaseModule):
         return self._exec_group.get_input_grads(merge_multi_context=merge_multi_context)
 
     def update_metric(self, eval_metric, labels):
-        if self._spmd is not None and self._spmd._outputs is not None:
-            eval_metric.update(labels, self._spmd.get_outputs())
+        if self._spmd is not None and self._spmd.update_metric(eval_metric, labels):
             return
         self._exec_group.update_metric(eval_metric, labels)
+
+    def flush_pending_steps(self, eval_metric=None):
+        """Dispatch batches still buffered for a training megastep
+        (``MXNET_TRAIN_MEGASTEP_N`` > 1) and, when ``eval_metric`` is given,
+        drain their metric rows. fit() calls this at each epoch tail so a
+        partial final buffer still trains and still scores."""
+        if self._spmd is None or self._spmd._megastep_n <= 1:
+            return
+        self._spmd.flush()
+        if eval_metric is not None:
+            self._spmd.drain_metric(eval_metric)
 
     def install_monitor(self, mon):
         assert self.binded

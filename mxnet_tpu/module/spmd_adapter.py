@@ -25,7 +25,23 @@ import pickle
 
 import numpy as np
 
-__all__ = ["SPMDStepAdapter"]
+__all__ = ["SPMDStepAdapter", "train_megastep_n"]
+
+
+def train_megastep_n(default=1):
+    """``MXNET_TRAIN_MEGASTEP_N``: batches buffered per fused dispatch.
+
+    N=1 (the default) is today's one-dispatch-per-batch path. N>1 buffers N
+    batches on the host and runs them through ONE ``lax.scan``-ed megastep
+    (``SPMDTrainer.step_many``), amortizing the host dispatch seam the same
+    way MXNET_DECODE_MEGASTEP_K does for serving. Junk or <1 falls back to
+    ``default``."""
+    raw = os.environ.get("MXNET_TRAIN_MEGASTEP_N", "")
+    try:
+        n = int(raw)
+    except (TypeError, ValueError):
+        return default
+    return n if n >= 1 else default
 
 
 class SPMDStepAdapter:
@@ -48,6 +64,26 @@ class SPMDStepAdapter:
         self._optimizer = module._optimizer
         self._outputs = None
         self._pending_step = False  # a fused step ran, update() not yet seen
+        self._megastep_n = train_megastep_n()
+        self._buf = []           # buffered (data, label, lr, labels_nd) tuples
+        self._metric_pairs = []  # flushed (labels_nd, outputs) awaiting metric
+        if self._megastep_n > 1 and self.trainer._spans_processes:
+            # step_many refuses multi-process meshes (a stacked global batch
+            # cannot be assembled from process-local shards) — run N=1 rather
+            # than fail on the first flush
+            logging.warning(
+                "MXNET_TRAIN_MEGASTEP_N=%d ignored: multi-process mesh — "
+                "dispatching one batch per step", self._megastep_n)
+            self._megastep_n = 1
+        if self._megastep_n > 1 and shared is not None:
+            # bucketing interleaves steps from several per-bucket adapters
+            # over ONE shared state cell; buffering would flush them out of
+            # order and corrupt the optimizer step sequence
+            logging.warning(
+                "MXNET_TRAIN_MEGASTEP_N=%d ignored for bucket adapter: "
+                "shared-state buckets dispatch one batch per step",
+                self._megastep_n)
+            self._megastep_n = 1
         if shared is not None:
             # bucketing: same weights/opt state, a per-bucket compiled step —
             # this trainer shares `shared`'s state cell instead of re-adopting
@@ -137,6 +173,7 @@ class SPMDStepAdapter:
     def export_params(self, arg_params, aux_params):
         """Write the trainer's current params back into the module's host
         NDArray dicts (checkpointing / get_params)."""
+        self.flush()  # buffered megastep batches must land before export
         arg, aux = self.trainer.get_params()
         for k, v in arg.items():
             arg_params[k][:] = v
@@ -146,7 +183,13 @@ class SPMDStepAdapter:
 
     # ------------------------------------------------------------------ step
     def step(self, data_batch):
-        """The fused train step: fwd + bwd + all-reduce + update."""
+        """The fused train step: fwd + bwd + all-reduce + update.
+
+        With ``MXNET_TRAIN_MEGASTEP_N`` > 1 the batch is only BUFFERED here;
+        every N-th call (or an explicit ``flush``) dispatches all N through
+        one ``lax.scan``-ed megastep. The lr schedule is still read at
+        buffer time, so schedules fire on the same optimizer step as the
+        N=1 path."""
 
         def host(v):
             return v._jax() if hasattr(v, "_jax") else np.asarray(v)
@@ -161,9 +204,61 @@ class SPMDStepAdapter:
         # same step here as on the per-device path
         opt.num_update += 1
         lr = self._lr_of_step(opt.num_update)
-        self._outputs = self.trainer.step(data, label, lr=lr)
+        if self._megastep_n <= 1:
+            self._outputs = self.trainer.step(data, label, lr=lr)
+            self.params_dirty = True
+            self._pending_step = True
+            return
+        # the iterator may reuse its buffers across next() calls — copy now
+        data = {n: np.asarray(v) for n, v in data.items()}
+        label = {n: np.asarray(v) for n, v in label.items()}
+        labels_nd = list(data_batch.label) if data_batch.label is not None else []
+        self._buf.append((data, label, lr, labels_nd))
+        self._outputs = None
         self.params_dirty = True
         self._pending_step = True
+        if len(self._buf) >= self._megastep_n:
+            self.flush()
+
+    def flush(self):
+        """Dispatch any buffered batches through one N-step megastep."""
+        if not self._buf:
+            return
+        buf, self._buf = self._buf, []
+        outs = self.trainer.step_many(
+            [b[0] for b in buf], [b[1] for b in buf],
+            lrs=[b[2] for b in buf])
+        self._metric_pairs.extend(
+            (b[3], o) for b, o in zip(buf, outs))
+        self._outputs = outs[-1]
+
+    def drain_metric(self, eval_metric):
+        """Feed every flushed-but-unreported (labels, outputs) pair into
+        ``eval_metric``. Returns True iff anything was drained."""
+        from ..ndarray import NDArray
+
+        pairs, self._metric_pairs = self._metric_pairs, []
+        for labels_nd, outs in pairs:
+            eval_metric.update(labels_nd, [NDArray(o) for o in outs])
+        return bool(pairs)
+
+    def update_metric(self, eval_metric, labels):
+        """Module.update_metric seam. Returns True when this adapter owns
+        the metric update (fused step ran), False → exec-group fallback.
+
+        Megastep mode drains the flushed backlog instead of pairing the
+        caller's ``labels`` with ``get_outputs()`` — with N batches per
+        dispatch the latest outputs do not correspond to the current batch.
+        A still-buffered batch also returns True (its metric row arrives at
+        the next flush) so the exec group's stale forward is never used."""
+        if self._megastep_n > 1:
+            if self.drain_metric(eval_metric):
+                return True
+            return bool(self._buf)
+        if self._outputs is None:
+            return False
+        eval_metric.update(labels, self.get_outputs())
+        return True
 
     def get_outputs(self):
         """Step outputs as NDArrays. Multi-host: each process sees its own
@@ -191,6 +286,7 @@ class SPMDStepAdapter:
     def get_states(self):
         import jax
 
+        self.flush()  # buffered megastep batches must land before snapshot
         return pickle.dumps(jax.device_get(self.trainer.opt_state))
 
     def set_states(self, blob):
@@ -385,6 +481,15 @@ def derive(module, shared_adapter):
             "split evenly over %d devices", module._exec_group.batch_size,
             len(module._context))
         return None
+    if shared_adapter._megastep_n > 1:
+        # buckets interleave steps over the shared state cell; buffering on
+        # the donor would flush out of order relative to bucket steps
+        logging.warning(
+            "MXNET_TRAIN_MEGASTEP_N=%d disabled: bucketing shares one "
+            "optimizer state cell across modules — dispatching one batch "
+            "per step from here on", shared_adapter._megastep_n)
+        shared_adapter.flush()
+        shared_adapter._megastep_n = 1
     try:
         # the donor's rules travel with its mesh: an autoplanned donor laid
         # params out per its plan, and the bucket trainer shares that state

@@ -103,6 +103,7 @@ class SPMDTrainer:
 
         self._state = _TrainState()
         self._step_fn = None
+        self._megastep_fns = {}  # (n, with_lr) -> jitted N-step scan
         self._step_count = 0
         self._seed = 0
         self._base_key = None
@@ -205,7 +206,14 @@ class SPMDTrainer:
         return jax.device_put(jnp.asarray(host), sharding)
 
     # ------------------------------------------------------------------ step
-    def _build_step(self):
+    def _make_step_fn(self):
+        """The pure one-step function ``step(params, aux, opt_state,
+        inputs, base_key, lr)`` — traced by ``_build_step`` as the
+        single-dispatch jit AND by ``_build_megastep`` as the scan body,
+        so the N-step megastep is bitwise the same math as N separate
+        steps (the per-step PRNG key folds the optimizer counter, which a
+        guard-skipped step does not advance — seeded dropout etc. stays
+        reproducible across any N partitioning)."""
         import jax
         import jax.numpy as jnp
 
@@ -295,7 +303,44 @@ class SPMDTrainer:
                     _sel(new_aux_d, dict(zip(aux_names, aux_tuple))),
                     _sel(new_opt, opt_state), outs, finite_vec)
 
-        return jax.jit(step, donate_argnums=(0, 1, 2))
+        return step
+
+    def _build_step(self):
+        import jax
+
+        return jax.jit(self._make_step_fn(), donate_argnums=(0, 1, 2))
+
+    def _build_megastep(self, n, with_lr):
+        """N fused steps in ONE dispatch: a ``lax.scan`` of the SAME step
+        body over batch-stacked inputs (leading axis N) and per-step lrs.
+        The carry is (params, aux, opt_state); head outputs (and the
+        anomaly guard's per-step finite vectors) stack along the scan
+        axis. Dispatch-side state mutation stays identical to ``step`` —
+        one jitted call, donated state."""
+        import jax
+
+        step = self._make_step_fn()
+        guard = self._anomaly_mode
+
+        def megastep(params, aux, opt_state, inputs, base_key, lrs):
+            def body(carry, xs):
+                p, a, o = carry
+                inp, lr = xs if with_lr else (xs, None)
+                res = step(p, a, o, inp, base_key, lr)
+                if guard is None:
+                    p2, a2, o2, outs = res
+                    return (p2, a2, o2), (outs, ())
+                p2, a2, o2, outs, fv = res
+                return (p2, a2, o2), (outs, fv)
+
+            xs = (inputs, lrs) if with_lr else inputs
+            (p, a, o), (outs, fvs) = jax.lax.scan(
+                body, (params, aux, opt_state), xs, length=n)
+            if guard is None:
+                return p, a, o, outs
+            return p, a, o, outs, fvs
+
+        return jax.jit(megastep, donate_argnums=(0, 1, 2))
 
     @property
     def _spans_processes(self):
@@ -337,6 +382,8 @@ class SPMDTrainer:
         sp = _tm.NULL_SPAN
         if _tm.enabled():
             _tm.counter("trainer.step").inc()
+            _tm.counter("trainer.dispatches").inc()
+            _tm.gauge("train.steps_per_dispatch").set(1)
             # host-side dispatch time only: the XLA step itself is async
             sp = _tm.span("trainer.step", n=self._step_count)
         with sp:
@@ -354,16 +401,125 @@ class SPMDTrainer:
                 self._check_anomaly(finite)
         return outs
 
+    def step_many(self, data_list, label_list=None, lrs=None):
+        """Run N training steps in ONE dispatch (the training megastep,
+        docs/PERF.md §megasteps): the N batches are stacked on a leading
+        axis and scanned through the same step body ``step`` traces, so
+        the resulting weights are bitwise what N ``step`` calls produce —
+        including NaN-guard skipped steps, which where-select the old
+        state inside the scan exactly as they do outside it.
+
+        ``lrs`` is an optional per-step learning-rate list (None entries
+        fall back to the optimizer's static lr). Returns a list of N
+        per-step head-output tuples (device arrays, sliced from the
+        stacked scan outputs). Multi-process meshes are rejected:
+        process-local shard assembly has no stacked equivalent."""
+        import jax.numpy as jnp
+
+        n = len(data_list)
+        if n == 0:
+            return []
+        if not self.params and self.param_names:
+            raise MXNetError("call init_params first")
+        if n == 1:
+            lr = lrs[0] if lrs else None
+            outs = self.step(data_list[0],
+                             (label_list or [None])[0], lr=lr)
+            return [outs]
+        if self._spans_processes:
+            raise MXNetError(
+                "step_many: multi-process meshes are not supported (the "
+                "stacked batch cannot be assembled from process-local "
+                "shards) — set MXNET_TRAIN_MEGASTEP_N=1")
+        with_lr = False
+        lr_vals = None
+        if lrs is not None or self._opt_static_lr is not None:
+            vals = [(None if lrs is None else lrs[i]) for i in range(n)]
+            vals = [self._opt_static_lr if v is None else v for v in vals]
+            if any(v is None for v in vals):
+                raise MXNetError(
+                    "step_many: per-step lr required when the optimizer "
+                    "has no static learning rate")
+            with_lr = True
+            lr_vals = jnp.asarray(np.asarray(vals, np.float32))
+        key = (n, with_lr)
+        fn = self._megastep_fns.get(key)
+        if fn is None:
+            if self._step_fn is None:
+                # step() and step_many() share _anomaly_mode; build the
+                # single-step jit first so both read the same guard mode
+                self._step_fn = self._build_step()
+            fn = self._megastep_fns[key] = self._build_megastep(n, with_lr)
+        from .. import telemetry as _tm
+
+        sp = _tm.NULL_SPAN
+        if _tm.enabled():
+            _tm.counter("trainer.step").inc(n)
+            _tm.counter("trainer.megastep").inc()
+            _tm.counter("trainer.dispatches").inc()
+            _tm.gauge("train.steps_per_dispatch").set(n)
+            sp = _tm.span("trainer.megastep", n=self._step_count, steps=n)
+        with sp:
+            placed = self._place_batch_stacked(data_list, label_list)
+            self._step_count += n
+            res = fn(self.params, self.aux, self.opt_state, placed,
+                     self._base_key, lr_vals)
+            if self._anomaly_mode is None:
+                self.params, self.aux, self.opt_state, outs = res
+            else:
+                self.params, self.aux, self.opt_state, outs, fvs = res
+                self._check_anomaly(fvs)
+        return [tuple(o[i] for o in outs) for i in range(n)]
+
+    def _place_batch_stacked(self, data_list, label_list=None):
+        """Stack N host batches on a leading scan axis and lay them out on
+        the mesh: per-step sharding is the usual batch spec, the scan axis
+        is unsharded (``P(None, *batch_spec)``)."""
+        import jax
+        import jax.numpy as jnp
+        from jax.sharding import PartitionSpec as P
+
+        n = len(data_list)
+        labels = label_list or [None] * n
+        placed = {}
+        for name in self.input_names:
+            rows = []
+            for i in range(n):
+                inputs = dict(data_list[i])
+                inputs.update(labels[i] or {})
+                if name not in inputs:
+                    raise MXNetError("missing input %r" % name)
+                rows.append(np.asarray(inputs[name]))
+            stacked = np.stack(rows, axis=0)
+            spec = self.rules.batch_spec(rows[0].shape)
+            sspec = P(*((None,) + tuple(spec)))
+            placed[name] = jax.device_put(jnp.asarray(stacked),
+                                          self.rules.named(sspec))
+        if getattr(self, "_base_key", None) is None:
+            self._base_key = jax.device_put(
+                jax.random.PRNGKey(self._seed),
+                self.rules.named(_replicated(self.rules)))
+        return placed
+
     def _check_anomaly(self, finite_vec):
         """Host half of the anomaly guard: the device side already
         where-selected the old state if any gradient was non-finite; here
         the per-key vector is read back (this synchronizes the step — the
         guard trades async dispatch for the check, docs/RESILIENCE.md) to
-        count the skip or raise naming the first offending key."""
+        count the skip or raise naming the first offending key.
+
+        A megastep hands a (N, keys) stack — one row per scanned step,
+        checked in step order. The device side already skip-selected each
+        offending step individually; in raise mode the error surfaces
+        after the whole dispatch (the scan cannot stop mid-flight)."""
         from .. import telemetry as _tm
 
         fv = np.asarray(finite_vec)
         if fv.all():
+            return
+        if fv.ndim == 2:
+            for row in fv:
+                self._check_anomaly(row)
             return
         bad = sorted(self.params)[int(np.argmin(fv))]
         if self._anomaly_mode == "raise":

@@ -16,9 +16,20 @@ swap, no copy, no host round-trip. Attention over slots is
 order-agnostic (position information lives in the embeddings), so ring
 wraparound needs no rotation: once ``p >= max_len`` every slot is valid
 and the oldest token is simply the one overwritten.
+
+Megasteps (``MXNET_DECODE_MEGASTEP_K``, docs/SERVING.md §megasteps): the
+per-token loop above still pays one host round-trip per token.
+``decode_megastep``/``step_megastep`` fold K decode steps into ONE
+compiled program — a ``lax.scan`` over the same decode graph with
+on-device sampling (greedy argmax head, or temperature/top-k via the
+PRNG machinery) — so only (K, B) token ids cross the host per dispatch.
+Per-lane early exit reuses the all-zero ``slot_onehot`` idle-lane idiom:
+once a lane emits ``eos_id`` its remaining scan steps write NOTHING to
+its KV slots. K=1 keeps today's single-step path byte-for-byte.
 """
 from __future__ import annotations
 
+import os
 import time
 from typing import Dict, Optional
 
@@ -28,9 +39,24 @@ from ..base import MXNetError
 from .. import telemetry as _tm
 from .cache import PersistentExecutableCache
 
-__all__ = ["KVCacheDecoder", "PagedKVDecoder", "PagedKVExhausted"]
+__all__ = ["KVCacheDecoder", "PagedKVDecoder", "PagedKVExhausted",
+           "decode_megastep_k"]
 
 _NEG = np.float32(-1e9)
+
+
+def decode_megastep_k(default=1):
+    """Decode tokens per dispatch (``MXNET_DECODE_MEGASTEP_K``). K=1 is
+    the classic single-step path; K>1 routes the greedy loops through the
+    scan megastep. Junk values fall back to ``default``."""
+    raw = os.environ.get("MXNET_DECODE_MEGASTEP_K", "").strip()
+    if not raw:
+        return int(default)
+    try:
+        k = int(raw)
+    except ValueError:
+        return int(default)
+    return k if k >= 1 else int(default)
 
 
 def _gap_mark(dec, site):
@@ -56,6 +82,243 @@ def _gap_return(dec):
         dec._last_return_t = time.perf_counter()
 
 
+# ------------------------------------------------------------------ megastep
+class _Sampler:
+    """On-device sampling config for megasteps: ``greedy`` takes the
+    graph's argmax head; ``topk`` divides logits by ``temperature``,
+    masks everything below the ``top_k``-th logit (0 = no truncation) and
+    draws with ``jax.random.categorical``."""
+
+    __slots__ = ("mode", "temperature", "top_k")
+
+    def __init__(self, mode="greedy", temperature=1.0, top_k=0):
+        if mode not in ("greedy", "topk"):
+            raise MXNetError("decode sampler: mode must be 'greedy' or "
+                             "'topk', got %r" % (mode,))
+        self.mode = mode
+        self.temperature = float(temperature)
+        self.top_k = int(top_k)
+        if self.temperature <= 0:
+            raise MXNetError("decode sampler: temperature must be > 0")
+        if self.top_k < 0:
+            raise MXNetError("decode sampler: top_k must be >= 0")
+
+    def key(self):
+        return (self.mode, self.temperature, self.top_k)
+
+
+def _sampler_from(sample=None, temperature=None, top_k=None):
+    """Resolve sampler knobs: explicit arguments win over the
+    MXNET_DECODE_SAMPLE / _TEMP / _TOPK environment defaults."""
+    mode = sample or os.environ.get("MXNET_DECODE_SAMPLE", "greedy")
+    if temperature is None:
+        temperature = float(os.environ.get("MXNET_DECODE_SAMPLE_TEMP",
+                                           "1.0"))
+    if top_k is None:
+        top_k = int(os.environ.get("MXNET_DECODE_SAMPLE_TOPK", "0"))
+    return _Sampler(mode, temperature, top_k)
+
+
+def _sampling_key(dec):
+    """Per-decoder PRNG base key for on-device sampling. Seeded from the
+    decoder's ``sample_seed`` ctor arg, else MXNET_DECODE_SAMPLE_SEED,
+    else split off the global PRNG stream. The base key is FIXED for the
+    decoder's life — the megastep folds the absolute position and lane
+    index into it per draw, so a seeded decode emits the same tokens no
+    matter how the steps are partitioned into megasteps."""
+    if dec._sample_key is None:
+        import jax
+
+        seed = dec._sample_seed
+        if seed is None:
+            raw = os.environ.get("MXNET_DECODE_SAMPLE_SEED", "").strip()
+            seed = int(raw) if raw else None
+        if seed is not None:
+            dec._sample_key = jax.random.PRNGKey(int(seed))
+        else:
+            from .. import random as _rnd
+
+            dec._sample_key = _rnd._next_key()
+    return dec._sample_key
+
+
+class _DecodeMegastep:
+    """K decode steps folded into ONE compiled program.
+
+    A ``jax.jit``-ted ``lax.scan`` over the per-stream decode graph
+    (``_GraphProgram.interpret`` is pure and jit-safe): the scan carries
+    (next token, done mask, attention mask, KV buffers), each step blends
+    its KV write in-graph through the host-staged slot plan, samples the
+    next token ON DEVICE, and only the stacked (K, B) ids + activity
+    mask ever cross to the host. EOS'd / idle lanes carry an all-zero
+    ``slot_onehot`` row — their KV passes through bitwise-unchanged (the
+    idle-lane idiom the paged decoder already relies on).
+
+    Shapes are fixed at build time, so after the warm-time compile every
+    dispatch is a jit cache hit; input-signature drift is a hard retrace
+    error, mirroring the sealed ``PersistentExecutableCache`` contract.
+    """
+
+    def __init__(self, dec, k, sampler):
+        import jax
+        import jax.numpy as jnp
+
+        from ..executor import _GraphProgram
+        from ..models import transformer as _tf
+
+        self.k = int(k)
+        self.sampler = sampler
+        self.rows = dec.batch if hasattr(dec, "batch") else dec.lanes
+        B, S, L = self.rows, dec.max_len, dec.num_layers
+        self._S = S
+        pos_len = dec.pos_len
+        sym = _tf.get_decode_symbol(
+            vocab_size=dec.vocab_size, num_layers=L,
+            num_heads=dec.num_heads, model_dim=dec.model_dim,
+            ffn_dim=dec.ffn_dim, max_len=S, pos_len=pos_len,
+            per_stream_slots=True)
+        prog = _GraphProgram(sym)
+        if prog.aux_names:
+            raise MXNetError("decode megastep: the decode graph must carry "
+                             "no aux state, got %r" % (prog.aux_names,))
+        self.kv_names = [n for i in range(L)
+                         for n in ("kv_k_%d" % i, "kv_v_%d" % i)]
+        step_inputs = {"data", "pos_idx", "slot_onehot", "kv_mask"}
+        step_inputs.update(self.kv_names)
+        # weight names are shared across every serving graph — the values
+        # are pulled from the live executable at DISPATCH time, so a
+        # hitless swap_params lands in the very next megastep
+        self.weight_names = [n for n in prog.arg_names
+                             if n not in step_inputs]
+        arg_names = list(prog.arg_names)
+        mode, temp, top_k = sampler.mode, sampler.temperature, sampler.top_k
+        lane_ids = jnp.arange(B)
+
+        def _sample(logits, pos_abs, base_key):
+            lg = logits.astype(jnp.float32) / jnp.float32(temp)
+            if top_k > 0:
+                kth = jax.lax.top_k(lg, top_k)[0][:, -1:]
+                lg = jnp.where(lg < kth, -jnp.inf, lg)
+
+            def draw(p, lane, row):
+                # fold ABSOLUTE position then lane: reproducible across
+                # any K partitioning of the same decode
+                return jax.random.categorical(
+                    jax.random.fold_in(
+                        jax.random.fold_in(base_key, p), lane), row)
+
+            return jax.vmap(draw)(pos_abs, lane_ids, lg)
+
+        def run(weights, kvs, tok0, pos, slots, base_mask, done0, key, eos):
+            def body(carry, xs):
+                tok, done, mask, kv = carry
+                t, slot_col = xs
+                act = jnp.logical_not(done)
+                oh = jax.nn.one_hot(slot_col, S, dtype=jnp.float32) \
+                    * act.astype(jnp.float32)[:, None]
+                # the slot written this step becomes attendable now and
+                # for the rest of the scan (the carried mask accumulates)
+                mask = jnp.where(oh > 0, jnp.float32(0), mask)
+                # idle/done lanes clamp their position into the trained
+                # table; their onehot row is all-zero so the value is
+                # never written anywhere
+                pos_t = jnp.clip(pos + t, 0, pos_len - 1)
+                feed = {"data": tok.astype(jnp.float32)[:, None],
+                        "pos_idx": pos_t.astype(jnp.float32)[:, None],
+                        "slot_onehot": oh, "kv_mask": mask}
+                for i, name in enumerate(self.kv_names):
+                    feed[name] = kv[i]
+                args = [feed[n] if n in feed else weights[n]
+                        for n in arg_names]
+                outs, _ = prog.interpret(args, (), False, key)
+                new_kv = tuple(outs[1 + j] for j in range(2 * L))
+                if mode == "greedy":
+                    nxt = outs[-1].astype(jnp.int32)  # on-device argmax head
+                else:
+                    nxt = _sample(outs[0], pos + t, key).astype(jnp.int32)
+                nxt = jnp.where(act, nxt, jnp.maximum(eos, 0))
+                done = jnp.logical_or(
+                    done, jnp.logical_and(act, (eos >= 0) & (nxt == eos)))
+                return (nxt, done, mask, new_kv), (nxt, act)
+
+            xs = (jnp.arange(self.k), jnp.transpose(slots))
+            (_tok, done_f, _mask, kv_f), (toks, acts) = jax.lax.scan(
+                body, (tok0, done0, base_mask, kvs), xs)
+            return toks, acts, kv_f, done_f
+
+        self._fn = jax.jit(run)
+        self._sig = None
+
+    @staticmethod
+    def _sig_of(*arrays):
+        return tuple((tuple(a.shape), str(a.dtype)) for a in arrays)
+
+    def _zero_inputs(self):
+        B, S = self.rows, self._S
+        tok0 = np.zeros((B,), np.int32)
+        pos = np.zeros((B,), np.int32)
+        slots = np.zeros((B, self.k), np.int32)
+        base_mask = np.full((B, S), _NEG, np.float32)
+        done0 = np.ones((B,), bool)  # every lane idle: compiles, writes nothing
+        return tok0, pos, slots, base_mask, done0
+
+    def warm(self, dec):
+        """Compile the megastep NOW (a dummy all-idle dispatch), counted
+        as the one ``executor.compile`` this program ever charges — bench
+        warmup snapshots see it, the steady state never does."""
+        import jax
+        import jax.numpy as jnp
+
+        B, S = self.rows, self._S
+        H, dh = dec.num_heads, dec.dh
+        weights = {n: dec._dec_exe.arg_dict[n]._jax()
+                   for n in self.weight_names}
+        kvs = tuple(jnp.zeros((B, H, S, dh), jnp.float32)
+                    for _ in self.kv_names)
+        z = self._zero_inputs()
+        with _tm.span("serving.megastep_compile", k=self.k, rows=B,
+                      sampler=self.sampler.mode):
+            out = self._fn(weights, kvs, *z, _sampling_key(dec),
+                           np.int32(-1))
+            # graphlint: waive GL7xx -- warm-time compile barrier, not the dispatch path
+            jax.block_until_ready(out)
+        self._sig = self._sig_of(*z)
+        if _tm.enabled():
+            _tm.counter("executor.compile").inc()
+
+    def run(self, dec, tok0, pos, slots, base_mask, done0, eos):
+        """One megastep dispatch. Returns device-resident
+        ``(toks (K,B) i32, acts (K,B) bool, new_kvs, done)`` — the caller
+        pulls the ids (the only host transfer) and pointer-swaps the KV."""
+        sig = self._sig_of(tok0, pos, slots, base_mask, done0)
+        if self._sig is not None and sig != self._sig:
+            if _tm.enabled():
+                _tm.counter("executor.retrace").inc()
+            raise MXNetError(
+                "decode megastep (K=%d): input signature drifted from the "
+                "warmed shapes (%r != %r) — megastep programs are sealed "
+                "like the executable cache" % (self.k, sig, self._sig))
+        if _tm.enabled():
+            _tm.counter("executor.cache_hit").inc()
+        weights = {n: dec._dec_exe.arg_dict[n]._jax()
+                   for n in self.weight_names}
+        kvs = tuple(dec._dec_exe.arg_dict[n]._jax() for n in self.kv_names)
+        return self._fn(weights, kvs, tok0, pos, slots, base_mask, done0,
+                        _sampling_key(dec), eos)
+
+
+def _megastep_for(dec, k, sampler):
+    """The decoder's cached megastep program for ``(K, sampler)`` —
+    built + warm-compiled once, a jit cache hit forever after."""
+    cache_key = (int(k), sampler.key())
+    ms = dec._megasteps.get(cache_key)
+    if ms is None:
+        ms = _DecodeMegastep(dec, k, sampler)
+        ms.warm(dec)
+        dec._megasteps[cache_key] = ms
+    return ms
+
+
 class KVCacheDecoder:
     """Batched greedy/streaming decode over the serving transformer.
 
@@ -68,13 +331,15 @@ class KVCacheDecoder:
                  num_layers=2, num_heads=2, model_dim=32, ffn_dim=64,
                  max_len=64, prefill_len: Optional[int] = None,
                  pos_len: Optional[int] = None, batch=1, ctx=None,
-                 dtype="float32", cache_dir=None, model_key=None):
+                 dtype="float32", cache_dir=None, model_key=None,
+                 sample_seed=None):
         from ..models import transformer as _tf
 
         self.vocab_size = int(vocab_size)
         self.num_layers = int(num_layers)
         self.num_heads = int(num_heads)
         self.model_dim = int(model_dim)
+        self.ffn_dim = int(ffn_dim)
         self.max_len = int(max_len)
         self.prefill_len = int(prefill_len or max_len)
         self.pos_len = int(pos_len or max_len)
@@ -100,6 +365,9 @@ class KVCacheDecoder:
         self._warm = False
         self._token_out = False
         self._last_return_t = None  # dispatch.host_gap interval start
+        self._megasteps = {}        # (K, sampler) -> _DecodeMegastep
+        self._sample_seed = sample_seed
+        self._sample_key = None
 
     # ------------------------------------------------------------ lifecycle
     def _decode_shapes(self):
@@ -121,9 +389,13 @@ class KVCacheDecoder:
         self._dec_exe = self._dec_cache.executable(self._decode_shapes())
         # trailing greedy_token head (transformer.get_decode_symbol
         # token_out=True)? A stale on-disk cache may hold the old program,
-        # so trust the compiled executable, not the symbol we asked for
-        self._token_out = \
-            len(self._dec_exe.outputs) == 2 + 2 * self.num_layers
+        # so trust the compiled executable, not the symbol we asked for —
+        # and detect the head BY NAME, not by output count: a count check
+        # misreads any program whose output arity merely coincides (e.g.
+        # a cached token-less program at a different layer count)
+        self._token_out = any(
+            name.startswith("greedy_token")
+            for name in self._dec_exe.output_dict)
         self._warm = True
         return self
 
@@ -223,6 +495,7 @@ class KVCacheDecoder:
         self._pos += 1
         if _tm.enabled():
             _tm.counter("serving.decode_tokens").inc(self.batch)
+            _tm.gauge("decode.tokens_per_dispatch").set(self.batch)
 
     def decode_step(self, tokens):
         """One token per stream through the decode executable. ``tokens``
@@ -252,24 +525,92 @@ class KVCacheDecoder:
         with _tm.span("serving.decode_step", rows=self.batch, pos=p,
                       greedy=True):
             exe.forward(is_train=False)
+            # graphlint: waive GL701 -- single-step tail of the megastep loop; the K-amortized body is the lax.scan in decode_megastep
             nxt = exe.outputs[-1].asnumpy()
         _gap_return(self)
         self._finish_step(exe)
         return nxt.astype(np.int64)
 
-    def greedy(self, prompt, n_tokens):
+    def decode_megastep(self, tokens, k=None, eos_id=None, sample=None,
+                        temperature=None, top_k=None):
+        """K tokens per stream in ONE dispatch: the K-step decode loop
+        runs as a ``lax.scan`` INSIDE the compiled program — in-graph
+        ring writes, on-device sampling (greedy argmax by default;
+        ``sample='topk'`` with ``temperature``/``top_k`` draws through
+        the PRNG machinery) — and only the (B, K) token ids cross back
+        to the host. ``eos_id`` arms per-lane early exit: once a lane
+        emits it, its later scan steps write NOTHING to the KV buffers
+        (all-zero slot_onehot rows) and its remaining outputs are eos
+        filler; the lockstep position still advances by K for every
+        lane. Returns (B, K) int64 ids. ``tokens`` is the (B,) step
+        input, exactly as for ``greedy_step``."""
+        self.warmup()
+        k = int(k) if k is not None else decode_megastep_k()
+        if k < 1:
+            raise MXNetError("decode_megastep: K must be >= 1, got %d" % k)
+        p, S, B = self._pos, self.max_len, self.batch
+        if p + k > self.pos_len:
+            raise MXNetError(
+                "decode_megastep: positions %d..%d exceed the trained "
+                "position table (%d rows)" % (p, p + k - 1, self.pos_len))
+        ms = _megastep_for(self, k,
+                           _sampler_from(sample, temperature, top_k))
+        tok0 = np.asarray(tokens, np.int32).reshape(B)
+        posv = np.full((B,), p, np.int32)
+        # slot plan: K consecutive ring slots, staged host-side exactly
+        # like _stage_step stages one
+        slots = np.tile((np.arange(p, p + k) % S).astype(np.int32), (B, 1))
+        valid = np.arange(S) < min(p, S)
+        base_mask = np.broadcast_to(
+            np.where(valid, np.float32(0), _NEG), (B, S)) \
+            .astype(np.float32).copy()
+        done0 = np.zeros((B,), bool)
+        eos = np.int32(-1 if eos_id is None else int(eos_id))
+        _gap_mark(self, "serving.decode_megastep")
+        with _tm.span("serving.decode_megastep", rows=B, pos=p, k=k):
+            toks, acts, new_kvs, _done = ms.run(
+                self, tok0, posv, slots, base_mask, done0, eos)
+            ids = np.asarray(toks)       # (K, B): the only host pull
+            acts_h = np.asarray(acts)
+        _gap_return(self)
+        for name, arr in zip(ms.kv_names, new_kvs):
+            self._dec_exe.arg_dict[name]._set_jax(arr)
+        self._pos = p + k
+        if _tm.enabled():
+            _tm.counter("serving.decode_tokens").inc(int(acts_h.sum()))
+            _tm.counter("serving.megasteps").inc()
+            _tm.gauge("decode.tokens_per_dispatch").set(ids.size)
+        return ids.T.astype(np.int64)
+
+    def greedy(self, prompt, n_tokens, k=None, eos_id=None):
         """Greedy-decode ``n_tokens`` continuations of a (B, L) prompt.
-        Returns (B, n_tokens) int64 token ids."""
+        With ``k`` > 1 (default ``MXNET_DECODE_MEGASTEP_K``) the loop
+        advances K tokens per dispatch through ``decode_megastep``; the
+        sub-K tail reuses the single-step program (both are warm — no
+        extra compiles). K=1 reproduces the classic per-token loop call
+        for call. Returns (B, n_tokens) int64 token ids."""
+        k = int(k) if k is not None else decode_megastep_k()
         logits = self.prefill(prompt)
         # prompt-head argmax: once per SEQUENCE, and the prefill API hands
         # these logits to the host anyway; the per-token loop below stays
         # on device via greedy_step
         nxt = np.argmax(logits, axis=-1)  # graphlint: waive GL703 -- once per sequence, logits already host-side
         out = np.zeros((self.batch, n_tokens), np.int64)
-        for t in range(n_tokens):
-            out[:, t] = nxt
-            if t + 1 < n_tokens:
+        if n_tokens:
+            out[:, 0] = nxt
+        t = 1
+        while t < n_tokens:
+            if k > 1 and n_tokens - t >= k:
+                # graphlint: waive GL702 -- K steps already folded into one lax.scan dispatch; the carried token is K-amortized
+                chunk = self.decode_megastep(nxt, k=k, eos_id=eos_id)
+                out[:, t:t + k] = chunk
+                nxt = chunk[:, -1]
+                t += k
+            else:
+                # graphlint: waive GL702 -- sub-K tail: fewer than K tokens left, single-step program is already warm
                 nxt = self.greedy_step(nxt)
+                out[:, t] = nxt
+                t += 1
         return out
 
 
@@ -365,13 +706,15 @@ class PagedKVDecoder:
                  max_len=64, page_size=8, lanes=4, page_budget=None,
                  prefill_len: Optional[int] = None,
                  pos_len: Optional[int] = None, ctx=None,
-                 dtype="float32", cache_dir=None, model_key=None):
+                 dtype="float32", cache_dir=None, model_key=None,
+                 sample_seed=None):
         from ..models import transformer as _tf
 
         self.vocab_size = int(vocab_size)
         self.num_layers = int(num_layers)
         self.num_heads = int(num_heads)
         self.model_dim = int(model_dim)
+        self.ffn_dim = int(ffn_dim)
         self.max_len = int(max_len)
         self.lanes = int(lanes)
         self.prefill_len = int(prefill_len or max_len)
@@ -402,6 +745,9 @@ class PagedKVDecoder:
         self._next_seq = 0
         self._warm = False
         self._last_return_t = None  # dispatch.host_gap interval start
+        self._megasteps = {}        # (K, sampler) -> _DecodeMegastep
+        self._sample_seed = sample_seed
+        self._sample_key = None
 
     # ------------------------------------------------------------ lifecycle
     def _decode_shapes(self):
@@ -574,6 +920,7 @@ class PagedKVDecoder:
         with _tm.span("serving.decode_step", rows=len(stepped),
                       paged=True):
             exe.forward(is_train=False)
+            # graphlint: waive GL701 -- single-step tail of the megastep loop; the K-amortized body is the lax.scan in step_megastep
             logits = exe.outputs[0].asnumpy()
         _gap_return(self)
         for i in range(self.num_layers):
@@ -589,15 +936,98 @@ class PagedKVDecoder:
         if _tm.enabled():
             _tm.counter("serving.decode_tokens").inc(len(stepped))
             _tm.counter("serving.paged_steps").inc()
+            _tm.gauge("decode.tokens_per_dispatch").set(len(stepped))
             _tm.gauge("serving.paged_pages_in_use").set(self.pool.in_use)
         return out
 
-    def greedy(self, prompts, n_tokens):
+    def step_megastep(self, tokens: Dict[int, object], k=None, eos_id=None,
+                      sample=None, temperature=None, top_k=None):
+        """K multiplexed decode steps in ONE dispatch: every stepped
+        sequence advances K positions at ITS OWN offsets through the
+        ``lax.scan`` megastep, sampling on device (greedy argmax default,
+        temperature/top-k via ``sample='topk'``). Page frames for ALL K
+        positions are acquired UP FRONT, so pool exhaustion
+        (``PagedKVExhausted``) surfaces BEFORE any device work — megastep
+        backpressure is admission backpressure: already-acquired frames
+        stay with their lanes (a retry after ``retire`` reuses them) and
+        the KV state is untouched. Unstepped lanes ride along idle
+        (all-zero onehot rows); with ``eos_id`` a lane that emits eos
+        mid-megastep writes nothing for its remaining steps and only its
+        pre-eos slots become valid. Returns {seq_id: (K,) int64 ids}."""
+        self.warmup()
+        k = int(k) if k is not None else decode_megastep_k()
+        if k < 1:
+            raise MXNetError("step_megastep: K must be >= 1, got %d" % k)
+        if not tokens:
+            return {}
+        B, S = self.lanes, self.max_len
+        stepped = []
+        for seq_id, tok in tokens.items():
+            idx = self._seq_lane.get(seq_id)
+            if idx is None:
+                raise MXNetError("paged_kv: unknown seq_id %r" % (seq_id,))
+            lane = self._lanes[idx]
+            if lane.pos + k > self.pos_len:
+                raise MXNetError(
+                    "paged_kv: seq %d megastep positions %d..%d exceed the "
+                    "trained position table (%d rows)"
+                    % (seq_id, lane.pos, lane.pos + k - 1, self.pos_len))
+            stepped.append((seq_id, idx, lane, tok))
+        phys = {}
+        for seq_id, idx, lane, tok in stepped:
+            phys[seq_id] = [self._phys_slot(lane, lane.pos + i)
+                            for i in range(k)]
+        ms = _megastep_for(self, k,
+                           _sampler_from(sample, temperature, top_k))
+        tok0 = np.zeros((B,), np.int32)
+        posv = np.zeros((B,), np.int32)
+        slots = np.zeros((B, k), np.int32)
+        base_mask = np.full((B, S), _NEG, np.float32)
+        done0 = np.ones((B,), bool)  # idle unless stepped
+        for seq_id, idx, lane, tok in stepped:
+            tok0[idx] = int(np.asarray(tok).reshape(()))
+            posv[idx] = lane.pos
+            slots[idx] = phys[seq_id]
+            base_mask[idx, lane.valid_slots] = 0.0
+            done0[idx] = False
+        eos = np.int32(-1 if eos_id is None else int(eos_id))
+        _gap_mark(self, "serving.paged_megastep")
+        with _tm.span("serving.decode_megastep", rows=len(stepped),
+                      paged=True, k=k):
+            toks, acts, new_kvs, _done = ms.run(
+                self, tok0, posv, slots, base_mask, done0, eos)
+            ids = np.asarray(toks)       # (K, B): the only host pull
+            acts_h = np.asarray(acts)
+        _gap_return(self)
+        for name, arr in zip(ms.kv_names, new_kvs):
+            self._dec_exe.arg_dict[name]._set_jax(arr)
+        out = {}
+        written = 0
+        for seq_id, idx, lane, tok in stepped:
+            # active steps form a prefix (done latches): exactly the
+            # steps whose KV write landed — only THOSE slots go valid
+            n_w = int(acts_h[:, idx].sum())
+            lane.valid_slots.extend(phys[seq_id][:n_w])
+            lane.pos += n_w
+            written += n_w
+            out[seq_id] = ids[:, idx].astype(np.int64)
+        if _tm.enabled():
+            _tm.counter("serving.decode_tokens").inc(written)
+            _tm.counter("serving.megasteps").inc()
+            _tm.gauge("decode.tokens_per_dispatch").set(k * len(stepped))
+            _tm.gauge("serving.paged_pages_in_use").set(self.pool.in_use)
+        return out
+
+    def greedy(self, prompts, n_tokens, k=None):
         """Greedy-decode ``n_tokens`` continuations for several prompts AT
         ONCE through the multiplexed batch (admitted together, stepped
-        together — one dispatch per token across all of them). ``prompts``
-        is a list of (L_i,) token arrays (lengths may differ). Returns a
-        list of (n_tokens,) int64 arrays. Convenience for tests/bench."""
+        together). With ``k`` > 1 (default ``MXNET_DECODE_MEGASTEP_K``)
+        the loop advances K tokens per dispatch via ``step_megastep``;
+        K=1 reproduces the classic one-dispatch-per-token loop call for
+        call. ``prompts`` is a list of (L_i,) token arrays (lengths may
+        differ). Returns a list of (n_tokens,) int64 arrays. Convenience
+        for tests/bench."""
+        k = int(k) if k is not None else decode_megastep_k()
         seqs = []
         logits = {}
         try:
@@ -606,12 +1036,27 @@ class PagedKVDecoder:
                 seqs.append(sid)
                 logits[sid] = lg
             out = {sid: np.zeros((n_tokens,), np.int64) for sid in seqs}
-            for t in range(n_tokens):
-                nxt = {sid: int(np.argmax(logits[sid])) for sid in seqs}
-                for sid in seqs:
-                    out[sid][t] = nxt[sid]
-                if t + 1 < n_tokens:
-                    logits = self.step(nxt)
+            nxt = {sid: int(np.argmax(logits[sid])) for sid in seqs}
+            for sid in seqs:
+                if n_tokens:
+                    out[sid][0] = nxt[sid]
+            t = 1
+            while t < n_tokens:
+                if k > 1 and n_tokens - t >= k:
+                    # graphlint: waive GL702 -- K steps already folded into one lax.scan dispatch; the carried token is K-amortized
+                    chunk = self.step_megastep(nxt, k=k)
+                    for sid in seqs:
+                        out[sid][t:t + k] = chunk[sid]
+                        nxt[sid] = int(chunk[sid][-1])
+                    t += k
+                else:
+                    # graphlint: waive GL702 -- sub-K tail: fewer than K tokens left, single-step program is already warm
+                    lg = self.step(nxt)
+                    # graphlint: waive GL703 -- sub-K tail host argmax, one id per lane on already-pulled logits
+                    nxt = {sid: int(np.argmax(lg[sid])) for sid in seqs}
+                    for sid in seqs:
+                        out[sid][t] = nxt[sid]
+                    t += 1
             return [out[sid] for sid in seqs]
         finally:
             # retire on EVERY exit: a partial admit/step failure must not

@@ -127,7 +127,14 @@ def gaps_table(trace, top):
     """Host-gap attribution: per span name, the host time between one
     span's end and the next one's start on the same thread (negative
     overlaps from threaded interleaving clamp to zero; the ``clamp``
-    column counts them). ``gap%%`` is gap/busy — the GL705 ratio."""
+    column counts them). ``gap%%`` is gap/busy — the GL705 ratio.
+    Megastep dispatches (K tokens / N batches per launch) are tagged
+    ``[megastep]`` so their per-interval gap is read as amortized over
+    K, not compared 1:1 against single-step rows."""
+
+    def _label(name):
+        return name + " [megastep]" if "megastep" in name else name
+
     rows = [r for r in gap_summary(trace=trace, top=top)
             if r["intervals"] > 0]
     if not rows:
@@ -136,7 +143,7 @@ def gaps_table(trace, top):
     return _fmt_table(
         ["span", "gap_ms", "busy_ms", "gap%", "gap/iv", "max_gap",
          "ivs", "clamp"],
-        [[r["name"], "%.3f" % r["gap_ms"], "%.3f" % r["busy_ms"],
+        [[_label(r["name"]), "%.3f" % r["gap_ms"], "%.3f" % r["busy_ms"],
           ("%.0f%%" % (100.0 * r["gap_ms"] / r["busy_ms"])
            if r["busy_ms"] > 0 else "-"),
           "%.3f" % (r["gap_ms"] / r["intervals"]),

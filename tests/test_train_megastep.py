@@ -1,0 +1,255 @@
+"""Training megasteps (SPMDTrainer.step_many + the SPMD adapter's
+MXNET_TRAIN_MEGASTEP_N buffering, docs/PERF.md §Megasteps): N fused
+steps per dispatch through one lax.scan. Gates: bitwise weight parity
+with N separate step() calls (NaN-guard skipped step included),
+dispatches-per-batch reduced N×, and Module.fit metric parity through
+the buffered update_metric/flush seams."""
+import numpy as np
+import pytest
+
+import mxnet_tpu as mx
+from mxnet_tpu import parallel, telemetry
+from mxnet_tpu.base import MXNetError
+from mxnet_tpu.module.spmd_adapter import train_megastep_n
+
+
+@pytest.fixture
+def tm():
+    telemetry.reset()
+    telemetry.clear_events()
+    saved = telemetry.current_override()
+    yield telemetry
+    telemetry.set_mode(saved)
+    telemetry.reset()
+    telemetry.clear_events()
+
+
+def _mlp(hidden=32, classes=4):
+    data = mx.sym.Variable("data")
+    h = mx.sym.FullyConnected(data, name="fc1", num_hidden=hidden)
+    h = mx.sym.Activation(h, name="relu1", act_type="relu")
+    h = mx.sym.FullyConnected(h, name="fc2", num_hidden=classes)
+    return mx.sym.SoftmaxOutput(h, name="softmax")
+
+
+def _host_batches(n, batch=16, feat=8, classes=4, seed=0, nan_step=None):
+    rs = np.random.RandomState(seed)
+    out = []
+    for i in range(n):
+        x = rs.rand(batch, feat).astype("float32")
+        if i == nan_step:
+            x[0, 0] = np.nan
+        y = rs.randint(0, classes, (batch,)).astype("float32")
+        out.append(({"data": x}, {"softmax_label": y}))
+    return out
+
+
+def _trainer(seed=5):
+    import jax
+
+    mesh = parallel.make_mesh((2,), ("data",), jax.devices()[:2])
+    tr = parallel.SPMDTrainer(
+        _mlp(), mesh, optimizer="sgd",
+        optimizer_params={"learning_rate": 0.1, "momentum": 0.9})
+    tr.init_params({"data": (16, 8)}, {"softmax_label": (16,)}, seed=seed)
+    return tr
+
+
+LRS = [0.1, 0.09, 0.08, 0.07]
+
+
+# ------------------------------------------------------------------ knobs
+def test_train_megastep_n_env(monkeypatch):
+    monkeypatch.delenv("MXNET_TRAIN_MEGASTEP_N", raising=False)
+    assert train_megastep_n() == 1
+    monkeypatch.setenv("MXNET_TRAIN_MEGASTEP_N", "4")
+    assert train_megastep_n() == 4
+    monkeypatch.setenv("MXNET_TRAIN_MEGASTEP_N", "junk")
+    assert train_megastep_n() == 1
+    monkeypatch.setenv("MXNET_TRAIN_MEGASTEP_N", "0")
+    assert train_megastep_n() == 1
+
+
+# ----------------------------------------------------------------- parity
+def test_step_many_bitwise_parity():
+    """The acceptance gate: one N=4 megastep must produce bitwise the
+    weights of 4 individual fused steps with the same per-step lrs."""
+    batches = _host_batches(4)
+    tr1 = _trainer()
+    for (d, l), lr in zip(batches, LRS):
+        tr1.step(d, l, lr=lr)
+    tr2 = _trainer()
+    tr2.step_many([d for d, _ in batches], [l for _, l in batches],
+                  lrs=LRS)
+    p1, _ = tr1.get_params()
+    p2, _ = tr2.get_params()
+    assert set(p1) == set(p2)
+    for k in p1:
+        assert np.array_equal(p1[k], p2[k]), \
+            "param %s not bitwise identical" % k
+
+
+def test_step_many_nan_guard_skip_parity(monkeypatch):
+    """A NaN-poisoned batch inside the scan must where-select the old
+    state exactly like the unfused skip: same skip count, bitwise
+    weights."""
+    monkeypatch.setenv("MXNET_ANOMALY_GUARD", "skip")
+    batches = _host_batches(4, nan_step=2)
+    tr1 = _trainer()
+    for (d, l), lr in zip(batches, LRS):
+        tr1.step(d, l, lr=lr)
+    tr2 = _trainer()
+    tr2.step_many([d for d, _ in batches], [l for _, l in batches],
+                  lrs=LRS)
+    assert tr1.skipped_steps == 1
+    assert tr2.skipped_steps == 1
+    p1, _ = tr1.get_params()
+    p2, _ = tr2.get_params()
+    for k in p1:
+        assert np.array_equal(p1[k], p2[k]), \
+            "param %s diverged across the skipped step" % k
+
+
+def test_step_many_outputs_match_per_step():
+    batches = _host_batches(2)
+    tr1 = _trainer()
+    want = [tr1.step(d, l, lr=0.1) for d, l in batches]
+    tr2 = _trainer()
+    got = tr2.step_many([d for d, _ in batches], [l for _, l in batches],
+                        lrs=[0.1, 0.1])
+    for w, g in zip(want, got):
+        for a, b in zip(w, g):
+            np.testing.assert_array_equal(np.asarray(a), np.asarray(b))
+
+
+def test_step_many_dispatch_counters(tm):
+    """8 batches at N=4: trainer.step counts 8 both ways, but dispatches
+    drop 8 -> 2 (the 4x dispatches-per-batch reduction)."""
+    tm.set_mode("counters")
+    batches = _host_batches(8)
+    tr1 = _trainer()
+    c0 = tm.counters()
+    for d, l in batches:
+        tr1.step(d, l, lr=0.1)
+    c1 = tm.counters()
+    assert c1.get("trainer.step", 0) - c0.get("trainer.step", 0) == 8
+    assert c1.get("trainer.dispatches", 0) - c0.get("trainer.dispatches", 0) == 8
+
+    tr2 = _trainer()
+    c2 = tm.counters()
+    for i in range(0, 8, 4):
+        tr2.step_many([d for d, _ in batches[i:i + 4]],
+                      [l for _, l in batches[i:i + 4]],
+                      lrs=[0.1] * 4)
+    c3 = tm.counters()
+    assert c3.get("trainer.step", 0) - c2.get("trainer.step", 0) == 8
+    assert c3.get("trainer.dispatches", 0) - c2.get("trainer.dispatches", 0) == 2
+    assert c3.get("trainer.megastep", 0) - c2.get("trainer.megastep", 0) == 2
+    assert tm.gauge("train.steps_per_dispatch").value == 4
+
+
+def test_step_many_single_degenerates_to_step():
+    tr = _trainer()
+    (d, l), = _host_batches(1)
+    outs = tr.step_many([d], [l], lrs=[0.1])
+    assert len(outs) == 1
+    assert not tr._megastep_fns  # no scan program built for N=1
+
+
+def test_step_many_empty_and_unbuilt():
+    import jax
+
+    tr = _trainer()
+    assert tr.step_many([]) == []
+    mesh = parallel.make_mesh((2,), ("data",), jax.devices()[:2])
+    tr2 = parallel.SPMDTrainer(_mlp(), mesh)
+    with pytest.raises(MXNetError):
+        tr2.step_many([b[0] for b in _host_batches(2)],
+                      [b[1] for b in _host_batches(2)])
+
+
+# ------------------------------------------------------------ module seam
+def _fit_mod(batches, megastep_n, monkeypatch, nb_metric=True):
+    monkeypatch.setenv("MXNET_MODULE_FUSED_STEP", "1")
+    if megastep_n is None:
+        monkeypatch.delenv("MXNET_TRAIN_MEGASTEP_N", raising=False)
+    else:
+        monkeypatch.setenv("MXNET_TRAIN_MEGASTEP_N", str(megastep_n))
+    mx.random.seed(7)
+    mod = mx.mod.Module(_mlp(), context=[mx.cpu(i) for i in range(4)])
+    b0 = batches[0]
+    mod.bind(data_shapes=[("data", b0.data[0].shape)],
+             label_shapes=[("softmax_label", b0.label[0].shape)])
+    mod.init_params(initializer=mx.init.Xavier(magnitude=2.0))
+    mod.init_optimizer(kvstore="local", optimizer="sgd",
+                       optimizer_params=(("learning_rate", 0.1),
+                                         ("momentum", 0.9)))
+    metric = mx.metric.Accuracy()
+    for b in batches:
+        mod.forward_backward(b)
+        mod.update()
+        mod.update_metric(metric, b.label)
+    mod.flush_pending_steps(metric)
+    args, _ = mod.get_params()
+    return ({k: v.asnumpy().copy() for k, v in args.items()},
+            metric.get(), mod)
+
+
+def _nd_batches(n, seed=0):
+    rs = np.random.RandomState(seed)
+    out = []
+    for _ in range(n):
+        x = rs.rand(16, 8).astype("float32")
+        y = rs.randint(0, 4, (16,)).astype("float32")
+        out.append(mx.io.DataBatch(data=[mx.nd.array(x)],
+                                   label=[mx.nd.array(y)]))
+    return out
+
+
+def test_module_megastep_bitwise_and_metric_parity(monkeypatch):
+    """Module-level N=4 buffering (6 batches: one full flush + a partial
+    tail flush) must match N=1 bitwise in weights AND in the metric —
+    the buffered (labels, outputs) pairs drain through update_metric."""
+    batches = _nd_batches(6)
+    p1, m1, _ = _fit_mod(batches, None, monkeypatch)
+    p4, m4, mod = _fit_mod(batches, 4, monkeypatch)
+    assert mod._spmd is not None and mod._spmd._megastep_n == 4
+    for k in p1:
+        assert np.array_equal(p1[k], p4[k]), "param %s diverged" % k
+    assert m1 == m4
+
+
+def test_module_megastep_fit_converges(monkeypatch):
+    """End-to-end fit() with the megastep on: epoch-tail flush +
+    score() both work, and the model still converges."""
+    monkeypatch.setenv("MXNET_MODULE_FUSED_STEP", "1")
+    monkeypatch.setenv("MXNET_TRAIN_MEGASTEP_N", "4")
+    rs = np.random.RandomState(0)
+    n, feat = 256, 16
+    w = rs.randn(feat, 2).astype("float32")
+    x = rs.randn(n, feat).astype("float32")
+    y = np.argmax(x @ w, axis=1).astype("float32")
+    it = mx.io.NDArrayIter(x, y, batch_size=32, shuffle=False,
+                           label_name="softmax_label")
+    mod = mx.mod.Module(_mlp(hidden=32, classes=2),
+                        context=[mx.cpu(i) for i in range(8)])
+    mod.fit(it, num_epoch=12, optimizer="sgd",
+            optimizer_params=(("learning_rate", 0.5), ("momentum", 0.9)),
+            initializer=mx.init.Xavier(magnitude=2.0),
+            eval_metric="acc", kvstore="local")
+    assert mod._spmd is not None and mod._spmd._megastep_n == 4
+    it.reset()
+    score = mod.score(it, mx.metric.Accuracy())
+    assert dict(score)["accuracy"] > 0.95
+
+
+def test_module_megastep_checkpoint_flushes(monkeypatch, tmp_path):
+    """get_params/export after a partial buffer must flush first — the
+    checkpointed weights include the buffered batches."""
+    batches = _nd_batches(2)
+    p1, _, _ = _fit_mod(batches, None, monkeypatch)
+    # N=4 with only 2 batches: nothing flushed until get_params
+    p4, _, mod = _fit_mod(batches, 4, monkeypatch)
+    assert mod._spmd._buf == []  # export drained the buffer
+    for k in p1:
+        assert np.array_equal(p1[k], p4[k]), "param %s diverged" % k

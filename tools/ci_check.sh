@@ -12,8 +12,10 @@
 # raw-vs-rewritten bit-parity subcheck (tests/nightly/rewrite_parity.py),
 # and the GL7xx dispatch-discipline gates: the zoo mesh sweep must carry
 # zero GL7xx findings while the `graphlint --dispatch` source scan must
-# keep flagging the known kv_decode host-sync sites (present-or-waived)
-# with everything outside kv_decode waived. Step 2 lints the sources with
+# keep flagging the known kv_decode host-sync sites — present AND waived
+# since the lax.scan decode megastep became the default K-amortized
+# shape, leaving only acknowledged K=1 tails — with everything outside
+# kv_decode waived. Step 2 lints the sources with
 # ruff when installed (pinned rule set: ruff.toml) and otherwise with the
 # dependency-free tools/src_lint.py fallback — always-on either way; the
 # every-source-compiles floor is additionally enforced by
@@ -37,7 +39,9 @@
 # Step 7 runs the serving engine smoke (tools/serve_bench.py --check):
 # QPS/p99 under a tiny open-loop load with zero post-warmup retraces, for
 # both the bucketed engine and the transformer KV-cache decode path
-# (docs/SERVING.md), plus the serving CHAOS smoke (--chaos): deterministic
+# including the K=8 decode-megastep leg (token-identical parity +
+# host-gap-per-token >=2x drop, docs/SERVING.md §Megasteps), plus the
+# serving CHAOS smoke (--chaos): deterministic
 # fault injection on the dispatch path + a mid-run hitless weight reload,
 # gated on zero hung futures, zero retraces, and recovery to `healthy`
 # (docs/RESILIENCE.md).
@@ -172,11 +176,14 @@ JAX_PLATFORMS=cpu python tests/nightly/rewrite_parity.py \
     || { echo "rewrite bit-parity gate FAILED"; exit 1; }
 # GL7xx dispatch-discipline source gate (docs/static_analysis.md §GL7xx):
 # the scan over the serving surface must keep FINDING the known kv_decode
-# host-sync sites (GL701 in both greedy decode loops — present-or-waived,
-# so a refactor that silently stops detecting them fails here, and a fix
-# that really removes them must update this anchor), while every
-# serve_bench/bench finding stays waived.  Exit 1 (live findings) is
-# expected — only exit 2 (unreadable target) hard-fails the scan itself.
+# host-sync sites (GL701 in both greedy decode loops — these are now the
+# acknowledged K=1 TAILS of the megastep path and carry waivers naming
+# the lax.scan megastep as the K-amortized shape, so every kv_decode
+# GL701 must be BOTH present and waived: a refactor that silently stops
+# detecting them fails here, and so does a new unwaived host sync),
+# while every serve_bench/bench finding stays waived.  Exit 1 (live
+# findings) is expected — only exit 2 (unreadable target) hard-fails the
+# scan itself.
 DISPATCH_SCAN="$(mktemp /tmp/graphlint_dispatch_ci.XXXXXX.json)"
 JAX_PLATFORMS=cpu python tools/graphlint --dispatch --format json \
     > "$DISPATCH_SCAN"
@@ -194,6 +201,14 @@ gl701 = {s["function"] for s in kv if s["code"] == "GL701"}
 need = {"KVCacheDecoder.greedy", "PagedKVDecoder.greedy"}
 assert need <= gl701, \
     "kv_decode GL701 anchors missing: %s (got %s)" % (need - gl701, gl701)
+# re-anchored for the megastep era: the megastep lax.scan is the default
+# scan-clean decode shape, so every REMAINING kv_decode host sync must be
+# a deliberately waived K=1 tail — an unwaived GL701 here is a regression
+unwaived = [(s["function"], s["line"]) for s in kv
+            if s["code"] == "GL701" and not s["waived"]]
+assert not unwaived, \
+    "unwaived kv_decode GL701 host syncs (megastep tails must carry " \
+    "waivers): %s" % unwaived
 bad = [s for s in kv
        if s["line"] <= 0 or (s["code"] == "GL701" and not s["provenance"])]
 assert not bad, "kv_decode sites without file:line provenance: %s" % bad
@@ -398,9 +413,13 @@ echo "== [7/10] serving: serve_bench smoke (docs/SERVING.md) =="
 JAX_PLATFORMS=cpu MXNET_DEFAULT_CONTEXT=cpu \
 python tools/serve_bench.py --model mlp --qps 100 --duration 1 --check \
     || { echo "serve_bench engine smoke FAILED"; exit 1; }
+# the kv-decode smoke includes the megastep leg (--megastep-k 8): K
+# tokens per dispatch through the sealed lax.scan program, gated on
+# token-identical parity with single-step greedy, zero post-warmup
+# retraces, and host_gap_per_token at K=8 <= 0.5x the K=1 baseline
 JAX_PLATFORMS=cpu MXNET_DEFAULT_CONTEXT=cpu \
 python tools/serve_bench.py --model transformer-decode --qps 16 \
-    --duration 1 --rows 2 --check \
+    --duration 1 --rows 2 --megastep-k 8 --check \
     || { echo "serve_bench kv-decode smoke FAILED"; exit 1; }
 # serving chaos smoke (docs/RESILIENCE.md): open-loop load with seeded
 # dispatch raises + delays injected (mxnet_tpu/faultinject.py) and one

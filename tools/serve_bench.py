@@ -21,7 +21,11 @@ reports
 ``--model transformer-decode`` measures the KV-cache autoregressive path
 instead: per-token decode-step latency and tokens/s over batched streams
 (prefill bucket + single-token decode executable, zero retraces across
-positions).
+positions). ``--megastep-k K`` (default 8) adds the decode-megastep
+comparison leg: the same streams decoded K tokens per dispatch through
+the ``lax.scan`` megastep program (docs/SERVING.md §Megasteps), gated
+under ``--check`` on token-identical parity with single-step greedy AND
+``host_gap_per_token`` at K ≤ 0.5× the K=1 baseline.
 
 ``--chaos`` is the serving resilience smoke (docs/RESILIENCE.md): the same
 open-loop load, but with deterministic fault injection live on the
@@ -235,8 +239,17 @@ def bench_decode(args):
     dec = KVCacheDecoder(params, max_len=S, prefill_len=16, pos_len=S,
                          batch=B, cache_dir=args.cache_dir, **cfg)
     dec.warmup()
-    c_warm = _counters()
     prompt = rs.randint(1, 256, (B, 8)).astype("float32")
+    K = max(0, int(args.megastep_k))
+    if K > 1:
+        # compile + seal the K-step megastep program BEFORE the counter
+        # snapshot, exactly like warmup() does for the per-step executables
+        # — the measured window must replay it with zero compiles
+        wl = dec.prefill(prompt)
+        wtok = np.argmax(wl, axis=-1)  # graphlint: waive GL703 -- warm leg, pre-snapshot
+        dec.decode_megastep(wtok, k=K)
+        dec.reset()
+    c_warm = _counters()
     logits = dec.prefill(prompt)
     # first token from the prompt head: prefill already pulled the logits
     tok = np.argmax(logits, axis=-1)  # graphlint: waive GL703 -- once per sequence
@@ -255,7 +268,6 @@ def bench_decode(args):
         lat.append((time.perf_counter() - t1) * 1000.0)
     elapsed = time.perf_counter() - t0
     gap_ms = gap_t.total_ms - gap0_ms
-    c_end = _counters()
     p50, p99 = _percentiles(lat)
     # comparison leg: a short window in the pre-token-head shape (full
     # logits pull + host argmax) so the report carries the measured
@@ -270,7 +282,7 @@ def bench_decode(args):
         logits = dec.decode_step(tok)      # graphlint: waive GL702 -- comparison leg
     cmp_elapsed = time.perf_counter() - t0c
     cmp_gap_ms = gap_t.total_ms - cgap0_ms
-    return {
+    res = {
         "mode": "kv_decode",
         "model": "transformer-decode",
         "streams": B,
@@ -289,11 +301,53 @@ def bench_decode(args):
             "tokens_per_s": round(B * cmp_steps / cmp_elapsed, 2),
             "host_gap_per_token": round(cmp_gap_ms / (B * cmp_steps), 6),
         },
-        "retraces_post_warmup": c_end.get("executor.retrace", 0)
-        - c_warm.get("executor.retrace", 0),
-        "compiles_post_warmup": c_end.get("executor.compile", 0)
-        - c_warm.get("executor.compile", 0),
     }
+    if K > 1:
+        # megastep leg: parity first (K-chunked greedy must be
+        # token-identical to single-step greedy), then a timed window of
+        # K-token dispatches for the ≥2x host-gap-per-token gate
+        n_par = 2 * K + 1
+        dec.reset()
+        seq = dec.greedy(prompt, n_par, k=1)
+        dec.reset()
+        mega = dec.greedy(prompt, n_par, k=K)
+        parity = bool(np.array_equal(seq, mega))
+        dec.reset()
+        logits = dec.prefill(prompt)
+        tok = np.argmax(logits, axis=-1)  # graphlint: waive GL703 -- once per sequence
+        # burn-in megastep, then as many full-K chunks as positions allow
+        chunk = dec.decode_megastep(tok, k=K)
+        tok = chunk[:, -1]
+        m_chunks = max(1, (S - prompt.shape[1] - K) // K)
+        mgap0_ms = gap_t.total_ms
+        t0m = time.perf_counter()
+        for _ in range(m_chunks):
+            # graphlint: waive GL702 -- measuring the megastep loop IS the bench
+            chunk = dec.decode_megastep(tok, k=K)
+            tok = chunk[:, -1]
+        m_elapsed = time.perf_counter() - t0m
+        m_gap_ms = gap_t.total_ms - mgap0_ms
+        m_tokens = B * m_chunks * K
+        m_gap_per_tok = round(m_gap_ms / m_tokens, 6)
+        res["megastep"] = {
+            "k": K,
+            "chunks": m_chunks,
+            "tokens_per_s": round(m_tokens / m_elapsed, 2),
+            "host_gap_per_token": m_gap_per_tok,
+            "parity_token_identical": parity,
+            "k_sweep": [
+                {"k": 1, "tokens_per_s": res["qps"],
+                 "host_gap_per_token": res["host_gap_per_token"]},
+                {"k": K, "tokens_per_s": round(m_tokens / m_elapsed, 2),
+                 "host_gap_per_token": m_gap_per_tok},
+            ],
+        }
+    c_end = _counters()
+    res["retraces_post_warmup"] = c_end.get("executor.retrace", 0) \
+        - c_warm.get("executor.retrace", 0)
+    res["compiles_post_warmup"] = c_end.get("executor.compile", 0) \
+        - c_warm.get("executor.compile", 0)
+    return res
 
 
 def bench_chaos(args):
@@ -766,12 +820,24 @@ def _check(res, trace_families):
         _fail("post-warmup compiles: %d" % res["compiles_post_warmup"])
     need = {"serving.dispatch"} if res["mode"] == "engine" \
         else {"serving.decode_step", "serving.prefill"}
+    ms = res.get("megastep")
+    if ms is not None:
+        need.add("serving.decode_megastep")
     missing = need - trace_families
     if missing:
         _fail("missing serving.* trace families: %s" % sorted(missing))
     if res["mode"] == "kv_decode" and not res.get("host_gap_per_token"):
         _fail("host_gap_per_token missing or zero — the dispatch.host_gap "
               "timer never ticked on the decode path")
+    if ms is not None:
+        if not ms.get("parity_token_identical"):
+            _fail("megastep K=%d greedy diverged from single-step decode"
+                  % ms["k"])
+        base = res.get("host_gap_per_token") or 0.0
+        if not base or ms["host_gap_per_token"] > 0.5 * base:
+            _fail("megastep host_gap_per_token %.6f ms not <= 0.5x the "
+                  "K=1 baseline %.6f ms"
+                  % (ms["host_gap_per_token"], base))
     if res.get("batching_speedup") is not None \
             and res["batching_speedup"] < 2.0:
         _fail("continuous batching speedup %.2fx < 2x over batch-size-1"
@@ -795,6 +861,10 @@ def main(argv=None):
                          "(default: MXNET_SERVE_CACHE_DIR)")
     ap.add_argument("--compare-batch1", action="store_true",
                     help="also measure saturation QPS vs a batch-1 engine")
+    ap.add_argument("--megastep-k", type=int, default=8,
+                    help="transformer-decode: K tokens per dispatch for "
+                         "the megastep comparison leg (MXNET_DECODE_"
+                         "MEGASTEP_K); 0 or 1 disables the leg")
     ap.add_argument("--quant", default=None, choices=[None, "off", "bf16",
                                                       "int8"],
                     help="sets MXNET_SERVE_QUANT for the run")
