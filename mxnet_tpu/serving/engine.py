@@ -163,7 +163,8 @@ class ServeFuture:
 
 
 class _Request:
-    __slots__ = ("inputs", "rows", "future", "t_enq", "deadline", "retries")
+    __slots__ = ("inputs", "rows", "future", "t_enq", "deadline", "retries",
+                 "trace_id")
 
     def __init__(self, inputs, rows, engine=None, deadline=None):
         self.inputs = inputs
@@ -172,6 +173,10 @@ class _Request:
         self.t_enq = time.perf_counter()
         self.deadline = deadline  # absolute perf_counter time, or None
         self.retries = 0
+        # captured at submit time on the CALLER's thread (the batcher
+        # runs elsewhere): lets dispatch/queue-wait spans join the
+        # fleet-wide request trace (docs/OBSERVABILITY.md §Fleet)
+        self.trace_id = _tm.trace_context()
 
 
 class _ReloadRequest:
@@ -694,14 +699,30 @@ class InferenceEngine:
             qw = _tm.timer("serving.queue_wait")
             for r in batch:
                 qw.add(t0 - r.t_enq)
+                # per-request queue-wait span on the request's own trace
+                # (no-op unless tracing): the fleet timeline's
+                # replica-queue segment
+                _tm.record_span("serving.queue_wait", r.t_enq,
+                                t0 - r.t_enq, trace_id=r.trace_id)
             # dispatch.host_gap: batching/padding/queue host time between
             # the previous batch's return and this enqueue
             if self._last_return_t is not None:
                 gap = time.perf_counter() - self._last_return_t
                 _tm.timer("dispatch.host_gap").add(gap)
                 _tm.timer("dispatch.host_gap.serving.dispatch").add(gap)
-        with _tm.span("serving.dispatch", model=self.name, bucket=bucket,
-                      rows=rows, requests=len(batch)):
+        # a batch serves many requests, possibly many traces: one unique
+        # trace_id → install it as context (nested decoder spans inherit);
+        # a mixed batch stamps the id LIST on the dispatch span instead
+        tids = {r.trace_id for r in batch if r.trace_id is not None}
+        span_kw = dict(model=self.name, bucket=bucket, rows=rows,
+                       requests=len(batch))
+        batch_tid = None
+        if len(tids) == 1:
+            batch_tid = next(iter(tids))
+        elif tids:
+            span_kw["trace_ids"] = sorted(tids)
+        with _tm.trace_scope(batch_tid), \
+                _tm.span("serving.dispatch", **span_kw):
             _fi.fire("serving.dispatch")
             outs = self.cache.run(padded)
         if _tm.enabled():
@@ -713,11 +734,17 @@ class InferenceEngine:
         per_row = self._row_factors
         off = 0
         overruns = 0
+        req_timer = _tm.timer("serving.request") if _tm.enabled() else None
         for r in batch:
             res = []
             for o, k in zip(outs, per_row):
                 res.append(o if k is None else o[off * k:(off + r.rows) * k])
             r.future.set_result(res)
+            if req_timer is not None:
+                # submit → delivery: the engine-side view of the same
+                # latency clients measure, so serve_bench can cross-check
+                # histogram quantiles against client-side percentiles
+                req_timer.add(r.future.done_at - r.t_enq)
             if r.deadline is not None and r.future.done_at > r.deadline:
                 overruns += 1  # delivered, but past its budget
             off += r.rows

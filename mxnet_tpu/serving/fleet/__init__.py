@@ -23,6 +23,14 @@ Chaos is a first-class input: ``fleet.dispatch`` / ``fleet.health`` /
 ``fleet.replica_spawn`` are deterministic fault-injection sites
 (mxnet_tpu/faultinject.py), and ``supervisor.kill_replica()`` is the
 kill-one chaos vector ``serve_bench --fleet`` drives in CI.
+
+Observability (docs/OBSERVABILITY.md §Fleet): the router mints a
+``trace_id`` per request that RPC frames propagate into replica spans,
+``Router.collect_fleet_trace()`` merges per-process chrome dumps onto
+one clock-aligned timeline, ``Router.metrics()`` folds the replicas'
+delta-encoded telemetry snapshots into fleet rollups (qps, shed rate,
+merged latency histograms), and ``MXNET_SLO`` arms a burn-rate monitor
+with structured violation events.
 """
 from __future__ import annotations
 
@@ -103,6 +111,15 @@ class Fleet:
             # already-dead slot still respawns onto the rewritten file
             self.supervisor.kill_replica(rid)
         return {"applied": sorted(applied), "recycled": recycled}
+
+    def metrics(self):
+        """Fleet rollups (``Router.metrics()``)."""
+        return self.router.metrics()
+
+    def collect_fleet_trace(self):
+        """Merged, clock-aligned fleet chrome trace
+        (``Router.collect_fleet_trace()``)."""
+        return self.router.collect_fleet_trace()
 
     def close(self):
         self.router.close()

@@ -12,7 +12,11 @@ heartbeat file touched on a timer (the PR 7 ps-lite idiom: mtime IS the
 signal; a wedged process stops touching it even though the PID exists).
 
 RPC surface: ``ping`` / ``infer`` / ``health`` / ``reload`` /
-``rollback`` / ``stop``. ``reload`` snapshots the prior values of every
+``rollback`` / ``stop`` / ``dump_trace``. ``health`` additionally ships
+a delta-encoded telemetry snapshot (counter + histogram-bucket
+increments keyed to the engine seq) the router folds into fleet
+rollups; ``dump_trace`` returns this process's chrome-trace dict for
+``telemetry.merge_traces``. ``reload`` snapshots the prior values of every
 key it is about to swap before applying the engine's hitless
 ``reload()`` — ``rollback`` restores that snapshot, which is what lets
 the router abort a fleet-wide rollout and leave the OLD weights live
@@ -28,6 +32,7 @@ import threading
 
 import numpy as np
 
+from ... import telemetry
 from ...base import MXNetError
 from .rpc import RpcServer
 
@@ -88,6 +93,12 @@ class ReplicaApp:
         self._hb_thread = None
         self._rollback_args = None
         self._rollback_aux = None
+        # delta-encoding state for the health() telemetry snapshot: the
+        # counter values / histogram buckets already shipped, so each
+        # snapshot carries only the increment since the last one
+        self._tel_lock = threading.Lock()
+        self._tel_last_counters = {}
+        self._tel_last_buckets = {}
 
     # ------------------------------------------------------------- assembly
     def _build_engine(self):
@@ -119,11 +130,52 @@ class ReplicaApp:
         fut = self.engine.submit(inputs, deadline_ms=deadline_ms)
         return fut.result(timeout=timeout_s)
 
+    def _telemetry_snapshot(self):
+        """Compact telemetry increment for health(): counter deltas and
+        sparse histogram-bucket deltas since the LAST snapshot shipped.
+
+        Delta encoding leans on the router's staleness contract: every
+        ``health()`` bumps the engine seq, and ``_accept_snapshot``
+        accepts a given seq at most once — so an accepted delta folds
+        into the fleet rollup exactly once. A poll whose response is
+        lost (or rejected as stale) drops that window's increments: the
+        rollup skews low by one poll interval and self-heals on the
+        next accepted snapshot — bounded, and the right trade against
+        shipping full monotonic state every 100 ms."""
+        if not telemetry.enabled():
+            return None
+        counters = telemetry.counters()
+        buckets = telemetry.hist_buckets()
+        with self._tel_lock:
+            dc = {k: v - self._tel_last_counters.get(k, 0)
+                  for k, v in counters.items()
+                  if v - self._tel_last_counters.get(k, 0)}
+            db = {}
+            for name, b in buckets.items():
+                prev = self._tel_last_buckets.get(name, {})
+                d = {k: v - prev.get(k, 0) for k, v in b.items()
+                     if v - prev.get(k, 0) > 0}
+                if d:
+                    db[name] = d
+            self._tel_last_counters = counters
+            self._tel_last_buckets = buckets
+        return {"counters": dc, "hist": db,
+                "dropped": telemetry.dropped_events()}
+
     def _h_health(self):
         h = self.engine.health()
         h["pid"] = os.getpid()
         h["replica_id"] = self.replica_id
+        tel = self._telemetry_snapshot()
+        if tel is not None:
+            h["telemetry"] = tel
         return h
+
+    def _h_dump_trace(self):
+        """The replica's chrome-trace dict (router/serve_bench fetches
+        one per replica and ``merge_traces`` aligns them)."""
+        return telemetry.build_trace(
+            extra={"label": "replica-%s" % self.replica_id})
 
     def _h_reload(self, arg_params, aux_params=None, timeout_s=60.0):
         # snapshot the PRIOR value of every key about to be swapped — the
@@ -162,6 +214,11 @@ class ReplicaApp:
             self._stop.wait(interval)
 
     def start(self):
+        # replica subprocesses do not inherit the parent's in-process
+        # set_mode(): the spec carries the telemetry mode the fleet runs
+        # under (serve_bench --check sets "trace")
+        if self.spec.get("telemetry"):
+            telemetry.set_mode(self.spec["telemetry"])
         self._build_engine()
         self.server = RpcServer({
             "ping": self._h_ping,
@@ -170,6 +227,7 @@ class ReplicaApp:
             "reload": self._h_reload,
             "rollback": self._h_rollback,
             "stop": self._h_stop,
+            "dump_trace": self._h_dump_trace,
         }).start()
         if self.spec.get("heartbeat_path"):
             self._hb_thread = threading.Thread(

@@ -43,13 +43,17 @@ weights — old weights stay live everywhere.
 from __future__ import annotations
 
 import logging
+import os
 import threading
 import time
+import uuid
 from collections import deque
 from concurrent.futures import ThreadPoolExecutor, wait as _fut_wait
 
 from ...base import MXNetError
 from ... import telemetry as _tm
+from ...telemetry import histogram as _hg
+from ...telemetry.slo import SloMonitor, SloSpec
 from ... import faultinject as _fi
 from ..engine import (ServeFuture, ServeOverloadError, ServeDeadlineError,
                       ServeClosedError, _env_float, _env_int)
@@ -91,9 +95,10 @@ class _View:
 
 class _FleetRequest:
     __slots__ = ("inputs", "future", "t_enq", "deadline", "deadline_ms",
-                 "tried", "redispatches")
+                 "tried", "redispatches", "trace_id")
 
-    def __init__(self, inputs, deadline=None, deadline_ms=None):
+    def __init__(self, inputs, deadline=None, deadline_ms=None,
+                 trace_id=None):
         self.inputs = inputs
         self.future = ServeFuture()
         self.t_enq = time.perf_counter()
@@ -101,6 +106,7 @@ class _FleetRequest:
         self.deadline_ms = deadline_ms    # forwarded to the replica engine
         self.tried = set()
         self.redispatches = 0
+        self.trace_id = trace_id          # router-minted request trace id
 
 
 class Router:
@@ -117,7 +123,8 @@ class Router:
     def __init__(self, provider, workers=None, max_queue=None,
                  health_interval_ms=None, stale_ms=None, shed_ms=None,
                  max_redispatch=None, rpc_timeout_ms=None,
-                 dispatch_wait_ms=None, deadline_ms=None, name="fleet"):
+                 dispatch_wait_ms=None, deadline_ms=None, name="fleet",
+                 slo=None):
         self.provider = provider
         self.name = name
         self.workers = (_env_int("MXNET_FLEET_WORKERS", 8)
@@ -164,12 +171,35 @@ class Router:
         self._counts = {"submitted": 0, "completed": 0, "shed": 0,
                         "redispatched": 0, "failed": 0}
         self._rollout_lock = threading.Lock()
+        # ---- fleet observability plane (docs/OBSERVABILITY.md §Fleet)
+        self._t_start = None
+        # router's own request-latency histogram, recorded regardless of
+        # telemetry mode so SLO latency objectives and the metrics()
+        # rollup always have truth (one bucket increment per delivery)
+        self._req_hist = _hg.Histogram()
+        self._tel_lock = threading.Lock()
+        self._fleet_counters = {}      # folded replica counter deltas
+        self._fleet_hists = {}         # timer -> merged sparse buckets
+        self._replica_tel = {}         # rid -> {"counters", "dropped"}
+        self._per_replica_done = {}    # rid -> deliveries via this router
+        self._clock_offsets = {}       # rid -> (offset_s, remote_pid)
+        # SLO gate: explicit spec (SloSpec | spec string) wins; else the
+        # MXNET_SLO env; else no monitor
+        if slo is not None and not isinstance(slo, SloSpec):
+            slo = SloSpec.parse(slo)
+        self._slo_spec = slo if slo is not None else SloSpec.from_env()
+        self._slo_monitor = (SloMonitor(self._slo_spec)
+                             if self._slo_spec is not None else None)
+        self._slo_last = {"completed": 0, "failed": 0, "buckets": {}}
+        self._slo_status = None
 
     # ------------------------------------------------------------ lifecycle
     def start(self):
         if self._started:
             return self
         self._stop = False
+        if self._t_start is None:
+            self._t_start = time.perf_counter()
         self._poll_pool = ThreadPoolExecutor(
             max_workers=8, thread_name_prefix="%s-health" % self.name)
         self._poll_once(wait_s=5.0)  # seed views before accepting traffic
@@ -304,7 +334,8 @@ class Router:
             _fi.fire("fleet.health")
             # RPC timeout well under the rpc default: a slow replica's
             # snapshot just ages out, it must not tie up a poll slot
-            h = self._call(self._client(v), "health",
+            cli = self._client(v)
+            h = self._call(cli, "health",
                            rpc_timeout_s=min(5.0, max(0.5, self.stale_s)))
         except Exception:
             if _tm.enabled():
@@ -312,24 +343,76 @@ class Router:
             with self._cond:
                 self._poll_pending.discard(v.rid)
             return  # view ages out; staleness does the skipping
+        if isinstance(cli, RpcClient) and cli.clock_offset_s is not None:
+            with self._tel_lock:
+                self._clock_offsets[v.rid] = (cli.clock_offset_s,
+                                              cli.remote_pid)
         now = time.perf_counter()
         with self._cond:
             self._poll_pending.discard(v.rid)
-            if self._views.get(v.rid) is v and \
-                    self._accept_snapshot(v, h, now):
+            accepted = (self._views.get(v.rid) is v
+                        and self._accept_snapshot(v, h, now))
+            if accepted:
                 if _tm.enabled():
                     _tm.gauge("fleet.replica.%s.queue_wait_ms"
                               % v.rid).set(
                         h.get("ewma_queue_wait_ms") or 0.0)
                 self._cond.notify_all()
+        if accepted and h.get("telemetry"):
+            self._fold_telemetry(v.rid, h["telemetry"])
+
+    def _fold_telemetry(self, rid, tel):
+        """Fold one ACCEPTED delta-encoded replica snapshot into the
+        fleet rollups. The staleness contract guarantees each snapshot
+        folds at most once (every health() gets a fresh seq; replays are
+        discarded before reaching here), so counters stay exact and
+        histogram merges stay associative."""
+        with self._tel_lock:
+            for k, dv in (tel.get("counters") or {}).items():
+                if isinstance(dv, (int, float)):
+                    self._fleet_counters[k] = \
+                        self._fleet_counters.get(k, 0) + dv
+            for name, db in (tel.get("hist") or {}).items():
+                self._fleet_hists[name] = _hg.merge_bucket_maps(
+                    self._fleet_hists.get(name), db)
+            per = self._replica_tel.setdefault(
+                rid, {"counters": {}, "dropped": 0})
+            for k, dv in (tel.get("counters") or {}).items():
+                if isinstance(dv, (int, float)):
+                    per["counters"][k] = per["counters"].get(k, 0) + dv
+            per["dropped"] = tel.get("dropped", per["dropped"])
 
     def _poll_loop(self):
         while not self._stop:
             t0 = time.perf_counter()
             self._poll_once()
+            if self._slo_monitor is not None:
+                self._slo_tick()
             delay = self.health_interval_s - (time.perf_counter() - t0)
             if delay > 0:
                 time.sleep(delay)
+
+    def _slo_tick(self):
+        """One SLO sample per poll round: request/error deltas since the
+        last tick, the request-latency bucket delta, and an availability
+        sample (any eligible replica?). Sheds are admission control, not
+        server errors — they hit availability/throughput, not err_pct."""
+        now = time.perf_counter()
+        with self._cond:
+            completed = self._counts["completed"]
+            failed = self._counts["failed"]
+            avail = 1.0 if self._eligible_locked(now) else 0.0
+        buckets = self._req_hist.to_dict()["buckets"]
+        last = self._slo_last
+        d_done = completed - last["completed"]
+        d_fail = failed - last["failed"]
+        db = {k: v - last["buckets"].get(k, 0) for k, v in buckets.items()
+              if v - last["buckets"].get(k, 0) > 0}
+        self._slo_last = {"completed": completed, "failed": failed,
+                          "buckets": buckets}
+        self._slo_monitor.observe(total=d_done + d_fail, errors=d_fail,
+                                  latency_buckets=db, available=avail)
+        self._slo_status = self._slo_monitor.evaluate()
 
     def _invalidate(self, rid):
         """Mark a replica suspect after a transport fault: its view goes
@@ -426,7 +509,12 @@ class Router:
             req = _FleetRequest(
                 inputs,
                 deadline=None if dl_s is None else now + dl_s,
-                deadline_ms=deadline_ms)
+                deadline_ms=deadline_ms,
+                # trace_id minted at admission (trace mode only): every
+                # span this request touches — router dispatch, RPC frame,
+                # replica engine/decoder — inherits it
+                trace_id=(uuid.uuid4().hex[:16] if _tm.tracing()
+                          else None))
             self._queue.append(req)
             self._counts["submitted"] += 1
             depth = len(self._queue)
@@ -504,7 +592,13 @@ class Router:
                 if req.deadline is not None:
                     timeout_s = min(timeout_s,
                                     max(0.05, req.deadline - now) + 5.0)
-                with _tm.span("fleet.dispatch", replica=rid):
+                # the time this request sat in the ROUTER queue, as a
+                # trace span (start was observed on the submit thread)
+                _tm.record_span("fleet.queue_wait", req.t_enq,
+                                now - req.t_enq, trace_id=req.trace_id,
+                                replica=rid)
+                with _tm.trace_scope(req.trace_id), \
+                        _tm.span("fleet.dispatch", replica=rid):
                     _fi.fire("fleet.dispatch")
                     # timeout_s is the REPLICA-side result wait; the
                     # socket bound sits strictly above it so the remote
@@ -568,13 +662,17 @@ class Router:
             # books BEFORE the future resolves: a client that wakes on
             # set_result and immediately reads health() must already see
             # this delivery counted
+            dur = time.perf_counter() - req.t_enq
+            self._req_hist.record(dur)
             with self._cond:
                 self._counts["completed"] += 1
+            with self._tel_lock:
+                self._per_replica_done[rid] = \
+                    self._per_replica_done.get(rid, 0) + 1
             req.future.set_result(outs)
             if _tm.enabled():
                 _tm.counter("fleet.dispatches").inc()
-                _tm.timer("fleet.request").add(
-                    time.perf_counter() - req.t_enq)
+                _tm.timer("fleet.request").add(dur)
             return
 
     def _count_fail(self):
@@ -694,6 +792,126 @@ class Router:
                     return False
                 self._cond.wait(min(remaining, 0.2))
         return True
+
+    # ------------------------------------------------------------- metrics
+    def metrics(self):
+        """Fleet-wide observability rollup (docs/OBSERVABILITY.md §Fleet):
+        router books (qps, shed rate, redispatches), replica telemetry
+        folded from the delta-encoded health() snapshots (counters +
+        merged latency histograms with p50/p95/p99), per-replica rows
+        with measured clock offsets, and — when an SLO spec is live —
+        the burn-rate status and structured violation log. JSON-safe;
+        ``serve_bench --fleet`` stamps it into the trace dump's
+        ``otherData.fleet`` for ``mxtrace --fleet``."""
+        now = time.perf_counter()
+        with self._cond:
+            counts = dict(self._counts)
+            views = dict(self._views)
+            fresh = [rid for rid, v in views.items()
+                     if v.health is not None
+                     and now - v.received_t <= self.stale_s]
+        elapsed = max(1e-9, now - (self._t_start or now))
+        with self._tel_lock:
+            fleet_counters = dict(self._fleet_counters)
+            fleet_hists = {k: dict(v)
+                           for k, v in self._fleet_hists.items()}
+            per_tel = {rid: {"counters": dict(d["counters"]),
+                             "dropped": d.get("dropped", 0)}
+                       for rid, d in self._replica_tel.items()}
+            per_done = dict(self._per_replica_done)
+            offsets = dict(self._clock_offsets)
+        # the router's own request-latency histogram IS the fleet view of
+        # submit→delivery (it brackets queue + rpc + replica service)
+        fleet_hists["fleet.request"] = _hg.merge_bucket_maps(
+            fleet_hists.get("fleet.request"),
+            self._req_hist.to_dict()["buckets"])
+        latency = {}
+        for name, b in sorted(fleet_hists.items()):
+            if not b:
+                continue
+            q = _hg.quantiles_from_buckets(b)
+            latency[name] = {"count": sum(b.values()),
+                             "p50": round(q.get("p50", 0.0), 3),
+                             "p95": round(q.get("p95", 0.0), 3),
+                             "p99": round(q.get("p99", 0.0), 3)}
+        tokens = fleet_counters.get("serving.decode_tokens", 0)
+        dispatches = (fleet_counters.get("serving.megasteps", 0)
+                      or fleet_counters.get("serving.dispatches", 0))
+        replicas = {}
+        for rid, v in sorted(views.items()):
+            off = offsets.get(rid)
+            done = per_done.get(rid, 0)
+            replicas[str(rid)] = {
+                "state": (v.health or {}).get("state", "unknown"),
+                "requests": done, "qps": round(done / elapsed, 3),
+                "clock_offset_ms": round(
+                    (off[0] if off else 0.0) * 1000.0, 3),
+                "dropped": per_tel.get(rid, {}).get("dropped", 0)}
+        attempts = counts["submitted"] + counts["shed"]
+        out = {"qps": round(counts["completed"] / elapsed, 3),
+               "requests": counts["completed"],
+               "errors": counts["failed"],
+               "shed": counts["shed"],
+               "shed_rate": round(counts["shed"] / attempts, 4)
+               if attempts else 0.0,
+               "redispatches": counts["redispatched"],
+               "submitted": counts["submitted"],
+               "replicas_fresh": len(fresh),
+               "tokens_per_dispatch": round(tokens / dispatches, 3)
+               if tokens and dispatches else None,
+               "elapsed_s": round(elapsed, 3),
+               "latency_ms": latency,
+               "counters": fleet_counters,
+               "replicas": replicas,
+               "dropped_events": (_tm.dropped_events()
+                                  + sum(d.get("dropped", 0)
+                                        for d in per_tel.values()))}
+        if self._slo_monitor is not None:
+            out["slo"] = self._slo_status or self._slo_monitor.evaluate()
+            out["violations"] = self._slo_monitor.violations()
+        return out
+
+    def slo_violations(self):
+        """Structured slo.violation/slo.clear events, oldest first
+        (empty without an SLO spec)."""
+        return ([] if self._slo_monitor is None
+                else self._slo_monitor.violations())
+
+    def collect_fleet_trace(self):
+        """ONE merged fleet chrome trace: the router's own dump plus each
+        reachable replica's (``dump_trace`` RPC), re-pidded and aligned
+        onto the router's wall clock via the per-connection midpoint
+        offsets. ``otherData.fleet`` carries ``metrics()``; unreachable
+        replicas are skipped with a log line (their spans simply don't
+        appear — the trace stays honest about ``dropped``)."""
+        with self._cond:
+            views = list(self._views.values())
+        with self._tel_lock:
+            off_by_rid = dict(self._clock_offsets)
+        dumps = [_tm.build_trace(extra={"label": "router"})]
+        labels = {os.getpid(): "router"}
+        offsets = {}
+        for v in views:
+            try:
+                d = self._call(self._client(v), "dump_trace",
+                               rpc_timeout_s=10.0)
+            except Exception as exc:
+                log.warning("fleet: dump_trace from replica %s failed: "
+                            "%s", v.rid, exc)
+                continue
+            if not isinstance(d, dict):
+                continue
+            pid = (d.get("otherData") or {}).get("pid")
+            off = off_by_rid.get(v.rid)
+            if pid is not None:
+                labels[pid] = "replica-%s" % v.rid
+                if off is not None:
+                    offsets[pid] = off[0]
+            dumps.append(d)
+        merged = _tm.merge_traces(dumps, offsets_s=offsets,
+                                  labels=labels)
+        merged["otherData"]["fleet"] = self.metrics()
+        return merged
 
     # -------------------------------------------------------------- health
     def health(self):

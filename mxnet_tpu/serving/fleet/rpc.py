@@ -19,14 +19,27 @@ and RE-DISPATCH the in-flight request elsewhere. A request is therefore
 never lost to a replica death; at-most-once execution is NOT promised
 (inference is idempotent, so replay is safe), which is exactly the
 trade the re-dispatch path wants.
+
+Observability plane (docs/OBSERVABILITY.md §Fleet): every ``call()``
+carries the caller's trace context in a ``trace`` field on the request
+frame; the server installs it thread-local around the handler so replica
+spans inherit the router-minted ``trace_id`` with no per-handler
+plumbing. Each connection also measures the peer's wall-clock offset on
+connect (and again after every reconnect) with the midpoint method —
+``offset = server_wall - (send + recv) / 2``, median over a few round
+trips — which ``telemetry.merge_traces`` uses to align per-process
+chrome dumps onto one timeline.
 """
 from __future__ import annotations
 
+import os
 import pickle
 import socket
 import struct
 import threading
+import time
 
+from ... import telemetry
 from ...base import MXNetError
 
 __all__ = ["RpcServer", "RpcClient", "RpcError", "RpcConnectionError",
@@ -80,13 +93,59 @@ class RpcClient:
     concurrent requests to one replica pipeline through separate
     connections). Reconnects lazily after any failure."""
 
-    def __init__(self, addr, timeout_s=30.0, connect_timeout_s=2.0):
+    def __init__(self, addr, timeout_s=30.0, connect_timeout_s=2.0,
+                 clock_samples=3):
         host, port = addr.rsplit(":", 1)
         self.addr = addr
         self._host, self._port = host, int(port)
         self.timeout_s = float(timeout_s)
         self.connect_timeout_s = float(connect_timeout_s)
         self._sock = None
+        # midpoint clock-offset handshake: seconds to ADD to the peer's
+        # wall clock to land on ours; None until a connection measured it
+        # (re-measured on every reconnect, so drift across a replica
+        # restart is picked up)
+        self.clock_offset_s = None
+        self.remote_pid = None
+        self._clock_samples = int(clock_samples)
+
+    def _measure_clock(self, s):
+        """Median midpoint offset over a few __clock__ round trips.
+
+        A server without the builtin answers with a clean unknown-method
+        error frame (stream stays in sync) — the offset just stays
+        unknown. A TRANSPORT failure mid-handshake leaves the stream
+        desynchronized, so it escalates to ``RpcConnectionError`` like
+        any other call-path failure."""
+        offsets = []
+        try:
+            s.settimeout(self.connect_timeout_s)
+            for _ in range(max(1, self._clock_samples)):
+                t0 = time.time()
+                _send_msg(s, {"method": "__clock__", "kw": {}})
+                resp = _recv_msg(s)
+                t1 = time.time()
+                if not (isinstance(resp, dict) and resp.get("ok")):
+                    return
+                r = resp.get("result") or {}
+                self.remote_pid = r.get("pid", self.remote_pid)
+                offsets.append((t0 + t1) / 2.0 - r.get("wall", t0))
+        except RpcError:
+            try:
+                s.close()
+            except OSError:
+                pass
+            raise
+        except (OSError, EOFError, pickle.UnpicklingError) as exc:
+            try:
+                s.close()
+            except OSError:
+                pass
+            raise RpcConnectionError(
+                "fleet.rpc: clock handshake with %s failed (%s: %s)"
+                % (self.addr, type(exc).__name__, exc)) from exc
+        offsets.sort()
+        self.clock_offset_s = offsets[len(offsets) // 2]
 
     def _ensure(self):
         if self._sock is not None:
@@ -99,6 +158,7 @@ class RpcClient:
                 "fleet.rpc: cannot connect to %s (%s)"
                 % (self.addr, exc)) from exc
         s.setsockopt(socket.IPPROTO_TCP, socket.TCP_NODELAY, 1)
+        self._measure_clock(s)
         self._sock = s
         return s
 
@@ -115,9 +175,15 @@ class RpcClient:
         sock = self._ensure()
         sock.settimeout(self.timeout_s if rpc_timeout_s is None
                         else float(rpc_timeout_s))
+        req = {"method": method, "kw": kw}
+        trace_id = telemetry.trace_context()
+        if trace_id is not None:
+            req["trace"] = {"id": trace_id}
         try:
-            _send_msg(sock, {"method": method, "kw": kw})
-            resp = _recv_msg(sock)
+            with telemetry.span("fleet.rpc", method=method,
+                                addr=self.addr):
+                _send_msg(sock, req)
+                resp = _recv_msg(sock)
         except RpcError:
             self.close()  # incl. frame-cap: the stream is mid-payload
             raise
@@ -150,6 +216,10 @@ class RpcServer:
 
     def __init__(self, handlers, host="127.0.0.1", port=0):
         self._handlers = dict(handlers)
+        # clock-offset handshake builtin (RpcClient._measure_clock): the
+        # peer's view of this process's wall clock + identity
+        self._handlers.setdefault(
+            "__clock__", lambda: {"wall": time.time(), "pid": os.getpid()})
         self._sock = socket.socket(socket.AF_INET, socket.SOCK_STREAM)
         self._sock.setsockopt(socket.SOL_SOCKET, socket.SO_REUSEADDR, 1)
         self._sock.bind((host, int(port)))
@@ -195,13 +265,19 @@ class RpcServer:
                     return  # peer hung up / garbage: drop the connection
                 method = req.get("method")
                 fn = self._handlers.get(method)
+                trace_id = (req.get("trace") or {}).get("id")
                 if fn is None:
                     resp = {"ok": False,
                             "error": MXNetError(
                                 "fleet.rpc: unknown method %r" % method)}
                 else:
                     try:
-                        resp = {"ok": True, "result": fn(**req.get("kw", {}))}
+                        # install the caller's trace context around the
+                        # handler: spans recorded on this thread inherit
+                        # the router-minted trace_id
+                        with telemetry.trace_scope(trace_id):
+                            resp = {"ok": True,
+                                    "result": fn(**req.get("kw", {}))}
                     except BaseException as exc:  # noqa: BLE001 — every
                         # handler failure must cross back as a response,
                         # or the caller's recv would hang

@@ -503,9 +503,12 @@ class KVCacheDecoder:
         position. The ring KV update happens in-graph; host-side this is
         arg/output pointer swaps only."""
         exe, p = self._stage_step(tokens)
+        t0 = time.perf_counter()
         with _tm.span("serving.decode_step", rows=self.batch, pos=p):
             exe.forward(is_train=False)
             logits = exe.outputs[0].asnumpy()
+        if _tm.enabled():
+            _tm.timer("serving.decode_step").add(time.perf_counter() - t0)
         _gap_return(self)
         self._finish_step(exe)
         return logits
@@ -522,11 +525,14 @@ class KVCacheDecoder:
                 # graphlint: waive GL703 -- fallback for stale token-less programs
                 return np.argmax(self.decode_step(tokens), axis=-1)
         exe, p = self._stage_step(tokens)
+        t0 = time.perf_counter()
         with _tm.span("serving.decode_step", rows=self.batch, pos=p,
                       greedy=True):
             exe.forward(is_train=False)
             # graphlint: waive GL701 -- single-step tail of the megastep loop; the K-amortized body is the lax.scan in decode_megastep
             nxt = exe.outputs[-1].asnumpy()
+        if _tm.enabled():
+            _tm.timer("serving.decode_step").add(time.perf_counter() - t0)
         _gap_return(self)
         self._finish_step(exe)
         return nxt.astype(np.int64)
@@ -567,11 +573,15 @@ class KVCacheDecoder:
         done0 = np.zeros((B,), bool)
         eos = np.int32(-1 if eos_id is None else int(eos_id))
         _gap_mark(self, "serving.decode_megastep")
+        t0 = time.perf_counter()
         with _tm.span("serving.decode_megastep", rows=B, pos=p, k=k):
             toks, acts, new_kvs, _done = ms.run(
                 self, tok0, posv, slots, base_mask, done0, eos)
             ids = np.asarray(toks)       # (K, B): the only host pull
             acts_h = np.asarray(acts)
+        if _tm.enabled():
+            _tm.timer("serving.decode_megastep").add(
+                time.perf_counter() - t0)
         _gap_return(self)
         for name, arr in zip(ms.kv_names, new_kvs):
             self._dec_exe.arg_dict[name]._set_jax(arr)

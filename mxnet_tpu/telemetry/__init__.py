@@ -10,29 +10,47 @@ capture directory. Gated by ``MXNET_TELEMETRY=0|counters|trace``
 (docs/ENV_VARS.md); off is the default and costs one mode check per
 instrumented seam. Taxonomy and usage: docs/OBSERVABILITY.md.
 
+Fleet plane (docs/OBSERVABILITY.md §Fleet): every Timer streams into a
+log-bucketed mergeable :mod:`histogram` (p50/p95/p99 with fixed memory),
+spans inherit a per-request trace context that the fleet RPC layer
+propagates across processes, ``merge_traces`` aligns per-pid chrome
+dumps into one clock-corrected timeline, and :mod:`slo` evaluates
+declarative SLOs (``MXNET_SLO``) with multi-window burn rates.
+
     MXNET_TELEMETRY=trace python train.py
     python tools/mxtrace profile.json          # per-step table + top spans
 """
 from __future__ import annotations
 
+from . import histogram, slo
+from .histogram import Histogram
 from .registry import (Counter, Gauge, StepStats, Timer, counter, counters,
-                       gauge, mark_step, reset, snapshot, step_rows, timer)
+                       gauge, hist_buckets, mark_step, reset, snapshot,
+                       step_rows, timer)
+from .slo import SloMonitor, SloSpec
 from .spans import (MODE_COUNTERS, MODE_OFF, MODE_TRACE, NULL_SPAN,
-                    clear_events, current_override, drain_events, enabled,
-                    event, mode, set_mode, span, tracing)
+                    clear_events, current_override, drain_events,
+                    dropped_events, enabled, event, mode, record_span,
+                    set_mode, set_trace_context, span, trace_context,
+                    trace_scope, tracing)
 from .trace import (SCHEMA_VERSION, build_trace, export_chrome_trace,
-                    gap_summary, span_summary, summarize)
+                    gap_summary, merge_traces, span_summary, summarize)
 
 __all__ = [
     # registry
     "Counter", "Gauge", "Timer", "StepStats",
-    "counter", "gauge", "timer", "counters", "snapshot",
+    "counter", "gauge", "timer", "counters", "snapshot", "hist_buckets",
     "mark_step", "step_rows", "reset",
+    # histograms / SLO
+    "Histogram", "histogram", "slo", "SloSpec", "SloMonitor",
     # spans / gating
     "MODE_OFF", "MODE_COUNTERS", "MODE_TRACE", "NULL_SPAN",
     "mode", "enabled", "tracing", "set_mode", "current_override",
-    "span", "event", "drain_events", "clear_events",
+    "span", "event", "record_span", "drain_events", "clear_events",
+    "dropped_events",
+    # trace context (fleet request tracing)
+    "set_trace_context", "trace_context", "trace_scope",
     # export
     "SCHEMA_VERSION", "build_trace", "export_chrome_trace",
-    "gap_summary", "span_summary", "summarize",
+    "gap_summary", "span_summary", "summarize", "merge_traces",
 ]

@@ -4,12 +4,22 @@
     python tools/mxtrace profile.json --top 40
     python tools/mxtrace profile.json --check      # schema gate (CI), exit 0/1
     python tools/mxtrace profile.json --json       # machine-readable summary
+    python tools/mxtrace router.json r0.json r1.json --out fleet.json
+    python tools/mxtrace fleet.json --fleet        # fleet rollups + SLO
+    python tools/mxtrace fleet.json --fleet-trace  # per-request span chains
 
 The dump is what ``profiler.dump_profile()`` (or
 ``telemetry.export_chrome_trace``) wrote: chrome-trace ``traceEvents`` plus
 an ``otherData`` block with the counter snapshot and per-step rows
 (docs/OBSERVABILITY.md). ``--check`` validates the schema every consumer
 of the dump relies on — the CI smoke gate after a telemetry-on fit.
+
+Fleet plane: multiple dump arguments are clock-aligned and merged into
+ONE timeline (``telemetry.merge_traces``; per-dump
+``otherData.clock_offset_s`` stamps — the router's RPC midpoint
+handshake — are honored). ``--fleet`` renders the router's ``fleet.*``
+rollups and SLO status; ``--fleet-trace`` reconstructs each request's
+cross-process span chain by shared ``trace_id``.
 """
 from __future__ import annotations
 
@@ -17,7 +27,7 @@ import argparse
 import json
 import sys
 
-from .trace import SCHEMA_VERSION, gap_summary, span_summary
+from .trace import SCHEMA_VERSION, gap_summary, merge_traces, span_summary
 
 # per-step table columns: (header, counter name in the step row)
 _STEP_COLS = [
@@ -118,9 +128,10 @@ def spans_table(trace, top):
     if not rows:
         return "(no spans recorded — was MXNET_TELEMETRY=trace set?)"
     return _fmt_table(
-        ["span", "ms", "count", "ms/call"],
+        ["span", "ms", "count", "p50", "p95", "p99"],
         [[r["name"], "%.3f" % r["ms"], str(r["count"]),
-          "%.3f" % (r["ms"] / r["count"])] for r in rows])
+          "%.3f" % r.get("p50_ms", 0.0), "%.3f" % r.get("p95_ms", 0.0),
+          "%.3f" % r.get("p99_ms", 0.0)] for r in rows])
 
 
 def gaps_table(trace, top):
@@ -151,26 +162,175 @@ def gaps_table(trace, top):
           str(r["clamped"])] for r in rows])
 
 
+def _event_trace_ids(ev):
+    """trace id(s) stamped on one X event (single or batch form)."""
+    args_ = ev.get("args") or {}
+    tid = args_.get("trace_id")
+    out = [tid] if tid is not None else []
+    ids = args_.get("trace_ids")
+    if isinstance(ids, list):
+        out.extend(ids)
+    return out
+
+
+def request_chains(trace, top=10):
+    """Per-request cross-process span chains, keyed by ``trace_id``:
+    ``{trace_id: [{"pid", "name", "ts", "dur_ms"}, ...]}`` sorted by
+    start time. The --fleet-trace view (router-queue → rpc →
+    replica-queue → dispatch → decode per request)."""
+    chains = {}
+    for ev in trace.get("traceEvents", []):
+        if ev.get("ph") != "X":
+            continue
+        for tid in _event_trace_ids(ev):
+            chains.setdefault(tid, []).append(
+                {"pid": ev.get("pid"), "name": ev.get("name"),
+                 "ts": ev.get("ts", 0),
+                 "dur_ms": round(ev.get("dur", 0) / 1000.0, 3)})
+    for spans_ in chains.values():
+        spans_.sort(key=lambda s: s["ts"])
+    ranked = sorted(chains.items(), key=lambda kv: -len(kv[1]))
+    return dict(ranked[:top]) if top else dict(ranked)
+
+
+def _proc_labels(trace):
+    labels = {}
+    for ev in trace.get("traceEvents", []):
+        if ev.get("ph") == "M" and ev.get("name") == "process_name":
+            labels[ev.get("pid")] = (ev.get("args") or {}).get("name",
+                                                               "?")
+    return labels
+
+
+def fleet_trace_table(trace, top=10):
+    chains = request_chains(trace, top=top)
+    if not chains:
+        return "(no trace_id-stamped spans — fleet tracing needs " \
+               "MXNET_TELEMETRY=trace on router AND replicas)"
+    labels = _proc_labels(trace)
+    out = []
+    for tid, spans_ in chains.items():
+        pids = sorted({s["pid"] for s in spans_})
+        t0 = spans_[0]["ts"]
+        out.append("request %s — %d span(s) across %d process(es)"
+                   % (tid, len(spans_), len(pids)))
+        out.append(_fmt_table(
+            ["t+ms", "dur_ms", "process", "span"],
+            [["%.3f" % ((s["ts"] - t0) / 1000.0), "%.3f" % s["dur_ms"],
+              str(labels.get(s["pid"], s["pid"])), s["name"]]
+             for s in spans_]))
+        out.append("")
+    return "\n".join(out).rstrip()
+
+
+def fleet_table(trace):
+    """Render otherData.fleet (Router.metrics() rollups stamped by
+    serve_bench / profiler) + merged per-process block + SLO status."""
+    other = trace.get("otherData") or {}
+    fleet = other.get("fleet")
+    out = []
+    if not fleet:
+        return "(no otherData.fleet block — write the dump from a " \
+               "fleet run: serve_bench --fleet --trace-out, or stamp " \
+               "Router.metrics() via export_chrome_trace(extra=...))"
+    top = [("qps", "%.1f"), ("requests", "%d"), ("errors", "%d"),
+           ("shed", "%d"), ("redispatches", "%d"),
+           ("tokens_per_dispatch", "%.1f"), ("replicas_fresh", "%d")]
+    line = []
+    for key, fmt in top:
+        if fleet.get(key) is not None:
+            line.append(("%s=" + fmt) % (key, fleet[key]))
+    out.append("fleet: " + "  ".join(line))
+    hists = fleet.get("latency_ms") or {}
+    if hists:
+        out.append("")
+        out.append(_fmt_table(
+            ["timer", "count", "p50", "p95", "p99"],
+            [[name, str(row.get("count", 0)),
+              "%.3f" % row.get("p50", 0.0), "%.3f" % row.get("p95", 0.0),
+              "%.3f" % row.get("p99", 0.0)]
+             for name, row in sorted(hists.items())]))
+    per = fleet.get("replicas") or {}
+    if per:
+        out.append("")
+        out.append(_fmt_table(
+            ["replica", "state", "qps", "requests", "clock_off_ms"],
+            [[str(rid), str(row.get("state", "?")),
+              "%.1f" % row.get("qps", 0.0), str(row.get("requests", 0)),
+              "%.3f" % row.get("clock_offset_ms", 0.0)]
+             for rid, row in sorted(per.items())]))
+    slo = fleet.get("slo")
+    if slo:
+        out.append("")
+        out.append("slo: ok=%s burn_rate=%.3f (threshold %.2f, windows "
+                   "%.0fs/%.0fs)" % (slo.get("ok"),
+                                     slo.get("burn_rate", 0.0),
+                                     slo.get("burn_threshold", 1.0),
+                                     slo.get("short_window_s", 0),
+                                     slo.get("window_s", 0)))
+        for key, row in sorted((slo.get("objectives") or {}).items()):
+            out.append("  %-10s threshold=%-8g burn=%-8.3f value=%s%s"
+                       % (key, row.get("threshold"),
+                          row.get("burn_rate", 0.0), row.get("value"),
+                          "  FIRING" if row.get("firing") else ""))
+        viol = fleet.get("violations") or []
+        if viol:
+            out.append("  %d violation event(s): %s" % (
+                len(viol), ", ".join(
+                    "%s:%s" % (v.get("kind"), v.get("objective"))
+                    for v in viol[-8:])))
+    return "\n".join(out)
+
+
 def main(argv=None):
     ap = argparse.ArgumentParser(
         prog="mxtrace", description="inspect/validate a mxnet_tpu telemetry "
         "chrome-trace dump (docs/OBSERVABILITY.md)")
-    ap.add_argument("dump", help="chrome-trace JSON from "
-                    "profiler.dump_profile()")
+    ap.add_argument("dump", nargs="+",
+                    help="chrome-trace JSON from profiler.dump_profile(); "
+                    "several dumps merge into one fleet timeline")
     ap.add_argument("--top", type=int, default=25,
                     help="span summary length (default 25)")
     ap.add_argument("--check", action="store_true",
                     help="validate the dump schema; exit 0 iff valid")
     ap.add_argument("--json", action="store_true",
                     help="print a machine-readable summary")
+    ap.add_argument("--fleet", action="store_true",
+                    help="render fleet.* rollups + SLO status "
+                    "(otherData.fleet)")
+    ap.add_argument("--fleet-trace", action="store_true",
+                    help="per-request cross-process span chains by "
+                    "trace_id")
+    ap.add_argument("--out", help="write the (merged) dump JSON here")
     args = ap.parse_args(argv)
 
-    try:
-        trace = load(args.dump)
-    except (OSError, ValueError) as exc:
-        print("mxtrace: cannot load %s: %s" % (args.dump, exc),
-              file=sys.stderr)
-        return 1
+    dumps = []
+    for path in args.dump:
+        try:
+            dumps.append(load(path))
+        except (OSError, ValueError) as exc:
+            print("mxtrace: cannot load %s: %s" % (path, exc),
+                  file=sys.stderr)
+            return 1
+    if len(dumps) == 1:
+        trace = dumps[0]
+    else:
+        offsets, labels = {}, {}
+        for d in dumps:
+            other = d.get("otherData") or {}
+            pid = other.get("pid")
+            if pid is not None:
+                if other.get("clock_offset_s") is not None:
+                    offsets[pid] = other["clock_offset_s"]
+                if other.get("label"):
+                    labels[pid] = other["label"]
+        trace = merge_traces(dumps, offsets_s=offsets, labels=labels)
+    if args.out:
+        with open(args.out, "w") as f:
+            json.dump(trace, f)
+
+    other = trace.get("otherData") or {}
+    dropped = other.get("dropped") or 0
 
     if args.check:
         problems = check(trace)
@@ -184,15 +344,36 @@ def main(argv=None):
         print("mxtrace: OK — %d span(s), categories: %s, %d step row(s)"
               % (n_x, ",".join(cats) or "(none)",
                  len((trace.get("otherData") or {}).get("steps") or [])))
+        if dropped:
+            print("mxtrace: WARNING — %d span(s) dropped (ring-buffer "
+                  "overflow; the trace is TRUNCATED — raise "
+                  "MXNET_TELEMETRY_MAX_EVENTS)" % dropped)
         return 0
 
-    other = trace.get("otherData") or {}
+    if args.fleet or args.fleet_trace:
+        if args.fleet:
+            print("== fleet rollups ==")
+            print(fleet_table(trace))
+        if args.fleet_trace:
+            if args.fleet:
+                print()
+            print("== per-request fleet chains (top %d by span count) =="
+                  % min(args.top, 10))
+            print(fleet_trace_table(trace, top=min(args.top, 10)))
+        if dropped:
+            print()
+            print("WARNING: %d dropped span(s) — truncated trace"
+                  % dropped)
+        return 0
+
     if args.json:
         print(json.dumps({
             "counters": other.get("counters", {}),
             "num_steps": len(other.get("steps") or []),
             "spans": span_summary(trace=trace, top=args.top),
             "gaps": gap_summary(trace=trace, top=args.top),
+            "dropped": dropped,
+            "fleet": other.get("fleet"),
             "xla_trace_dir": other.get("xla_trace_dir"),
         }))
         return 0
@@ -211,6 +392,10 @@ def main(argv=None):
         print("== final counters ==")
         for name, v in sorted(counters.items()):
             print("  %-40s %s" % (name, v))
+    if dropped:
+        print()
+        print("WARNING: %d span(s) dropped (ring-buffer overflow) — "
+              "this trace is TRUNCATED" % dropped)
     if other.get("xla_trace_dir"):
         print()
         print("XLA trace dir: %s (TensorBoard/Perfetto)"

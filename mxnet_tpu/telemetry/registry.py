@@ -14,12 +14,24 @@ live for the process unless ``reset()`` is called (tests).
 """
 from __future__ import annotations
 
+import os
 import threading
 import time
 
+from . import histogram as _histmod
+
 __all__ = ["Counter", "Gauge", "Timer", "StepStats",
            "counter", "gauge", "timer", "counters", "snapshot",
-           "mark_step", "step_rows", "reset"]
+           "hist_buckets", "mark_step", "step_rows", "reset"]
+
+
+def _hist_enabled():
+    """MXNET_TELEMETRY_HIST gate (default ON): each Timer carries a
+    fixed-memory log-bucketed histogram so hot-seam timers report
+    p50/p95/p99 (docs/OBSERVABILITY.md §Fleet). Read at instrument
+    creation — ``reset()`` (tests) picks up a flipped env."""
+    raw = os.environ.get("MXNET_TELEMETRY_HIST", "1").strip().lower()
+    return raw not in ("0", "off", "false")
 
 
 class Counter:
@@ -62,20 +74,29 @@ class Gauge:
 
 class Timer:
     """Accumulated duration + call count. ``add`` takes SECONDS (what
-    ``time.perf_counter`` deltas produce); readers get milliseconds."""
+    ``time.perf_counter`` deltas produce); readers get milliseconds.
 
-    __slots__ = ("name", "_total", "_count", "_lock")
+    Unless ``MXNET_TELEMETRY_HIST=0``, every Timer also streams samples
+    into a log-bucketed :class:`telemetry.histogram.Histogram` — one
+    bucket increment per ``add``, fixed memory — so quantile readers
+    (``quantiles_ms``, ``snapshot``, StepStats, mxtrace, fleet rollups)
+    see tail latency, not just the mean."""
+
+    __slots__ = ("name", "_total", "_count", "_lock", "hist")
 
     def __init__(self, name):
         self.name = name
         self._total = 0.0
         self._count = 0
         self._lock = threading.Lock()
+        self.hist = _histmod.Histogram() if _hist_enabled() else None
 
     def add(self, seconds):
         with self._lock:
             self._total += seconds
             self._count += 1
+        if self.hist is not None:
+            self.hist.record(seconds)
 
     @property
     def total_ms(self):
@@ -84,6 +105,13 @@ class Timer:
     @property
     def count(self):
         return self._count
+
+    def quantiles_ms(self, ps=(0.5, 0.95, 0.99)):
+        """{"p50": ms, "p95": ms, "p99": ms} (bounded ~10% relative
+        error); {} when the histogram is disabled or empty."""
+        if self.hist is None:
+            return {}
+        return self.hist.quantiles_ms(ps)
 
 
 _lock = threading.Lock()
@@ -130,7 +158,8 @@ def counters():
 
 
 def snapshot():
-    """Point-in-time view of EVERY instrument, JSON-safe."""
+    """Point-in-time view of EVERY instrument, JSON-safe. Timers with a
+    live histogram additionally carry p50/p95/p99 milliseconds."""
     out = {}
     for name, inst in _items():
         if isinstance(inst, Counter):
@@ -138,8 +167,27 @@ def snapshot():
         elif isinstance(inst, Gauge):
             out[name] = inst.value
         else:
-            out[name] = {"total_ms": round(inst.total_ms, 3),
-                         "count": inst.count}
+            row = {"total_ms": round(inst.total_ms, 3),
+                   "count": inst.count}
+            q = inst.quantiles_ms()
+            if q:
+                row.update({"p50_ms": round(q["p50"], 3),
+                            "p95_ms": round(q["p95"], 3),
+                            "p99_ms": round(q["p99"], 3)})
+            out[name] = row
+    return out
+
+
+def hist_buckets():
+    """Sparse histogram buckets per timer: {timer_name: {bucket: count}}.
+    The wire form replica health() snapshots delta-encode and the router
+    merges into fleet rollups (merge is element-wise add — associative)."""
+    out = {}
+    for name, inst in _items():
+        if isinstance(inst, Timer) and inst.hist is not None:
+            b = inst.hist.to_dict()["buckets"]
+            if b:
+                out[name] = b
     return out
 
 
@@ -161,16 +209,19 @@ class StepStats:
         self._last_t = None
         self._last_counters = {}
         self._last_timers = {}
+        self._last_hists = {}
 
     def mark(self, wall_ms=None):
         now = time.perf_counter()
         with self._lock:
-            cur_c, cur_t = {}, {}
+            cur_c, cur_t, cur_h = {}, {}, {}
             for name, inst in _items():
                 if isinstance(inst, Counter):
                     cur_c[name] = inst.value
                 elif isinstance(inst, Timer):
                     cur_t[name] = (inst.total_ms, inst.count)
+                    if inst.hist is not None:
+                        cur_h[name] = inst.hist.to_dict()["buckets"]
             if wall_ms is None:
                 wall_ms = ((now - self._last_t) * 1000.0
                            if self._last_t is not None else None)
@@ -182,6 +233,18 @@ class StepStats:
                 pms, pcnt = self._last_timers.get(n, (0.0, 0))
                 if cnt - pcnt:
                     dt[n] = {"ms": round(ms - pms, 3), "count": cnt - pcnt}
+                    # this step's OWN latency distribution, not the
+                    # run-cumulative one: diff the buckets, read quantiles
+                    prev_b = self._last_hists.get(n, {})
+                    db = {k: v - prev_b.get(k, 0)
+                          for k, v in cur_h.get(n, {}).items()
+                          if v - prev_b.get(k, 0) > 0}
+                    if db:
+                        q = _histmod.quantiles_from_buckets(db)
+                        dt[n].update(
+                            {"p50_ms": round(q["p50"], 3),
+                             "p95_ms": round(q["p95"], 3),
+                             "p99_ms": round(q["p99"], 3)})
             row = {"step": self._step,
                    "wall_ms": None if wall_ms is None else round(wall_ms, 3),
                    "counters": dc, "timers": dt}
@@ -192,6 +255,7 @@ class StepStats:
             self._last_t = now
             self._last_counters = cur_c
             self._last_timers = cur_t
+            self._last_hists = cur_h
             return row
 
     def rows(self, last=None):
@@ -206,6 +270,7 @@ class StepStats:
             self._last_t = None
             self._last_counters = {}
             self._last_timers = {}
+            self._last_hists = {}
 
 
 _steps = StepStats()

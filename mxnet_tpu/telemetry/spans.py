@@ -26,7 +26,9 @@ import threading
 import time
 
 __all__ = ["mode", "enabled", "tracing", "set_mode", "current_override",
-           "span", "event", "drain_events", "clear_events", "epoch"]
+           "span", "event", "record_span", "drain_events", "clear_events",
+           "epoch", "dropped_events", "set_trace_context", "trace_context",
+           "trace_scope"]
 
 MODE_OFF, MODE_COUNTERS, MODE_TRACE = 0, 1, 2
 _MODE_NAMES = {"0": MODE_OFF, "": MODE_OFF, "off": MODE_OFF,
@@ -61,6 +63,65 @@ def _max_events():
 
 
 _events = collections.deque(maxlen=_max_events())
+_dropped = [0]                # ring-buffer overflow count (satellite:
+_dropped_lock = threading.Lock()   # a truncated trace must say so)
+
+
+def _append_event(tup):
+    """Ring-buffer append that ACCOUNTS for truncation: once the deque is
+    full, every append evicts the oldest span — tick
+    ``telemetry.dropped_events`` so a truncated dump cannot masquerade as
+    a complete one (trace.py stamps the count into otherData, mxtrace
+    --check reports it)."""
+    if len(_events) == _events.maxlen:
+        with _dropped_lock:
+            _dropped[0] += 1
+        from . import registry
+
+        registry.counter("telemetry.dropped_events").inc()
+    _events.append(tup)
+
+
+def dropped_events():
+    """Spans evicted from the ring buffer since the last clear."""
+    return _dropped[0]
+
+
+# --------------------------------------------------------- trace context
+# The distributed-tracing propagation point: the fleet router mints a
+# trace_id per request, rpc.py ships it in the call frame, and RpcServer
+# installs it here (thread-local) around the handler — so every span the
+# handler's thread records inherits the id without any call-site plumbing.
+_trace_ctx = threading.local()
+
+
+def set_trace_context(trace_id):
+    """Install (or clear, with None) the current thread's trace id."""
+    _trace_ctx.tid = trace_id
+
+
+def trace_context():
+    """The current thread's trace id, or None."""
+    return getattr(_trace_ctx, "tid", None)
+
+
+class trace_scope:
+    """Context manager: install a trace id for the block, restoring the
+    previous one on exit (RpcServer handler wrap, engine dispatch)."""
+
+    __slots__ = ("_tid", "_prev")
+
+    def __init__(self, trace_id):
+        self._tid = trace_id
+
+    def __enter__(self):
+        self._prev = trace_context()
+        set_trace_context(self._tid)
+        return self
+
+    def __exit__(self, *exc):
+        set_trace_context(self._prev)
+        return False
 
 
 def _env_mode():
@@ -159,8 +220,11 @@ class _Span:
         t1 = time.perf_counter()
         if exc_type is not None:
             self.attrs["error"] = exc_type.__name__
-        _events.append((self.name, self._t0, t1 - self._t0,
-                        threading.get_ident(), self.attrs))
+        tid = trace_context()
+        if tid is not None and "trace_id" not in self.attrs:
+            self.attrs["trace_id"] = tid
+        _append_event((self.name, self._t0, t1 - self._t0,
+                       threading.get_ident(), self.attrs))
         return False
 
 
@@ -177,8 +241,24 @@ def event(name, **attrs):
     """An instant (zero-duration) event."""
     if mode() < MODE_TRACE:
         return
-    _events.append((name, time.perf_counter(), 0.0,
-                    threading.get_ident(), attrs))
+    tid = trace_context()
+    if tid is not None and "trace_id" not in attrs:
+        attrs["trace_id"] = tid
+    _append_event((name, time.perf_counter(), 0.0,
+                   threading.get_ident(), attrs))
+
+
+def record_span(name, t0_perf, dur_s, **attrs):
+    """Append a span whose interval was measured OUT of band — e.g. the
+    per-request replica queue-wait, whose start (enqueue) and end
+    (dispatch pull) are observed on different threads. No-op unless
+    tracing."""
+    if mode() < MODE_TRACE:
+        return
+    tid = trace_context()
+    if tid is not None and "trace_id" not in attrs:
+        attrs["trace_id"] = tid
+    _append_event((name, t0_perf, dur_s, threading.get_ident(), attrs))
 
 
 def drain_events():
@@ -189,3 +269,5 @@ def drain_events():
 
 def clear_events():
     _events.clear()
+    with _dropped_lock:
+        _dropped[0] = 0

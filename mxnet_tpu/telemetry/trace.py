@@ -14,14 +14,14 @@ from __future__ import annotations
 import json
 import os
 
-from . import registry, spans
+from . import histogram, registry, spans
 
 __all__ = ["export_chrome_trace", "summarize", "span_summary",
-           "gap_summary", "SCHEMA_VERSION"]
+           "gap_summary", "merge_traces", "SCHEMA_VERSION"]
 
 SCHEMA_VERSION = 1
 
-_PID = 1  # single framework process lane
+_PID = 1  # single framework process lane (merge_traces re-pids by os pid)
 
 
 def _category(name):
@@ -53,7 +53,9 @@ def build_trace(xla_trace_dir=None, extra=None):
         events.append(ev)
     other = {"mxnet_telemetry": SCHEMA_VERSION,
              "counters": registry.snapshot(),
-             "steps": registry.step_rows()}
+             "steps": registry.step_rows(),
+             "pid": os.getpid(),
+             "dropped": spans.dropped_events()}
     if xla_trace_dir:
         other["xla_trace_dir"] = os.path.abspath(xla_trace_dir)
     if extra:
@@ -84,21 +86,35 @@ def export_chrome_trace(path, xla_trace_dir=None, extra=None):
 def span_summary(trace=None, top=25):
     """Aggregate span wall time by name, heaviest first — the per-op stat
     table of the reference engine profiler, over framework spans. Accepts a
-    loaded trace dict (mxtrace) or None for the live buffer."""
-    acc = {}
+    loaded trace dict (mxtrace) or None for the live buffer.
+
+    Each row carries p50/p95/p99 milliseconds from a log-bucketed
+    histogram of the span's durations (bounded ~10% relative error) —
+    ``total/count`` means hide tail behavior."""
+    acc = {}          # name -> [ms, count, Histogram]
+    def _add(name, dur_s):
+        row = acc.get(name)
+        if row is None:
+            row = acc[name] = [0.0, 0, histogram.Histogram()]
+        row[0] += dur_s * 1000.0
+        row[1] += 1
+        row[2].record(dur_s)
+
     if trace is None:
         for name, _t0, dur, _ident, _attrs in spans.drain_events():
-            ms, cnt = acc.get(name, (0.0, 0))
-            acc[name] = (ms + dur * 1000.0, cnt + 1)
+            _add(name, dur)
     else:
         for ev in trace.get("traceEvents", []):
             if ev.get("ph") != "X":
                 continue
-            name = ev.get("name", "?")
-            ms, cnt = acc.get(name, (0.0, 0))
-            acc[name] = (ms + ev.get("dur", 0) / 1000.0, cnt + 1)
-    rows = [{"name": n, "ms": round(ms, 3), "count": cnt}
-            for n, (ms, cnt) in acc.items()]
+            _add(ev.get("name", "?"), ev.get("dur", 0) / 1e6)
+    rows = []
+    for n, (ms, cnt, h) in acc.items():
+        q = h.quantiles_ms()
+        rows.append({"name": n, "ms": round(ms, 3), "count": cnt,
+                     "p50_ms": round(q.get("p50", 0.0), 3),
+                     "p95_ms": round(q.get("p95", 0.0), 3),
+                     "p99_ms": round(q.get("p99", 0.0), 3)})
     rows.sort(key=lambda r: -r["ms"])
     return rows[:top]
 
@@ -162,6 +178,88 @@ def gap_summary(trace=None, prefix=None, top=25):
             for n, (c, it, busy, gap, mx, cl) in acc.items()]
     rows.sort(key=lambda r: -r["gap_ms"])
     return rows[:top]
+
+
+def _fold_counters(dst, src):
+    """Fold one process's counter snapshot into a fleet rollup: counters
+    and gauges add, timer rows add total_ms/count (quantile fields are
+    per-process — rebuilt fleet-wide from merged buckets, not summed)."""
+    for k, v in (src or {}).items():
+        if isinstance(v, dict):
+            d = dst.setdefault(k, {"total_ms": 0.0, "count": 0})
+            d["total_ms"] = round(d.get("total_ms", 0.0)
+                                  + (v.get("total_ms") or 0.0), 3)
+            d["count"] = d.get("count", 0) + (v.get("count") or 0)
+        elif isinstance(v, (int, float)) and not isinstance(v, bool):
+            dst[k] = (dst.get(k) or 0) + v
+    return dst
+
+
+def merge_traces(dumps, offsets_s=None, labels=None):
+    """Align per-process chrome dumps into ONE fleet timeline.
+
+    ``dumps`` are ``build_trace()`` dicts (live or JSON-loaded), each
+    self-identified by ``otherData.pid``. ``offsets_s`` maps pid → clock
+    correction in SECONDS, ADDED to that process's timestamps — the
+    router's per-connection midpoint handshake (rpc.py) measures these,
+    so replica spans land on the router's wall clock and a request's
+    router→rpc→replica→dispatch chain reads monotonically. ``labels``
+    maps pid → display name (``router``, ``replica-0``).
+
+    The merged dump keeps the single-process schema (mxtrace --check
+    passes on it) plus ``otherData.merged`` and a per-process block:
+    ``processes[pid] = {label, counters, dropped, clock_offset_ms}``.
+    Top-level counters/dropped are fleet-folded; steps come from the
+    first dump (the router's lane)."""
+    offsets_s = offsets_s or {}
+    labels = labels or {}
+    events, processes, counters = [], {}, {}
+    dropped_total, steps, used_pids = 0, None, set()
+    fleet = None
+    for i, dump in enumerate(dumps):
+        if not isinstance(dump, dict):
+            continue
+        other = dump.get("otherData") or {}
+        pid = other.get("pid")
+        if not isinstance(pid, int) or pid in used_pids:
+            pid = 100000 + i
+            while pid in used_pids:
+                pid += 1
+        used_pids.add(pid)
+        off = offsets_s.get(pid, offsets_s.get(str(pid), 0.0)) or 0.0
+        label = labels.get(pid, labels.get(str(pid))) \
+            or "pid-%d" % pid
+        events.append({"ph": "M", "pid": pid, "name": "process_name",
+                       "args": {"name": label}})
+        for ev in dump.get("traceEvents", []):
+            if not isinstance(ev, dict):
+                continue
+            if ev.get("ph") == "M" and ev.get("name") == "process_name":
+                continue      # replaced by the labeled one above
+            ev = dict(ev)
+            ev["pid"] = pid
+            if off and isinstance(ev.get("ts"), (int, float)):
+                ev["ts"] = round(ev["ts"] + off * 1e6, 1)
+            events.append(ev)
+        dropped = other.get("dropped") or 0
+        dropped_total += dropped
+        _fold_counters(counters, other.get("counters"))
+        processes[str(pid)] = {
+            "label": label, "dropped": dropped,
+            "clock_offset_ms": round(off * 1000.0, 3),
+            "counters": other.get("counters") or {}}
+        if steps is None:
+            steps = other.get("steps") or []
+        if fleet is None and other.get("fleet"):
+            fleet = other["fleet"]   # router's metrics() rollup survives
+    merged_other = {"mxnet_telemetry": SCHEMA_VERSION,
+                    "merged": True, "counters": counters,
+                    "steps": steps or [], "dropped": dropped_total,
+                    "processes": processes}
+    if fleet is not None:
+        merged_other["fleet"] = fleet
+    return {"traceEvents": events, "displayTimeUnit": "ms",
+            "otherData": merged_other}
 
 
 # counters the scoreboard cares about, reported per step when steps exist

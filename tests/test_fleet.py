@@ -592,3 +592,218 @@ def test_fleet_rollout_recycles_unrolled_replicas(tmp_path):
     assert f.supervisor.killed == [0]  # recycled onto the new file
     arg, _ = load_params_npz(params_path)
     np.testing.assert_array_equal(arg["w"], np.ones(2, np.float32))
+
+
+# ---------------------------------------- fleet observability (metrics)
+class TelemetryFake(FakeReplica):
+    """FakeReplica that ships queued delta-encoded telemetry snapshots in
+    health() — the replica wire contract the router folds."""
+
+    def __init__(self, rid, **kw):
+        super().__init__(rid, **kw)
+        self.pending_tel = []
+
+    def health(self, **kw):
+        h = super().health(**kw)
+        if self.pending_tel:
+            h["telemetry"] = self.pending_tel.pop(0)
+        return h
+
+
+def test_router_metrics_fold_replica_snapshots(payload):
+    """Delta-encoded replica snapshots fold EXACTLY ONCE each into the
+    fleet.* rollups: counters add, histogram buckets merge (quantiles
+    rebuilt fleet-wide), per-replica dropped counts surface."""
+    from mxnet_tpu.telemetry.histogram import Histogram
+
+    h0, h1 = Histogram(), Histogram()
+    for _ in range(20):
+        h0.record(0.004)
+    for _ in range(20):
+        h1.record(0.016)
+    fakes = {0: TelemetryFake(0, wait_ms=1.0),
+             1: TelemetryFake(1, wait_ms=2.0)}
+    fakes[0].pending_tel = [
+        {"counters": {"serving.requests": 20},
+         "hist": {"serving.request": h0.to_dict()["buckets"]},
+         "dropped": 0},
+        {"counters": {"serving.requests": 5}, "hist": {}, "dropped": 2},
+    ]
+    fakes[1].pending_tel = [
+        {"counters": {"serving.requests": 20},
+         "hist": {"serving.request": h1.to_dict()["buckets"]},
+         "dropped": 0},
+    ]
+    with make_router(fakes) as r:
+        _wait_fresh(r, 2)
+        for _ in range(3):
+            r.infer(payload, timeout=5)
+        deadline = time.perf_counter() + 3.0
+        m = r.metrics()
+        while time.perf_counter() < deadline:
+            m = r.metrics()
+            if m["counters"].get("serving.requests") == 45 \
+                    and m["replicas"].get("0", {}).get("dropped") == 2:
+                break
+            time.sleep(0.02)
+    assert m["counters"]["serving.requests"] == 45
+    lat = m["latency_ms"]["serving.request"]
+    assert lat["count"] == 40
+    # merged across replicas: 20 @4ms + 20 @16ms — p50 in the fast mode,
+    # p99 in the slow (within the histogram's ~10% bucket error)
+    assert abs(lat["p50"] - 4.0) / 4.0 < 0.15
+    assert abs(lat["p99"] - 16.0) / 16.0 < 0.15
+    # the router's own submit->delivery histogram is the fleet view
+    assert m["latency_ms"]["fleet.request"]["count"] == 3
+    assert m["requests"] == 3 and m["errors"] == 0
+    assert m["replicas"]["0"]["dropped"] == 2
+    assert m["dropped_events"] >= 2
+
+
+def test_trace_id_minting_gated_by_mode(payload):
+    """The router mints a per-request trace id at admission ONLY in trace
+    mode, and installs it around the dispatch so the replica call
+    inherits it (in-process fakes included)."""
+    seen = []
+
+    class Spy(FakeReplica):
+        def infer(self, inputs, **kw):
+            seen.append(telemetry.trace_context())
+            return super().infer(inputs, **kw)
+
+    telemetry.reset()
+    telemetry.clear_events()
+    try:
+        fakes = {0: Spy(0)}
+        with make_router(fakes) as r:
+            _wait_fresh(r, 1)
+            telemetry.set_mode("counters")
+            r.infer(payload, timeout=5)
+            telemetry.set_mode("trace")
+            r.infer(payload, timeout=5)
+        assert seen[0] is None                      # counters: no id
+        assert isinstance(seen[1], str) and len(seen[1]) == 16
+        int(seen[1], 16)                            # hex request id
+    finally:
+        telemetry.set_mode(None)
+        telemetry.reset()
+        telemetry.clear_events()
+
+
+def test_fleet_trace_ids_propagate_across_rpc(payload):
+    """End-to-end request tracing over the REAL wire: the router-minted
+    trace id rides the RPC frame, the replica handler's spans inherit it,
+    the health-poll connection measures a clock offset, and
+    collect_fleet_trace() merges both processes' spans into one chain
+    keyed by that id."""
+    import os
+
+    from mxnet_tpu.telemetry import cli
+
+    telemetry.reset()
+    telemetry.clear_events()
+    telemetry.set_mode("trace")
+    seen = []
+    seq = [0]
+
+    def health(**kw):
+        seq[0] += 1
+        return {"state": "healthy", "seq": seq[0],
+                "snapshot_ms": time.time() * 1000.0,
+                "ewma_queue_wait_ms": 1.0, "pid": os.getpid(),
+                "queue_depth": 0}
+
+    def infer(inputs, deadline_ms=None, **kw):
+        seen.append(telemetry.trace_context())
+        with telemetry.span("serving.dispatch", rows=2):
+            pass
+        return [np.zeros((2, 4), np.float32)]
+
+    def dump_trace(**kw):
+        d = telemetry.build_trace(extra={"label": "replica-0"})
+        # a real replica is a subprocess with its own pid; this in-process
+        # stand-in must self-identify as one for the merge to re-pid it
+        d["otherData"]["pid"] = os.getpid() + 100000
+        return d
+
+    srv = RpcServer({"health": health, "infer": infer,
+                     "dump_trace": dump_trace}).start()
+    try:
+        with make_router({0: srv.addr}) as r:
+            _wait_fresh(r, 1)
+            r.infer(payload, timeout=10)
+            assert len(seen) == 1 and isinstance(seen[0], str)
+            m = r.metrics()
+            # the health-poll connection's midpoint handshake landed
+            assert abs(m["replicas"]["0"]["clock_offset_ms"]) < 5000.0
+            merged = r.collect_fleet_trace()
+        assert cli.check(merged) == []
+        assert merged["otherData"]["merged"] is True
+        assert merged["otherData"]["fleet"]["requests"] == 1
+        labels = {d["label"]
+                  for d in merged["otherData"]["processes"].values()}
+        assert "router" in labels and "replica-0" in labels
+        chains = cli.request_chains(merged)
+        assert seen[0] in chains
+        # the chain spans >= 2 process lanes (router + replica)
+        assert len({s["pid"] for s in chains[seen[0]]}) >= 2
+        names = {s["name"] for s in chains[seen[0]]}
+        assert "fleet.dispatch" in names and "serving.dispatch" in names
+    finally:
+        srv.stop()
+        telemetry.set_mode(None)
+        telemetry.reset()
+        telemetry.clear_events()
+
+
+def test_router_slo_violation_fires_and_clears(payload, monkeypatch):
+    """A redispatch-exhausting fault burst trips the err_pct burn gate
+    (structured slo.violation event); clean traffic rolls the failures
+    out of both windows and the matching slo.clear is emitted."""
+    monkeypatch.setenv("MXNET_SLO_WINDOW_S", "2")
+    monkeypatch.setenv("MXNET_SLO_SHORT_WINDOW_S", "0.5")
+    fakes = {0: FakeReplica(0, wait_ms=1.0)}
+    with make_router(fakes, slo="err_pct:5", max_redispatch=1,
+                     dispatch_wait_ms=500) as r:
+        _wait_fresh(r, 1)
+        for _ in range(5):
+            r.infer(payload, timeout=5)        # healthy baseline
+        s = r.metrics()["slo"]
+        assert s["ok"] and "err_pct" in s["objectives"]
+        fakes[0].fail_next = 12                # initial + 1 redispatch x6
+        futs = [r.submit(payload) for _ in range(6)]
+        for f in futs:
+            with pytest.raises(Exception):
+                f.result(timeout=10)
+        fakes[0].fail_next = 0
+        deadline = time.perf_counter() + 5.0
+        fired = False
+        while time.perf_counter() < deadline:
+            s = r.metrics().get("slo") or {}
+            if s and not s.get("ok", True):
+                fired = True
+                break
+            time.sleep(0.05)
+        assert fired, s
+        assert s["objectives"]["err_pct"]["firing"]
+        assert s["burn_rate"] >= s["burn_threshold"]
+        # recovery: healthy traffic ages the burst out of the window
+        deadline = time.perf_counter() + 10.0
+        cleared = False
+        while time.perf_counter() < deadline:
+            try:
+                r.infer(payload, timeout=5)
+            except Exception:
+                pass
+            s = r.metrics().get("slo") or {}
+            if s.get("ok"):
+                cleared = True
+                break
+            time.sleep(0.1)
+        assert cleared, s
+        kinds = [v["kind"] for v in r.slo_violations()]
+        assert "slo.violation" in kinds and "slo.clear" in kinds
+        viol = [v for v in r.slo_violations()
+                if v["kind"] == "slo.violation"][0]
+        assert viol["objective"] == "err_pct"
+        assert viol["burn_rate"] >= 1.0

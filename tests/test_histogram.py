@@ -1,0 +1,232 @@
+"""Streaming latency histogram (mxnet_tpu/telemetry/histogram.py,
+docs/OBSERVABILITY.md §Fleet): log-bucket quantile error bound vs numpy
+percentiles, merge associativity/commutativity, thread-safety of the
+one-increment record path, empty/single-sample edges, and the sparse
+delta encoding round-tripped through the fleet's framed-pickle RPC."""
+import threading
+
+import numpy as np
+import pytest
+
+from mxnet_tpu.telemetry import histogram as hg
+from mxnet_tpu.telemetry.histogram import Histogram
+
+
+# ------------------------------------------------------------- buckets
+def test_bucket_index_edges():
+    assert hg.bucket_index(0.0) == hg.UNDER
+    assert hg.bucket_index(hg.LO / 10.0) == hg.UNDER
+    assert hg.bucket_index(hg.HI) == hg.OVER
+    assert hg.bucket_index(hg.HI * 10.0) == hg.OVER
+    assert hg.bucket_index(hg.LO) == 0
+    # every finite bucket's own midpoint maps back to itself
+    for i in range(hg.NUM_BUCKETS):
+        lo, hi = hg.bucket_bounds(i)
+        mid = (lo * hi) ** 0.5
+        assert hg.bucket_index(mid) == i, i
+
+
+def test_bucket_bounds_tile_the_range():
+    prev_hi = None
+    for i in range(hg.NUM_BUCKETS):
+        lo, hi = hg.bucket_bounds(i)
+        assert lo < hi
+        if prev_hi is not None:
+            assert lo == pytest.approx(prev_hi, rel=1e-12)
+        prev_hi = hi
+    assert hg.bucket_bounds(0)[0] == pytest.approx(hg.LO)
+    assert prev_hi == pytest.approx(hg.HI, rel=1e-9)
+
+
+# ------------------------------------------------------------ quantiles
+def test_empty_and_single_sample():
+    h = Histogram()
+    assert h.count == 0
+    assert h.quantile(0.5) is None
+    assert h.quantiles_ms() == {}
+    h.record(0.0105)
+    assert h.count == 1
+    # every quantile of a single sample is that sample (within bound)
+    for p in (0.0, 0.5, 0.99, 1.0):
+        assert h.quantile(p) == pytest.approx(0.0105, rel=hg.REL_ERROR)
+
+
+def test_quantile_bad_p_raises():
+    h = Histogram()
+    h.record(0.01)
+    with pytest.raises(ValueError):
+        h.quantile(-0.1)
+    with pytest.raises(ValueError):
+        h.quantile(1.5)
+
+
+def test_sentinel_buckets_answer_their_edge():
+    h = Histogram()
+    for _ in range(10):
+        h.record(1e-9)        # below LO
+    for _ in range(10):
+        h.record(1000.0)      # above HI
+    assert h.quantile(0.1) == pytest.approx(hg.LO)
+    assert h.quantile(0.9) == pytest.approx(hg.HI)
+
+
+@pytest.mark.parametrize("dist", ["loguniform", "bimodal"])
+def test_quantile_error_bound_vs_numpy(dist):
+    rs = np.random.RandomState(7)
+    if dist == "loguniform":
+        # latencies spread over 1µs..10s uniformly in log space
+        samples = 10.0 ** rs.uniform(-6, 1, 20000)
+    else:
+        # fast path ~2ms + slow tail ~800ms — the shape SLO p99s care
+        # about; a mean-only timer reads ~80ms and misses both modes
+        fast = 10.0 ** rs.normal(np.log10(2e-3), 0.1, 18000)
+        slow = 10.0 ** rs.normal(np.log10(0.8), 0.1, 2000)
+        samples = np.concatenate([fast, slow])
+    h = Histogram()
+    for s in samples:
+        h.record(float(s))
+    assert h.count == len(samples)
+    for p in (0.5, 0.9, 0.95, 0.99):
+        # nearest-rank percentile (method="lower") matches the bucketed
+        # ceil-rank scan, so the bound is the pure bucket-midpoint error
+        # — linear interpolation would smear across the bimodal gap
+        true = float(np.percentile(samples, 100.0 * p, method="lower"))
+        got = h.quantile(p)
+        assert got == pytest.approx(true, rel=hg.REL_ERROR + 0.01), \
+            (dist, p, true, got)
+
+
+def test_quantiles_ms_keys():
+    h = Histogram()
+    for ms in (1, 2, 5, 10, 100):
+        for _ in range(10):
+            h.record(ms / 1000.0)
+    q = h.quantiles_ms()
+    assert set(q) == {"p50", "p95", "p99"}
+    assert q["p50"] <= q["p95"] <= q["p99"]
+    assert q["p50"] == pytest.approx(5.0, rel=hg.REL_ERROR + 0.01)
+
+
+# --------------------------------------------------------------- merge
+def _random_hist(seed, n=500):
+    rs = np.random.RandomState(seed)
+    h = Histogram()
+    for s in 10.0 ** rs.uniform(-6, 1, n):
+        h.record(float(s))
+    return h
+
+
+def test_merge_commutative_and_associative():
+    a, b, c = _random_hist(1), _random_hist(2), _random_hist(3)
+    ab = Histogram().merge(a).merge(b)
+    ba = Histogram().merge(b).merge(a)
+    assert ab.to_dict() == ba.to_dict()
+    ab_c = Histogram().merge(ab).merge(c)
+    a_bc = Histogram().merge(a).merge(
+        Histogram().merge(b).merge(c))
+    assert ab_c.to_dict() == a_bc.to_dict()
+    assert ab_c.count == a.count + b.count + c.count
+
+
+def test_merge_accepts_wire_dict_and_preserves_quantiles():
+    a, b = _random_hist(4), _random_hist(5)
+    merged = Histogram().merge(a.to_dict()).merge(b.to_dict())
+    # merged quantiles == quantiles of the pooled samples' histogram
+    pooled = Histogram().merge(a).merge(b)
+    for p in (0.5, 0.95, 0.99):
+        assert merged.quantile(p) == pooled.quantile(p)
+
+
+def test_merge_bucket_maps_matches_histogram_merge():
+    a, b = _random_hist(6), _random_hist(8)
+    da, db = a.to_dict()["buckets"], b.to_dict()["buckets"]
+    m = hg.merge_bucket_maps(da, db, None, {})
+    assert m == Histogram().merge(a).merge(b).to_dict()["buckets"]
+    q = hg.quantiles_from_buckets(m)
+    assert set(q) == {"p50", "p95", "p99"}
+    assert hg.quantiles_from_buckets({}) == {}
+
+
+def test_merge_drops_out_of_range_buckets():
+    # a corrupt wire snapshot must not index outside the fixed array
+    h = Histogram.from_dict(
+        {"v": 1, "buckets": {"0": 3, "97": 2, "500": 9, "-4": 1}})
+    assert h.count == 5
+
+
+# -------------------------------------------------------- thread-safety
+def test_concurrent_record_loses_nothing():
+    h = Histogram()
+    N, T = 5000, 8
+    vals = [1e-4, 1e-3, 1e-2, 1e-1]
+
+    def work(k):
+        v = vals[k % len(vals)]
+        for _ in range(N):
+            h.record(v)
+
+    threads = [threading.Thread(target=work, args=(k,)) for k in range(T)]
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join()
+    assert h.count == N * T
+    buckets = h.to_dict()["buckets"]
+    # each value hit exactly one bucket, T/len(vals) workers each
+    assert sorted(buckets.values()) == [2 * N] * 4
+
+
+# ------------------------------------------------- delta encoding + RPC
+def test_delta_since_is_sparse_and_exact():
+    h = Histogram()
+    h.record(0.001)
+    h.record(0.001)
+    snap = h.to_dict()["buckets"]
+    assert h.delta_since(snap) == {}
+    h.record(0.001)
+    h.record(0.5)
+    d = h.delta_since(snap)
+    assert sum(d.values()) == 2
+    assert hg.merge_bucket_maps(snap, d) == h.to_dict()["buckets"]
+
+
+def test_delta_round_trip_through_framed_pickle_rpc():
+    """The fleet wire path end to end: a 'replica' records latencies,
+    ships sparse bucket DELTAS over the real framed-pickle RPC, and the
+    'router' folds them — the folded rollup must equal the replica's
+    full histogram no matter how the increments were windowed."""
+    from mxnet_tpu.serving.fleet.rpc import RpcServer, RpcClient
+
+    replica_hist = Histogram()
+    shipped = {"last": {}}
+    lock = threading.Lock()
+
+    def snapshot():
+        with lock:
+            d = replica_hist.delta_since(shipped["last"])
+            shipped["last"] = replica_hist.to_dict()["buckets"]
+        return {"hist": {"t.req": d}}
+
+    server = RpcServer({"health": snapshot}).start()
+    cli = RpcClient(server.addr, timeout_s=10.0)
+    try:
+        rs = np.random.RandomState(11)
+        folded = {}
+        for _window in range(5):
+            for s in 10.0 ** rs.uniform(-4, 0, 200):
+                replica_hist.record(float(s))
+            tel = cli.call("health")
+            folded = hg.merge_bucket_maps(folded,
+                                          tel["hist"].get("t.req"))
+        # the clock handshake measured an offset on connect, too
+        assert cli.clock_offset_s is not None
+        assert abs(cli.clock_offset_s) < 5.0  # same host, same clock
+        assert cli.remote_pid is not None
+    finally:
+        cli.close()
+        server.stop()
+    assert folded == replica_hist.to_dict()["buckets"]
+    assert sum(folded.values()) == 1000
+    for p in (0.5, 0.99):
+        assert hg.quantiles_from_buckets(folded)["p%g" % (p * 100)] \
+            == pytest.approx(replica_hist.quantile(p) * 1000.0)

@@ -434,3 +434,329 @@ def test_fit_trace_end_to_end(tm, tmp_path):
         [sys.executable, os.path.join(ROOT, "tools", "mxtrace"), path,
          "--check"], capture_output=True, text=True)
     assert out.returncode == 0, out.stderr
+
+
+# ----------------------------------------------- dropped-span accounting
+def test_dropped_events_are_accounted(tm, monkeypatch):
+    """Ring-buffer overflow must be VISIBLE: the evicted-span count ticks
+    a counter, lands in the dump metadata, and survives until clear."""
+    import collections
+
+    from mxnet_tpu.telemetry import spans as spans_mod
+
+    tm.set_mode("trace")
+    monkeypatch.setattr(spans_mod, "_events",
+                        collections.deque(maxlen=50))
+    for i in range(60):
+        tm.record_span("t.flood", float(i), 0.001)
+    assert tm.dropped_events() == 10
+    assert tm.counters()["telemetry.dropped_events"] == 10
+    trace = tm.build_trace()
+    assert trace["otherData"]["dropped"] == 10
+    assert len([e for e in trace["traceEvents"]
+                if e.get("ph") == "X"]) == 50
+    tm.clear_events()
+    assert tm.dropped_events() == 0
+
+
+def test_mxtrace_check_warns_on_truncated_dump(tm, tmp_path, capsys):
+    from mxnet_tpu.telemetry import cli
+
+    tm.set_mode("trace")
+    trace = tm.build_trace()
+    trace["otherData"]["dropped"] = 7
+    p = tmp_path / "trunc.json"
+    p.write_text(json.dumps(trace))
+    assert cli.main([str(p), "--check"]) == 0  # truncated, not invalid
+    out = capsys.readouterr().out
+    assert "TRUNCATED" in out and "7" in out
+
+
+# ------------------------------------------------- trace context (fleet)
+def test_trace_scope_stamps_and_restores(tm):
+    tm.set_mode("trace")
+    assert tm.trace_context() is None
+    with tm.trace_scope("aaaa000011112222"):
+        assert tm.trace_context() == "aaaa000011112222"
+        with tm.span("t.inner"):
+            pass
+        tm.event("t.mark")
+        with tm.trace_scope("bbbb000011112222"):
+            assert tm.trace_context() == "bbbb000011112222"
+        assert tm.trace_context() == "aaaa000011112222"  # restored
+        # an explicit trace_id attr wins over the ambient context
+        with tm.span("t.explicit", trace_id="cccc000011112222"):
+            pass
+    assert tm.trace_context() is None
+    by_name = {e[0]: e[4] for e in tm.drain_events()}
+    assert by_name["t.inner"]["trace_id"] == "aaaa000011112222"
+    assert by_name["t.mark"]["trace_id"] == "aaaa000011112222"
+    assert by_name["t.explicit"]["trace_id"] == "cccc000011112222"
+
+
+def test_record_span_out_of_band(tm):
+    """record_span appends an interval measured across threads (replica
+    queue-wait) — no-op below trace mode, inherits the trace context."""
+    tm.set_mode("counters")
+    tm.record_span("t.oob", 1.0, 0.5)
+    tm.set_mode("trace")
+    assert tm.drain_events() == []
+    with tm.trace_scope("dddd000011112222"):
+        tm.record_span("t.oob", 2.0, 0.25, replica="r1")
+    (name, t0, dur, _ident, attrs), = tm.drain_events()
+    assert (name, t0, dur) == ("t.oob", 2.0, 0.25)
+    assert attrs == {"replica": "r1", "trace_id": "dddd000011112222"}
+
+
+# -------------------------------------------- span summary tail latency
+def test_span_summary_rows_carry_quantiles(tm):
+    """The mxtrace top-N table reads p50/p95/p99 per span name — a
+    90/10 bimodal span whose mean (~11ms) describes NEITHER mode."""
+    import time
+
+    tm.set_mode("trace")
+    t0 = time.perf_counter()
+    for i in range(90):
+        tm.record_span("t.bimodal", t0 + i, 0.001)
+    for i in range(10):
+        tm.record_span("t.bimodal", t0 + 90 + i, 0.100)
+    row, = [r for r in telemetry.span_summary(top=5)
+            if r["name"] == "t.bimodal"]
+    assert row["count"] == 100
+    from mxnet_tpu.telemetry import histogram as hg
+    assert row["p50_ms"] == pytest.approx(1.0, rel=hg.REL_ERROR + 0.01)
+    assert row["p95_ms"] == pytest.approx(100.0, rel=hg.REL_ERROR + 0.01)
+    assert row["p99_ms"] == pytest.approx(100.0, rel=hg.REL_ERROR + 0.01)
+
+
+def test_timer_snapshot_quantiles(tm):
+    tm.set_mode("counters")
+    t = tm.timer("t.lat")
+    for _ in range(95):
+        t.add(0.002)
+    for _ in range(5):
+        t.add(0.900)    # 5% tail so the nearest-rank p99 lands in it
+    snap = tm.snapshot()["t.lat"]
+    assert snap["count"] == 100
+    from mxnet_tpu.telemetry import histogram as hg
+    assert snap["p50_ms"] == pytest.approx(2.0, rel=hg.REL_ERROR + 0.01)
+    assert snap["p99_ms"] == pytest.approx(900.0, rel=hg.REL_ERROR + 0.01)
+    # per-step rows diff the BUCKETS, so a quiet step shows its own tail
+    tm.mark_step()
+    for _ in range(10):
+        t.add(0.004)
+    row = tm.mark_step()
+    assert row["timers"]["t.lat"]["count"] == 10
+    assert row["timers"]["t.lat"]["p99_ms"] == pytest.approx(
+        4.0, rel=hg.REL_ERROR + 0.01)
+
+
+# --------------------------------------------------- fleet trace merging
+def test_merge_traces_builds_one_fleet_timeline(tm):
+    """Two per-process dumps sharing a trace_id merge into one dump:
+    re-pidded, clock-offset applied, labels installed, counters folded,
+    and the request chain spans both processes."""
+    import time
+
+    from mxnet_tpu.telemetry import cli
+
+    tm.set_mode("trace")
+    t0 = time.perf_counter()
+    with tm.trace_scope("deadbeefcafe0123"):
+        with tm.span("fleet.dispatch", replica="r0"):
+            pass
+    d1 = tm.build_trace()
+    d1["otherData"]["pid"] = 111
+    d1["otherData"]["counters"] = {
+        "fleet.requests": 3, "t.req": {"total_ms": 6.0, "count": 3}}
+    tm.clear_events()
+    with tm.trace_scope("deadbeefcafe0123"):
+        tm.record_span("serving.dispatch", t0, 0.002, rows=4)
+    d2 = tm.build_trace()
+    d2["otherData"]["pid"] = 222
+    d2["otherData"]["counters"] = {
+        "fleet.requests": 2, "t.req": {"total_ms": 4.0, "count": 2}}
+    ts_before = [e["ts"] for e in d2["traceEvents"] if e.get("ph") == "X"]
+
+    merged = telemetry.merge_traces(
+        [d1, d2], offsets_s={222: 1.5},
+        labels={111: "router", 222: "replica-0"})
+    assert cli.check(merged) == []
+    other = merged["otherData"]
+    assert other["merged"] is True
+    assert other["counters"]["fleet.requests"] == 5
+    assert other["counters"]["t.req"] == {"total_ms": 10.0, "count": 5}
+    assert other["processes"]["111"]["label"] == "router"
+    assert other["processes"]["222"]["clock_offset_ms"] == 1500.0
+    metas = {e["pid"]: e["args"]["name"] for e in merged["traceEvents"]
+             if e.get("ph") == "M" and e.get("name") == "process_name"}
+    assert metas == {111: "router", 222: "replica-0"}
+    # replica timestamps moved onto the router's wall clock
+    ts_after = [e["ts"] for e in merged["traceEvents"]
+                if e.get("ph") == "X" and e["pid"] == 222]
+    assert len(ts_after) == len(ts_before)
+    for got, was in zip(ts_after, ts_before):
+        assert got == pytest.approx(was + 1.5e6, abs=0.2)
+    # ONE trace_id joins spans from both processes
+    chains = cli.request_chains(merged)
+    assert set(chains) == {"deadbeefcafe0123"}
+    assert {s["pid"] for s in chains["deadbeefcafe0123"]} == {111, 222}
+
+
+def test_mxtrace_fleet_and_fleet_trace_views(tm, tmp_path, capsys):
+    """mxtrace merges multiple dump arguments (honoring stamped
+    clock_offset_s / label), keeps the router's fleet rollup block, and
+    renders --fleet + --fleet-trace."""
+    import time
+
+    from mxnet_tpu.telemetry import cli
+
+    tm.set_mode("trace")
+    t0 = time.perf_counter()
+    with tm.trace_scope("feedfacefeedface"):
+        tm.record_span("fleet.dispatch", t0, 0.004, replica="r0")
+    d1 = tm.build_trace()
+    d1["otherData"].update(pid=111, label="router")
+    d1["otherData"]["fleet"] = {
+        "qps": 12.5, "requests": 100, "errors": 1, "shed": 0,
+        "latency_ms": {"fleet.request": {
+            "count": 100, "p50": 4.0, "p95": 9.0, "p99": 12.0}},
+        "replicas": {"r0": {"state": "up", "qps": 12.5, "requests": 100,
+                            "clock_offset_ms": 250.0}},
+        "slo": {"ok": False, "burn_rate": 2.5, "burn_threshold": 1.0,
+                "window_s": 4.0, "short_window_s": 1.0,
+                "objectives": {"err_pct": {
+                    "threshold": 1.0, "burn_rate": 2.5, "value": 2.0,
+                    "firing": True}}},
+        "violations": [{"kind": "slo.violation", "objective": "err_pct"}],
+    }
+    tm.clear_events()
+    with tm.trace_scope("feedfacefeedface"):
+        tm.record_span("serving.dispatch", t0, 0.002)
+    d2 = tm.build_trace()
+    d2["otherData"].update(pid=222, label="replica-0", clock_offset_s=0.25)
+
+    p1, p2 = tmp_path / "router.json", tmp_path / "r0.json"
+    p1.write_text(json.dumps(d1))
+    p2.write_text(json.dumps(d2))
+    out = tmp_path / "fleet.json"
+    assert cli.main([str(p1), str(p2), "--out", str(out),
+                     "--check"]) == 0, capsys.readouterr().err
+    capsys.readouterr()
+    merged = json.loads(out.read_text())
+    assert merged["otherData"]["merged"]
+    assert merged["otherData"]["processes"]["222"]["clock_offset_ms"] \
+        == 250.0
+    assert merged["otherData"]["fleet"]["requests"] == 100
+
+    assert cli.main([str(out), "--fleet", "--fleet-trace"]) == 0
+    text = capsys.readouterr().out
+    assert "fleet:" in text and "qps=12.5" in text
+    assert "fleet.request" in text
+    assert "slo: ok=False" in text and "FIRING" in text
+    assert "request feedfacefeedface" in text
+    assert "router" in text and "replica-0" in text
+
+
+# --------------------------------------------------------- SLO burn rate
+def test_slo_spec_parse_forms(tmp_path):
+    from mxnet_tpu.telemetry.slo import SloSpec
+
+    s = SloSpec.parse("p99_ms:250, err_pct:1 ,avail_pct:99")
+    assert s.objectives == {"p99_ms": 250.0, "err_pct": 1.0,
+                            "avail_pct": 99.0}
+    assert SloSpec.parse('{"p99_ms": 100}').objectives == {"p99_ms": 100.0}
+    f = tmp_path / "slo.json"
+    f.write_text('{"err_pct": 2}')
+    assert SloSpec.parse(str(f)).objectives == {"err_pct": 2.0}
+    # a trailing comma is tolerated (k:v lists paste from shells)
+    assert SloSpec.parse("p99_ms:250,").objectives == {"p99_ms": 250.0}
+    with pytest.raises(ValueError):
+        SloSpec.parse("bogus_key:1")
+    with pytest.raises(ValueError):
+        SloSpec.parse("p99_ms")       # no value
+    with pytest.raises(ValueError):
+        SloSpec({"err_pct": 0})       # out of range
+    with pytest.raises(ValueError):
+        SloSpec({"avail_pct": 120})
+
+
+def test_slo_monitor_fire_and_clear_cycle(tm):
+    """Error burst trips the multi-window burn gate; clean traffic rolls
+    it out of both windows and the matching clear event is emitted."""
+    from mxnet_tpu.telemetry.slo import SloMonitor, SloSpec
+
+    tm.set_mode("trace")
+    mon = SloMonitor(SloSpec.parse("err_pct:10"), window_s=4.0,
+                     short_window_s=1.0, burn_threshold=1.0)
+    mon.observe(total=100, errors=0, t=100.0)
+    mon.observe(total=100, errors=0, t=101.0)
+    r = mon.evaluate(t=101.5)
+    assert r["ok"] and r["burn_rate"] == 0.0
+    # burst: 80% errors = 8x the 10% budget in the short window, and
+    # enough to push the long window over too (multi-window AND)
+    mon.observe(total=100, errors=80, t=102.0)
+    r = mon.evaluate(t=102.2)
+    assert not r["ok"]
+    obj = r["objectives"]["err_pct"]
+    assert obj["firing"] and obj["short"] > obj["long"] >= 1.0
+    assert r["burn_rate"] >= 1.0
+    assert mon.firing() == ["err_pct"]
+    assert tm.snapshot()["slo.burn_rate"] >= 1.0  # gauge published
+    # recovery: clean ticks age the burst past the 4s window
+    for i in range(4):
+        mon.observe(total=100, errors=0, t=103.0 + i)
+    r = mon.evaluate(t=106.5)
+    assert r["ok"] and mon.firing() == []
+    kinds = [v["kind"] for v in mon.violations()]
+    assert kinds == ["slo.violation", "slo.clear"]
+    viol = mon.violations()[0]
+    assert viol["objective"] == "err_pct" and viol["burn_rate"] >= 1.0
+    # structured span events rode along for the trace timeline
+    names = [e[0] for e in tm.drain_events()]
+    assert "slo.violation" in names and "slo.clear" in names
+
+
+def test_slo_latency_objective_over_buckets(tm):
+    """p99 objective burns by the fraction of bucketed samples over the
+    ceiling — fed the same sparse buckets the fleet wire ships."""
+    from mxnet_tpu.telemetry.histogram import Histogram
+    from mxnet_tpu.telemetry.slo import SloMonitor, SloSpec
+
+    tm.set_mode("counters")
+    mon = SloMonitor(SloSpec.parse("p99_ms:50"), window_s=4.0,
+                     short_window_s=1.0, burn_threshold=1.0)
+    good = Histogram()
+    for _ in range(995):
+        good.record(0.010)
+    for _ in range(5):
+        good.record(0.200)   # 0.5% tail: half the 1% budget
+    mon.observe(total=1000, latency_buckets=good.to_dict()["buckets"],
+                t=100.0)
+    r = mon.evaluate(t=100.5)
+    assert r["ok"]
+    assert r["objectives"]["p99_ms"]["value"] == pytest.approx(10.0,
+                                                               rel=0.15)
+    bad = Histogram()
+    for _ in range(950):
+        bad.record(0.010)
+    for _ in range(50):
+        bad.record(0.200)    # 5% tail: 5x the budget
+    mon.observe(total=1000, latency_buckets=bad.to_dict()["buckets"],
+                t=101.0)
+    r = mon.evaluate(t=101.2)
+    assert not r["ok"] and r["objectives"]["p99_ms"]["firing"]
+    assert r["objectives"]["p99_ms"]["value"] > 50.0
+
+
+def test_slo_availability_objective(tm):
+    from mxnet_tpu.telemetry.slo import SloMonitor, SloSpec
+
+    tm.set_mode("counters")
+    mon = SloMonitor(SloSpec.parse("avail_pct:99"), window_s=4.0,
+                     short_window_s=1.0, burn_threshold=1.0)
+    mon.observe(available=True, t=10.0)
+    assert mon.evaluate(t=10.5)["ok"]
+    mon.observe(available=False, t=11.0)   # replica-less tick
+    r = mon.evaluate(t=11.2)
+    assert not r["ok"] and r["objectives"]["avail_pct"]["firing"]

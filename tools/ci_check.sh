@@ -441,10 +441,45 @@ echo "== [8/10] serving fleet: 4-replica router chaos smoke (docs/SERVING.md §F
 # completed>0, the rollout applied, the fleet back to healthy, aggregate
 # QPS above the single-replica closed-loop baseline, p99 in bound, and
 # paged-KV multiplexed decode token-identical to sequential decode.
+# The same run also drives the fleet OBSERVABILITY plane
+# (docs/OBSERVABILITY.md §Fleet): --check additionally gates the
+# fleet.request histogram p50/p99 against client-side percentiles, the
+# seeded 100%-fault burst tripping the SLO burn-rate gate (and clearing
+# after recovery, with structured slo.violation/slo.clear events), and
+# --trace-out writes the merged clock-aligned fleet chrome trace.
+FLEET_TRACE="$(mktemp /tmp/fleet_trace_ci.XXXXXX.json)"
 JAX_PLATFORMS=cpu MXNET_DEFAULT_CONTEXT=cpu \
 python tools/serve_bench.py --model mlp --fleet --fleet-replicas 4 \
-    --qps 100 --duration 4 --check \
-    || { echo "serve_bench fleet smoke FAILED"; exit 1; }
+    --qps 100 --duration 4 --check --trace-out "$FLEET_TRACE" \
+    || { echo "serve_bench fleet smoke FAILED"; rm -f "$FLEET_TRACE"; exit 1; }
+# the merged dump is a machine contract like the single-process one:
+# mxtrace must schema-gate it, and at least one request chain must span
+# >=2 processes (router pid + replica pid) joined by ONE trace_id
+python tools/mxtrace "$FLEET_TRACE" --check \
+    || { echo "mxtrace --check on merged fleet trace FAILED"; rm -f "$FLEET_TRACE"; exit 1; }
+python tools/mxtrace "$FLEET_TRACE" --fleet >/dev/null \
+    || { echo "mxtrace --fleet on merged fleet trace FAILED"; rm -f "$FLEET_TRACE"; exit 1; }
+python - "$FLEET_TRACE" <<'PYEOF' || { echo "fleet trace cross-process gate FAILED"; rm -f "$FLEET_TRACE"; exit 1; }
+import json, sys
+trace = json.load(open(sys.argv[1]))
+events = trace["traceEvents"]
+by_tid = {}
+for ev in events:
+    a = ev.get("args") or {}
+    for tid in ([a["trace_id"]] if a.get("trace_id") else []) \
+            + list(a.get("trace_ids") or []):
+        by_tid.setdefault(tid, set()).add(ev.get("pid"))
+cross = {t: sorted(p) for t, p in by_tid.items() if len(p) >= 2}
+assert cross, "no trace_id joins spans from >=2 processes (%d traced)" \
+    % len(by_tid)
+pids = {ev.get("pid") for ev in events if ev.get("ph") == "X"}
+assert len(pids) >= 2, "merged trace has spans from only %s" % pids
+other = trace["otherData"]
+assert other.get("merged") and other.get("fleet"), "otherData not merged"
+print("fleet trace gate OK: %d events across %d pids, %d cross-process "
+      "request chains" % (len(events), len(pids), len(cross)))
+PYEOF
+rm -f "$FLEET_TRACE"
 
 echo "== [9/10] elastic: 8-proc chaos smoke (docs/FAULT_TOLERANCE.md) =="
 # kill 1 of 8 workers mid-fit: survivors pause, re-form to 7, reseed from

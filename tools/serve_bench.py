@@ -98,6 +98,49 @@ def _percentiles(lat_ms):
             float(np.percentile(lat_ms, 99)))
 
 
+def _hist_delta_quantiles(name, warm_buckets):
+    """Engine-side histogram quantiles for timer ``name`` over the
+    measured window only: sparse-bucket delta against the pre-window
+    snapshot, read back as {"p50": ms, "p95": ms, "p99": ms}."""
+    from mxnet_tpu import telemetry
+    from mxnet_tpu.telemetry import histogram as _hg
+
+    end = telemetry.hist_buckets().get(name, {})
+    warm = warm_buckets.get(name, {})
+    db = {k: v - warm.get(k, 0) for k, v in end.items()
+          if v - warm.get(k, 0) > 0}
+    q = _hg.quantiles_from_buckets(db)
+    q["count"] = sum(db.values())
+    return q
+
+
+def _quantile_agreement(hist_q, client_p50, client_p99,
+                        abs_ms=(15.0, 50.0), rel=(0.5, 0.75)):
+    """Cross-check histogram p50/p99 against client-side request-list
+    percentiles: each must agree within max(abs floor, rel fraction) —
+    loose enough for the ~10% bucket error + scheduling noise, tight
+    enough to catch unit errors and a histogram measuring the wrong
+    thing. Returns (ok, detail)."""
+    detail = {"hist_p50_ms": round(hist_q.get("p50", 0.0), 3),
+              "hist_p99_ms": round(hist_q.get("p99", 0.0), 3),
+              "client_p50_ms": None if client_p50 is None
+              else round(client_p50, 3),
+              "client_p99_ms": None if client_p99 is None
+              else round(client_p99, 3),
+              "samples": hist_q.get("count", 0)}
+    if not hist_q.get("count") or client_p50 is None:
+        return False, detail
+    ok = True
+    for key, cli, a, r in (("p50", client_p50, abs_ms[0], rel[0]),
+                           ("p99", client_p99, abs_ms[1], rel[1])):
+        h = hist_q.get(key, 0.0)
+        tol = max(a, r * max(cli, h))
+        if abs(h - cli) > tol:
+            ok = False
+    detail["agree"] = ok
+    return ok, detail
+
+
 def _mk_engine(net, arg_params, aux_params, item, buckets, max_delay_ms,
                cache_dir, tag):
     from mxnet_tpu.serving import InferenceEngine, PersistentExecutableCache
@@ -172,10 +215,17 @@ def bench_engine(args):
     # setup; keep it out of the measured window
     eng.infer({"data": np.zeros((args.rows,) + item, "float32")})
     c_warm = _counters()
+    hb_warm = telemetry.hist_buckets()
     lat, completed, elapsed, offered, dropped = _open_loop(
         eng, item, args.qps, args.duration, args.rows)
     c_end = _counters()
     p50, p99 = _percentiles(lat)
+    # engine-vs-client agreement: the serving.request timer histogram
+    # (submit -> delivery, measured in _dispatch) must tell the same
+    # latency story the client request list does
+    _, hist_detail = _quantile_agreement(
+        _hist_delta_quantiles("serving.request", hb_warm), p50, p99,
+        abs_ms=(10.0, 25.0), rel=(0.4, 0.6))
     items = c_end.get("serving.batch_items", 0) - \
         c_warm.get("serving.batch_items", 0)
     capacity = c_end.get("serving.batch_capacity", 0) - \
@@ -192,6 +242,7 @@ def bench_engine(args):
         "dropped": dropped,
         "p50_ms": None if p50 is None else round(p50, 3),
         "p99_ms": None if p99 is None else round(p99, 3),
+        "engine_hist": hist_detail,
         "batches": c_end.get("serving.batches", 0)
         - c_warm.get("serving.batches", 0),
         "batch_occupancy": round(items / capacity, 4) if capacity else None,
@@ -488,6 +539,7 @@ def bench_fleet(args):
 
     import mxnet_tpu  # noqa: F401
     from mxnet_tpu import faultinject as fi
+    from mxnet_tpu import telemetry
     from mxnet_tpu.serving import ServeOverloadError, ServeDeadlineError
     from mxnet_tpu.serving.fleet import (Fleet, RpcClient, save_params_npz,
                                          FleetRolloutError)
@@ -503,6 +555,10 @@ def bench_fleet(args):
             "buckets": buckets,
             "params": params_path,
             "engine": {"max_delay_ms": args.max_delay_ms},
+            # replica subprocesses don't inherit the bench's in-process
+            # set_mode(): ship the mode so their spans/timers exist for
+            # the merged trace and the health() telemetry snapshots
+            "telemetry": telemetry.mode(),
             "heartbeat_ms": 300}
     n = args.fleet_replicas
     rs = np.random.RandomState(1)
@@ -517,11 +573,18 @@ def bench_fleet(args):
     # purge stuck work, the router's absolute shed cap bounds the queueing
     # a completed request can have suffered — both scale off the p99 bound
     deadline_ms = args.p99_bound_ms / 2.0
+    # SLO gate, windows scaled to bench length (env wins if already set):
+    # err_pct is what the seeded 100% fault burst below must trip, and
+    # what the recovery traffic must clear once the window rolls past
+    os.environ.setdefault("MXNET_SLO_WINDOW_S", "4")
+    os.environ.setdefault("MXNET_SLO_SHORT_WINDOW_S", "1")
+    slo_spec = ("p99_ms:%g,err_pct:2,avail_pct:50"
+                % args.p99_bound_ms)
     fleet = Fleet(spec, n_replicas=n, workdir=workdir,
                   router_kwargs=dict(
                       workers=max(8, 2 * n), health_interval_ms=100,
                       stale_ms=1500, shed_ms=args.p99_bound_ms / 4.0,
-                      dispatch_wait_ms=30000))
+                      dispatch_wait_ms=30000, slo=slo_spec))
     try:
         t_up = time.perf_counter()
         fleet.start()
@@ -668,6 +731,64 @@ def bench_fleet(args):
             "fleet_health_after": router.health()["state"],
             "p99_bound_ms": args.p99_bound_ms,
         })
+
+        # ---- observability plane (docs/OBSERVABILITY.md §Fleet) ----
+        # fleet rollup + fleet-vs-client latency agreement: the router's
+        # fleet.request histogram brackets exactly what clients timed
+        # over the load window (metrics read BEFORE the SLO burst below
+        # adds traffic), so its p50/p99 must tell the same story
+        m = router.metrics()
+        fq = (m.get("latency_ms") or {}).get("fleet.request", {})
+        _, agree = _quantile_agreement(
+            {"p50": fq.get("p50", 0.0), "p99": fq.get("p99", 0.0),
+             "count": fq.get("count", 0)}, p50, p99,
+            abs_ms=(25.0, 75.0), rel=(0.6, 0.8))
+        res["fleet_metrics"] = m
+        res["fleet_hist_vs_client"] = agree
+
+        # merged fleet trace: one clock-aligned timeline whose request
+        # chains must join >=2 processes (router + replica) on a single
+        # router-minted trace_id
+        if telemetry.tracing():
+            merged = fleet.collect_fleet_trace()
+            res["fleet_trace"] = _fleet_trace_stats(merged)
+            if args.trace_out:
+                with open(args.trace_out, "w") as f:
+                    json.dump(merged, f)
+                res["fleet_trace"]["written"] = args.trace_out
+
+        # seeded fault burst: 100% fleet.dispatch raises exhaust the
+        # redispatch budget -> router errors -> slo.burn_rate trips; then
+        # clean recovery traffic must CLEAR it once the window rolls
+        slo_burst = {"fired": False, "cleared": False, "peak_burn": 0.0}
+        with fi.inject("fleet.dispatch", "raise", prob=1.0, seed=13):
+            t_burst = time.perf_counter()
+            while time.perf_counter() - t_burst < 12.0:
+                try:
+                    router.infer({"data": payloads[0]}, timeout=20.0)
+                except Exception:
+                    pass
+                s = router.metrics().get("slo")
+                if s:
+                    slo_burst["peak_burn"] = max(slo_burst["peak_burn"],
+                                                 s.get("burn_rate", 0.0))
+                    if not s.get("ok", True):
+                        slo_burst["fired"] = True
+                        break
+                time.sleep(0.05)
+        t_rec = time.perf_counter()
+        while time.perf_counter() - t_rec < 20.0:
+            try:
+                router.infer({"data": payloads[0]}, timeout=20.0)
+            except Exception:
+                pass
+            s = router.metrics().get("slo")
+            if slo_burst["fired"] and s and s.get("ok"):
+                slo_burst["cleared"] = True
+                break
+            time.sleep(0.1)
+        res["slo_burst"] = slo_burst
+        res["slo_violations"] = router.slo_violations()
     finally:
         fleet.close()
         shutil.rmtree(workdir, ignore_errors=True)
@@ -676,6 +797,31 @@ def bench_fleet(args):
     # the fleet story: one decode batch, many concurrent sequences)
     res["paged_kv"] = _paged_kv_parity()
     return res
+
+
+def _fleet_trace_stats(merged):
+    """Summary of a merged fleet trace: how many request chains cross
+    process boundaries (>=2 pids joined by one trace_id) — the number the
+    --check gate asserts is at least 1."""
+    by_tid = {}
+    span_pids = set()
+    events = merged.get("traceEvents", [])
+    for ev in events:
+        if ev.get("ph") == "X":
+            span_pids.add(ev.get("pid"))
+        a = ev.get("args") or {}
+        tids = []
+        if a.get("trace_id"):
+            tids.append(a["trace_id"])
+        tids.extend(a.get("trace_ids") or [])
+        for tid in tids:
+            by_tid.setdefault(tid, set()).add(ev.get("pid"))
+    cross = sum(1 for pids in by_tid.values() if len(pids) >= 2)
+    other = merged.get("otherData") or {}
+    return {"events": len(events), "span_pids": len(span_pids),
+            "traced_requests": len(by_tid),
+            "cross_process_traces": cross,
+            "dropped": other.get("dropped", 0)}
 
 
 def _paged_kv_parity(n_streams=3, n_tokens=6):
@@ -760,6 +906,29 @@ def _check_fleet(res):
     if not res["paged_kv"]["token_identical"]:
         _fail("paged-KV multiplexed decode diverged from sequential "
               "per-request decode: %s" % res["paged_kv"])
+    # ---- observability-plane gates (docs/OBSERVABILITY.md §Fleet)
+    agree = res.get("fleet_hist_vs_client") or {}
+    if not agree.get("agree"):
+        _fail("fleet.request histogram p50/p99 disagree with client-side "
+              "request percentiles: %s" % agree)
+    ft = res.get("fleet_trace")
+    if ft is None:
+        _fail("no merged fleet trace was collected")
+    elif not ft.get("cross_process_traces"):
+        _fail("merged fleet trace has no request chain spanning >=2 "
+              "processes on one trace_id: %s" % ft)
+    burst = res.get("slo_burst") or {}
+    if not burst.get("fired"):
+        _fail("the seeded fault burst never tripped the SLO burn-rate "
+              "gate: %s" % burst)
+    if not burst.get("cleared"):
+        _fail("the SLO violation did not clear after recovery: %s"
+              % burst)
+    viol = res.get("slo_violations") or []
+    if not any(v.get("kind") == "slo.violation" for v in viol):
+        _fail("no structured slo.violation event was recorded: %s" % viol)
+    if not any(v.get("kind") == "slo.clear" for v in viol):
+        _fail("no structured slo.clear event was recorded: %s" % viol)
     return ok
 
 
@@ -842,6 +1011,11 @@ def _check(res, trace_families):
             and res["batching_speedup"] < 2.0:
         _fail("continuous batching speedup %.2fx < 2x over batch-size-1"
               % res["batching_speedup"])
+    if res["mode"] == "engine":
+        eh = res.get("engine_hist") or {}
+        if not eh.get("agree"):
+            _fail("engine-side serving.request histogram p50/p99 disagree "
+                  "with client-side request percentiles: %s" % eh)
     return ok
 
 
@@ -890,6 +1064,10 @@ def main(argv=None):
                     help="chaos/fleet gate: p99 of COMPLETED requests "
                          "must stay under this (default 1500; fleet mode "
                          "4000 — its deadline/shed knobs derive from it)")
+    ap.add_argument("--trace-out", default=None,
+                    help="--fleet: write the merged, clock-aligned fleet "
+                         "chrome trace here (forces trace mode; view "
+                         "with mxtrace or chrome://tracing)")
     ap.add_argument("--json", action="store_true")
     ap.add_argument("--check", action="store_true",
                     help="CI gate: assert qps>0, finite p99, zero "
@@ -901,7 +1079,8 @@ def main(argv=None):
         os.environ["MXNET_SERVE_QUANT"] = args.quant
     from mxnet_tpu import telemetry
 
-    telemetry.set_mode("trace" if args.check else "counters")
+    telemetry.set_mode("trace" if (args.check or args.trace_out)
+                       else "counters")
     if args.p99_bound_ms is None:
         args.p99_bound_ms = 4000.0 if args.fleet else 1500.0
     if args.fleet:
