@@ -18,6 +18,9 @@ Examples::
     python tools/graphlint --dispatch                  # GL7xx host-sync lint
     python tools/graphlint --dispatch mxnet_tpu/serving --format json
     python tools/graphlint --dispatch --trace profile.json   # + GL705
+    python tools/graphlint --concurrency               # GL8xx lock/collective lint
+    python tools/graphlint --concurrency mxnet_tpu/serving --format json
+    python tools/graphlint --concurrency --witness trace.json   # + GL805
 """
 from __future__ import annotations
 
@@ -437,6 +440,74 @@ def _run_dispatch(args, targets) -> int:
     return 1 if failed else 0
 
 
+def _format_concurrency_table(sites) -> str:
+    """The --concurrency per-site table: one row per finding."""
+    rows = [("code", "site", "function", "waived", "finding")]
+    for s in sites:
+        msg = s["message"]
+        if len(msg) > 56:
+            msg = msg[:53] + "..."
+        rows.append((s["code"], "%s:%d" % (s["file"], s["line"]),
+                     s["function"], "waived" if s["waived"] else "-", msg))
+    widths = [max(len(r[i]) for r in rows) for i in range(len(rows[0]))]
+    out = ["== concurrency sites =="]
+    for r in rows:
+        out.append("  ".join(c.ljust(w) for c, w in zip(r, widths)).rstrip())
+    return "\n".join(out)
+
+
+def _run_concurrency(args, targets) -> int:
+    """The --concurrency mode: the source-level concurrency lint
+    (GL801-GL804, analysis/concurrency_lint.py) over Python files and
+    directories. Targets are *paths*; with none given, the default scan
+    surface is the threaded/distributed layer
+    (``concurrency_lint.DEFAULT_SCAN_PATHS``). ``--witness DUMP.json``
+    additionally judges a ``MXNET_CONCLINT=witness`` run: GL805 for every
+    witnessed lock-order inversion or >threshold hold across a dispatch
+    seam (the dump is either a raw ``witness_report()`` JSON or a chrome
+    trace whose ``otherData.lock_witness`` block carries one).
+
+    Waivers (``# graphlint: waive GL80x -- reason``) stay in the site
+    table but do not fail the run. Exit 0 when every static finding is
+    waived (or none) and no GL805 fired; 1 otherwise; 2 on an unreadable
+    path or witness dump."""
+    from .concurrency_lint import (DEFAULT_SCAN_PATHS, lint_lock_witness,
+                                   lint_concurrency_paths)
+
+    try:
+        report, sites = lint_concurrency_paths(targets or None)
+    except OSError as exc:
+        print("graphlint: --concurrency: %s" % exc, file=sys.stderr)
+        return 2
+    witness_diags = []
+    if args.witness:
+        try:
+            with open(args.witness) as f:
+                dump = json.load(f)
+        except (OSError, ValueError) as exc:
+            print("graphlint: cannot load --witness %s: %s"
+                  % (args.witness, exc), file=sys.stderr)
+            return 2
+        if isinstance(dump.get("otherData"), dict):
+            dump = dump["otherData"].get("lock_witness") or {}
+        witness_diags = lint_lock_witness(dump)
+        report.extend(witness_diags)
+    failed = any(not s["waived"] for s in sites) or bool(witness_diags)
+    if args.format == "json":
+        payload = {"target": "concurrency",
+                   "paths": list(targets) or list(DEFAULT_SCAN_PATHS),
+                   "sites": sites,
+                   "witness": [d.to_dict() for d in witness_diags],
+                   "report": json.loads(report.to_json())}
+        print(json.dumps(payload, indent=2))
+    else:
+        print(report.format(min_severity=args.min_severity))
+        if sites:
+            print()
+            print(_format_concurrency_table(sites))
+    return 1 if failed else 0
+
+
 def main(argv=None) -> int:
     ap = argparse.ArgumentParser(
         prog="graphlint",
@@ -483,6 +554,22 @@ def main(argv=None) -> int:
                          "chrome-trace dump — GL705 when a span's measured "
                          "host gap exceeds MXNET_DISPATCHLINT_GAP_PCT of "
                          "its device busy time")
+    ap.add_argument("--concurrency", action="store_true",
+                    help="run the source-level concurrency lint (GL8xx: "
+                         "rank-divergent collectives, unguarded shared "
+                         "state, lock-order inversions, blocking with a "
+                         "lock held) over Python files/dirs instead of "
+                         "Symbol graphs. Targets are paths; default: the "
+                         "threaded/distributed surface. Findings honor "
+                         "'# graphlint: waive GL80x -- reason' comments "
+                         "(docs/static_analysis.md)")
+    ap.add_argument("--witness", default=None, metavar="DUMP.json",
+                    help="with --concurrency: also judge a "
+                         "MXNET_CONCLINT=witness run — GL805 for every "
+                         "witnessed lock-order inversion or >threshold "
+                         "hold across a dispatch seam (raw "
+                         "witness_report() JSON or a chrome trace with an "
+                         "otherData.lock_witness block)")
     ap.add_argument("--rewrite-json", action="store_true",
                     help="with --rewrite: emit the machine-readable plan "
                          "dump as JSON, including the full provenance "
@@ -528,6 +615,9 @@ def main(argv=None) -> int:
 
     if args.dispatch:
         return _run_dispatch(args, list(args.targets))
+
+    if args.concurrency:
+        return _run_concurrency(args, list(args.targets))
 
     targets = list(args.targets)
     if args.all_models:

@@ -19,6 +19,7 @@ Codes are grouped by pass family:
   * ``GL5xx`` — static memory-liveness / peak-HBM planner (``memory_plan.py``)
   * ``GL6xx`` — graph-rewrite provenance verifier (``rewrite.py``)
   * ``GL7xx`` — dispatch-discipline analyzer (``dispatch_lint.py``)
+  * ``GL8xx`` — concurrency analyzer (``concurrency_lint.py``)
 """
 from __future__ import annotations
 
@@ -132,6 +133,22 @@ CODES = {
               "measured dispatch gap: host time between executable return "
               "and next enqueue exceeds the threshold fraction of device "
               "time"),
+    # --- concurrency analyzer (concurrency_lint.py) ------------------------
+    "GL801": (Severity.ERROR,
+              "collective-order divergence: a collective call is "
+              "control-dependent on rank-varying data (cross-rank deadlock)"),
+    "GL802": (Severity.WARNING,
+              "unguarded shared state: attribute mutated from >=2 thread "
+              "contexts with no common lock on every mutating path"),
+    "GL803": (Severity.ERROR,
+              "lock-order inversion: cycle in the static lock-acquisition "
+              "graph"),
+    "GL804": (Severity.WARNING,
+              "blocking call (collective/RPC/timeout-less wait) reached "
+              "while holding a lock"),
+    "GL805": (Severity.WARNING,
+              "witnessed concurrency hazard: real-run lock-order inversion "
+              "or >threshold hold across a dispatch seam"),
 }
 
 

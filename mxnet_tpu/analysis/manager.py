@@ -179,7 +179,8 @@ def run_graph_passes(symbol, shape_hints=None, type_hints=None,
     """
     # passes live in sibling modules registered at import time
     from . import (shape_lint, retrace_guard, fusion_explain,  # noqa: F401
-                   shard_lint, memory_plan, dispatch_lint)  # noqa: F401
+                   shard_lint, memory_plan, dispatch_lint,  # noqa: F401
+                   concurrency_lint)  # noqa: F401
 
     ctx = GraphContext(symbol, shape_hints=shape_hints, type_hints=type_hints,
                        strict_shapes=strict_shapes, mesh=mesh, rules=rules,

@@ -608,13 +608,13 @@ class Checkpointer:
         self.directory = directory
         self.keep = checkpoint_keep() if keep is None else keep
         self.async_ = checkpoint_async() if async_ is None else bool(async_)
-        self._lock = threading.Lock()
+        self._lock = _tm.named_lock("checkpoint.writer")
         self._queued = None      # superseded-able pending job
         self._active = None
         self._thread = None
         self._shutdown = False   # close() in progress; writer loop exits
         self._error = None       # first writer failure; re-raised at next op
-        self._cv = threading.Condition(self._lock)
+        self._cv = _tm.named_condition("checkpoint.writer", self._lock)
         os.makedirs(directory, exist_ok=True)
 
     # ------------------------------------------------------------- lifecycle
@@ -711,7 +711,17 @@ class Checkpointer:
             _tm.counter("checkpoint.saves").inc()
         self._ensure_thread()
         if block:
-            job.done.wait()
+            # bounded wait (GL804 audit): a writer thread that died
+            # without completing the job — hard kill, unhandled crash —
+            # must surface as an error, not hang the training loop
+            while not job.done.wait(5.0):
+                t = self._thread
+                if t is None or not t.is_alive():
+                    with self._cv:
+                        self._raise_pending_error()
+                    raise MXNetError(
+                        "checkpoint writer thread died before step %s "
+                        "completed" % (job.step,))
             with self._cv:
                 self._raise_pending_error()
         return job
@@ -722,7 +732,15 @@ class Checkpointer:
         with _tm.span("checkpoint.wait"):
             with self._cv:
                 while self._queued is not None or self._active is not None:
-                    self._cv.wait()
+                    # bounded (GL804 audit): cv.wait releases _lock, but a
+                    # dead writer would leave work queued forever
+                    if not self._cv.wait(5.0):
+                        t = self._thread
+                        if t is None or not t.is_alive():
+                            self._raise_pending_error()
+                            raise MXNetError(
+                                "checkpoint writer thread died with "
+                                "write(s) still queued")
                 self._raise_pending_error()
 
     def close(self):

@@ -308,6 +308,24 @@ def _pump_loop(fetch, q, stop, end_sentinel):
         q.put(end_token)
 
 
+def _get_bounded(q, threads, what, poll_s=1.0):
+    """``queue.get`` that cannot hang on a dead pump (GL804 audit,
+    docs/static_analysis.md §GL8xx): poll with a timeout and raise once
+    every pump thread is gone while the queue stayed empty — the sentinel
+    guarantee of ``_pump_loop`` was violated (a hard-killed thread), so
+    blocking forever is the only alternative. A slow-but-alive pump just
+    keeps the poll going; steady state never times out."""
+    while True:
+        try:
+            return q.get(timeout=poll_s)
+        except queue.Empty:
+            if not any(t.is_alive() for t in threads):
+                raise MXNetError(
+                    "%s: prefetch pump thread(s) died without terminating "
+                    "their queue — batch stream lost; reset the iterator"
+                    % what)
+
+
 def _drain_and_join(queues, threads, stop, end_sentinel, timeout):
     """The shared bounded teardown: signal stop, drain each queue until
     its sentinel (unblocking a pump stuck on a full queue), then join
@@ -465,10 +483,12 @@ class PrefetchingIter(DataIter):
 
             t0 = _time.perf_counter()
             with _tm.span("io.prefetch_wait"):
-                got = [q.get() for q in self._queues]
+                got = [_get_bounded(q, self._threads, "PrefetchingIter")
+                       for q in self._queues]
             _tm.timer("io.prefetch_wait").add(_time.perf_counter() - t0)
         else:
-            got = [q.get() for q in self._queues]
+            got = [_get_bounded(q, self._threads, "PrefetchingIter")
+                   for q in self._queues]
         for g in got:
             if isinstance(g, BaseException):
                 self._ended = True
@@ -648,10 +668,12 @@ class DevicePrefetchIter(DataIter):
         t0 = _time.perf_counter()
         if _tm.enabled():
             with _tm.span("io.prefetch_wait"):
-                got = self._queue.get()
+                got = _get_bounded(self._queue, (self._thread,),
+                                   "DevicePrefetchIter")
             _tm.timer("io.prefetch_wait").add(_time.perf_counter() - t0)
         else:
-            got = self._queue.get()
+            got = _get_bounded(self._queue, (self._thread,),
+                               "DevicePrefetchIter")
         self.wait_s += _time.perf_counter() - t0
         if isinstance(got, BaseException):
             self._ended = True

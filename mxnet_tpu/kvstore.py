@@ -52,6 +52,9 @@ class KVStore:
         self._optimizer = None
         self._bucket_engine = None  # dist comm engine (kvstore_bucket)
         self._sparse_engine = None  # row-sparse rounds (sparse/kvstore_sparse)
+        # monolithic-path digest window (bucketed path: engine._rounds_done)
+        self._verify_rounds_done = 0
+        self._verify_check_rounds = None  # lazy MXNET_KVSTORE_CHECK_STEPS
 
     # ------------------------------------------------------------------ meta
     @property
@@ -148,6 +151,7 @@ class KVStore:
                 return
             merged_list = [self._reduce_local(vals) for vals in grouped]
             if "dist" in self._type:
+                self._verify_push_round(keys)
                 merged_list = self._allreduce_batch(merged_list)
             for k, merged in zip(keys, merged_list):
                 if self._updater is not None:
@@ -303,6 +307,39 @@ class KVStore:
                     ctx=arrs[i].context)
                 off += n
         return out
+
+    # ------------------------------------------------------------ validation
+    def _verify_push_round(self, keys):
+        """Monolithic-path twin of the bucket engine's first-N round check:
+        before the fused allreduce, allgather a 4-byte digest of this
+        round's key order so rank-dependent pushes fail loudly instead of
+        deadlocking (or silently misreducing) inside the collective. The
+        window re-arms via ``rearm_verify()``/``reform()``."""
+        import jax
+
+        if jax.process_count() == 1:
+            return
+        from .kvstore_bucket import (BucketEngine,
+                                     verify_digest_across_workers)
+
+        if self._verify_check_rounds is None:
+            self._verify_check_rounds = BucketEngine._env_check_rounds()
+        self._verify_rounds_done += 1
+        if self._verify_rounds_done > self._verify_check_rounds:
+            return
+        verify_digest_across_workers(repr(list(keys)),
+                                     self._verify_check_rounds,
+                                     BucketEngine._allgather_digest)
+
+    def rearm_verify(self):
+        """Re-open the collective key-sequence digest window (both the
+        bucketed and monolithic push paths) after anything that can
+        desynchronize the workers' push streams — an elastic ``reform``, a
+        bucket plan change, a manual topology intervention. The next
+        MXNET_KVSTORE_CHECK_STEPS rounds verify again."""
+        self._verify_rounds_done = 0
+        if self._bucket_engine is not None:
+            self._bucket_engine.rearm_verify()
 
     # -------------------------------------------------------------- optimizer
     def set_optimizer(self, optimizer):
@@ -602,6 +639,8 @@ class KVStore:
         _Collective._cache = None  # stale worker mesh must not survive
         if self._bucket_engine is not None:
             self._bucket_engine.reform()
+        # survivors must re-prove push-stream agreement over the new world
+        self.rearm_verify()
 
     def load_sharded_checkpoint(self, root, step=None):
         """Seed stored WEIGHTS and optimizer state from a sharded

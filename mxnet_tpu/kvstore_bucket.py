@@ -56,7 +56,8 @@ from . import telemetry as _tm
 from .ndarray import NDArray
 
 __all__ = ["BucketPlan", "BucketSpec", "Slot", "BucketEngine",
-           "bucket_bytes", "update_mode", "comm_dtype_for"]
+           "bucket_bytes", "update_mode", "comm_dtype_for",
+           "verify_digest_across_workers"]
 
 log = logging.getLogger("mxnet_tpu.kvstore")
 
@@ -487,7 +488,7 @@ class BucketEngine:
         self._round_seq = []
         self._round_t0 = None
         self._round_flushes = []
-        self._rounds_done = 0
+        self.rearm_verify()
         self._mode = update_mode()
         self._mode_reason = None
         self.plan = None
@@ -515,6 +516,9 @@ class BucketEngine:
                  len(records), len(self.plan.buckets),
                  self.plan.bucket_cap / 1e6, self.mode, self.plan.hash[:12])
         self._verify_across_workers("plan:" + self.plan.hash)
+        # a committed plan changes every subsequent round's wire layout:
+        # re-open the first-N digest window over the new plan
+        self.rearm_verify()
         # replay the recorded round through the fresh buckets (bypassing
         # push(): the round sequence already logged these keys)
         recorded, self._recording = self._recording, []
@@ -767,30 +771,23 @@ class BucketEngine:
             self._verify_across_workers(repr(seq))
 
     # ------------------------------------------------------------ validation
+    def rearm_verify(self):
+        """Re-open the first-N digest window: the next
+        MXNET_KVSTORE_CHECK_STEPS rounds allgather-verify the key sequence
+        again. Called after anything that can desynchronize the workers'
+        push streams — an elastic ``reform``, a bucket re-plan — so a
+        divergence the change introduced fails loudly instead of
+        deadlocking inside a later collective."""
+        self._rounds_done = 0
+
     def _verify_across_workers(self, payload: str):
         """Cheap cross-worker agreement check: allgather a 4-byte digest of
         this round's key sequence (or the plan hash) and compare. Catches
         mismatched key sets/orders that would otherwise deadlock or silently
         misreduce inside the collective. Gated to the first
         MXNET_KVSTORE_CHECK_STEPS rounds — steady state costs nothing."""
-        import jax
-
-        if jax.process_count() == 1:
-            return
-        # uint32: jax's 32-bit default would silently truncate a wider
-        # digest inside the allgather and fail the compare on matching keys
-        digest = hashlib.sha1(payload.encode()).digest()[:4]
-        mine = np.frombuffer(digest, dtype=np.uint32)
-        theirs = self._allgather_digest(mine)
-        if not (theirs == mine[0]).all():
-            bad = {int(r): hex(int(v)) for r, v in enumerate(theirs)}
-            raise MXNetError(
-                "dist KVStore workers disagree on the pushed key "
-                "set/order this round (digest by rank: %s). Every worker "
-                "must push the same keys in the same order — check for "
-                "rank-dependent branches around kv.push. (Verified for the "
-                "first %d rounds; set MXNET_KVSTORE_CHECK_STEPS to tune.)"
-                % (bad, self._check_rounds))
+        verify_digest_across_workers(payload, self._check_rounds,
+                                     self._allgather_digest)
 
     @staticmethod
     def _allgather_digest(arr):
@@ -988,3 +985,28 @@ class BucketEngine:
         w_local = jax.device_put(jnp.asarray(w_host), coll.my_device)
         return jax.make_array_from_single_device_arrays(
             (spec.total,), NamedSharding(coll.mesh, P()), [w_local])
+
+
+def verify_digest_across_workers(payload: str, check_rounds: int,
+                                 allgather) -> None:
+    """Allgather a 4-byte sha1 of ``payload`` and require every rank to
+    agree — the shared core of the BucketEngine round/plan checks and the
+    monolithic ``KVStore._verify_push_round`` window."""
+    import jax
+
+    if jax.process_count() == 1:
+        return
+    # uint32: jax's 32-bit default would silently truncate a wider
+    # digest inside the allgather and fail the compare on matching keys
+    digest = hashlib.sha1(payload.encode()).digest()[:4]
+    mine = np.frombuffer(digest, dtype=np.uint32)
+    theirs = allgather(mine)
+    if not (theirs == mine[0]).all():
+        bad = {int(r): hex(int(v)) for r, v in enumerate(theirs)}
+        raise MXNetError(
+            "dist KVStore workers disagree on the pushed key "
+            "set/order this round (digest by rank: %s). Every worker "
+            "must push the same keys in the same order — check for "
+            "rank-dependent branches around kv.push. (Verified for the "
+            "first %d rounds; set MXNET_KVSTORE_CHECK_STEPS to tune.)"
+            % (bad, check_rounds))

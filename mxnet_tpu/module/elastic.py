@@ -371,6 +371,11 @@ class ElasticFit:
                 payload = dist.poll_pause()
             self._pending_pause = payload
         if payload is not None and self._round >= int(payload["pause_at"]):
+            # the pause payload is first-write-wins in the coordination KV
+            # and pause_at carries a full check_interval margin, so every
+            # rank reads the SAME payload before reaching that round: the
+            # branch is rank-uniform by protocol
+            # graphlint: waive GL801 -- pause payload is rank-uniform (above)
             return self._execute_pause(payload, epoch, nbatch)
         return None
 

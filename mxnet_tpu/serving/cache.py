@@ -37,7 +37,7 @@ __all__ = ["PersistentExecutableCache", "serve_cache_dir"]
 
 log = logging.getLogger("mxnet_tpu.serving")
 
-_xla_cache_lock = threading.Lock()
+_xla_cache_lock = _tm.named_lock("serving.cache.xla_compile")
 _xla_cache_dir = None
 
 
@@ -146,8 +146,8 @@ class PersistentExecutableCache:
         # held for the full duration of a warmup compile (+ autotune) — a
         # liveness probe must never block on a compile.
         self._fusion_sites: Dict[tuple, dict] = {}
-        self._sites_lock = threading.Lock()
-        self._lock = threading.RLock()
+        self._sites_lock = _tm.named_lock("serving.cache.sites")
+        self._lock = _tm.named_rlock("serving.cache")
         self._sealed = False
         digest = hashlib.sha1(
             (symbol.tojson() + "|" + self._dtype).encode()).hexdigest()[:16]

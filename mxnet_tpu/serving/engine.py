@@ -262,7 +262,7 @@ class InferenceEngine:
         self.health_window_s = float(health_window_s)
         self.name = name or cache._model_key
         self._queue = deque()
-        self._cond = threading.Condition()
+        self._cond = _tm.named_condition("serving.engine")
         self._stop = False
         self._thread = None
         self._started = False
@@ -316,7 +316,8 @@ class InferenceEngine:
         if warmup:
             self.cache.warmup(self.bucket_shapes())
         self._row_factors = self._output_row_factors()
-        self._stop = False
+        with self._cond:
+            self._stop = False
         self._thread = threading.Thread(target=self._batcher_loop,
                                         name="mxserve-batcher-%s" % self.name,
                                         daemon=True)
@@ -673,6 +674,7 @@ class InferenceEngine:
         return [(t, k) for t, k in self._recent_faults if t >= cutoff]
 
     def _dispatch(self, batch: List[_Request]):
+        _tm.note_dispatch()  # lock-witness seam: holds spanning this stall
         rows = sum(r.rows for r in batch)
         bucket = next(b for b in self.buckets if b >= rows)
         padded = {}

@@ -96,7 +96,7 @@ class ReplicaApp:
         # delta-encoding state for the health() telemetry snapshot: the
         # counter values / histogram buckets already shipped, so each
         # snapshot carries only the increment since the last one
-        self._tel_lock = threading.Lock()
+        self._tel_lock = telemetry.named_lock("fleet.replica.telemetry")
         self._tel_last_counters = {}
         self._tel_last_buckets = {}
 

@@ -163,21 +163,21 @@ class Router:
         self._poll_pending = set()  # rids with an in-flight poll
         self._rr = 0
         self._queue = deque()
-        self._cond = threading.Condition()
+        self._cond = _tm.named_condition("fleet.router.queue")
         self._stop = False
         self._started = False
         self._threads = []
         self._tls = threading.local()
         self._counts = {"submitted": 0, "completed": 0, "shed": 0,
                         "redispatched": 0, "failed": 0}
-        self._rollout_lock = threading.Lock()
+        self._rollout_lock = _tm.named_lock("fleet.router.rollout")
         # ---- fleet observability plane (docs/OBSERVABILITY.md §Fleet)
         self._t_start = None
         # router's own request-latency histogram, recorded regardless of
         # telemetry mode so SLO latency objectives and the metrics()
         # rollup always have truth (one bucket increment per delivery)
         self._req_hist = _hg.Histogram()
-        self._tel_lock = threading.Lock()
+        self._tel_lock = _tm.named_lock("fleet.router.telemetry")
         self._fleet_counters = {}      # folded replica counter deltas
         self._fleet_hists = {}         # timer -> merged sparse buckets
         self._replica_tel = {}         # rid -> {"counters", "dropped"}
@@ -197,7 +197,8 @@ class Router:
     def start(self):
         if self._started:
             return self
-        self._stop = False
+        with self._cond:
+            self._stop = False
         if self._t_start is None:
             self._t_start = time.perf_counter()
         self._poll_pool = ThreadPoolExecutor(
@@ -214,7 +215,8 @@ class Router:
                                  daemon=True)
             w.start()
             self._threads.append(w)
-        self._started = True
+        with self._cond:
+            self._started = True
         return self
 
     def close(self):
@@ -230,10 +232,11 @@ class Router:
                     "dispatched"))
         for t in self._threads:
             t.join(timeout=2.0)
-        if self._poll_pool is not None:
-            self._poll_pool.shutdown(wait=False)
-            self._poll_pool = None
-        self._started = False
+        with self._cond:
+            if self._poll_pool is not None:
+                self._poll_pool.shutdown(wait=False)
+                self._poll_pool = None
+            self._started = False
 
     def __enter__(self):
         return self.start()
@@ -613,7 +616,8 @@ class Router:
                 self._invalidate(rid)
                 if req.redispatches < self.max_redispatch:
                     req.redispatches += 1
-                    self._counts["redispatched"] += 1
+                    with self._cond:
+                        self._counts["redispatched"] += 1
                     if _tm.enabled():
                         _tm.counter("fleet.redispatches").inc()
                     log.info("fleet: re-dispatching after fault on "

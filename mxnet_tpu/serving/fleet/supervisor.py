@@ -105,7 +105,7 @@ class ReplicaSupervisor:
                 os.path.join(self.workdir, "replica-%d.port" % rid),
                 os.path.join(self.workdir, "replica-%d.hb" % rid))
             self._handles.append(h)
-        self._lock = threading.Lock()
+        self._lock = _tm.named_lock("fleet.supervisor")
         self._stop = threading.Event()
         self._monitor = None
         self._started = False

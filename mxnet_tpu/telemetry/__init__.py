@@ -22,8 +22,11 @@ declarative SLOs (``MXNET_SLO``) with multi-window burn rates.
 """
 from __future__ import annotations
 
-from . import histogram, slo
+from . import histogram, lockwitness, slo
 from .histogram import Histogram
+from .lockwitness import (named_condition, named_lock, named_rlock,
+                          note_dispatch, reset_witness, witness_report,
+                          witnessing)
 from .registry import (Counter, Gauge, StepStats, Timer, counter, counters,
                        gauge, hist_buckets, mark_step, reset, snapshot,
                        step_rows, timer)
@@ -50,6 +53,9 @@ __all__ = [
     "dropped_events",
     # trace context (fleet request tracing)
     "set_trace_context", "trace_context", "trace_scope",
+    # lock witness (MXNET_CONCLINT=witness; analysis/concurrency_lint GL805)
+    "lockwitness", "named_lock", "named_rlock", "named_condition",
+    "note_dispatch", "witnessing", "witness_report", "reset_witness",
     # export
     "SCHEMA_VERSION", "build_trace", "export_chrome_trace",
     "gap_summary", "span_summary", "summarize", "merge_traces",

@@ -162,6 +162,53 @@ def gaps_table(trace, top):
           str(r["clamped"])] for r in rows])
 
 
+def locks_table(trace, top=25):
+    """Lock-contention attribution from a ``MXNET_CONCLINT=witness`` run
+    (``otherData.lock_witness``, telemetry/lockwitness.py): top locks by
+    total hold time, with contention counts, waiter time, the >threshold
+    hold count, and the per-thread acquisition split. Witnessed hazards
+    (the GL805 feed) print below the table."""
+    w = (trace.get("otherData") or {}).get("lock_witness")
+    if not w:
+        return "(no lock_witness block — capture with MXNET_CONCLINT=" \
+               "witness to record lock orders and hold times)"
+    rows = sorted(w.get("locks") or [], key=lambda r: -r.get("hold_ms", 0))
+    out = []
+    if rows:
+        out.append(_fmt_table(
+            ["lock", "acqs", "cont", "wait_ms", "hold_ms", "max_hold",
+             "long", "threads"],
+            [[r["name"], str(r["acquisitions"]), str(r["contentions"]),
+              "%.3f" % r["wait_ms"], "%.3f" % r["hold_ms"],
+              "%.3f" % r["max_hold_ms"], str(r["long_holds"]),
+              ",".join("%s:%d" % kv
+                       for kv in sorted((r.get("threads") or {}).items()))]
+             for r in rows[:top]]))
+    else:
+        out.append("(witness enabled but no named lock was acquired)")
+    events = w.get("events") or []
+    inv = [e for e in events if e.get("kind") == "inversion"]
+    holds = [e for e in events if e.get("kind") == "long_hold"]
+    if inv or holds:
+        out.append("")
+        for e in inv:
+            out.append("  INVERSION %s -> %s on %s (reverse order seen "
+                       "%dx) [GL805]" % (e.get("first"), e.get("then"),
+                                         e.get("thread"),
+                                         e.get("prior_count", 1)))
+        for e in holds:
+            out.append("  LONG HOLD %s %.1fms on %s%s%s"
+                       % (e.get("lock"), e.get("hold_ms", 0.0),
+                          e.get("thread"),
+                          " across a dispatch seam"
+                          if e.get("dispatch_seam") else "",
+                          " [GL805]" if e.get("dispatch_seam") else ""))
+    if w.get("events_dropped"):
+        out.append("  (%d witness event(s) dropped — ring full)"
+                   % w["events_dropped"])
+    return "\n".join(out)
+
+
 def _event_trace_ids(ev):
     """trace id(s) stamped on one X event (single or batch form)."""
     args_ = ev.get("args") or {}
@@ -374,6 +421,7 @@ def main(argv=None):
             "gaps": gap_summary(trace=trace, top=args.top),
             "dropped": dropped,
             "fleet": other.get("fleet"),
+            "locks": other.get("lock_witness"),
             "xla_trace_dir": other.get("xla_trace_dir"),
         }))
         return 0
@@ -386,6 +434,10 @@ def main(argv=None):
     print()
     print("== host-gap attribution (span end -> next same-name start) ==")
     print(gaps_table(trace, args.top))
+    if other.get("lock_witness"):
+        print()
+        print("== lock witness (MXNET_CONCLINT=witness) ==")
+        print(locks_table(trace, args.top))
     counters = other.get("counters") or {}
     if counters:
         print()

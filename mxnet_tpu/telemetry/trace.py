@@ -14,7 +14,7 @@ from __future__ import annotations
 import json
 import os
 
-from . import histogram, registry, spans
+from . import histogram, lockwitness, registry, spans
 
 __all__ = ["export_chrome_trace", "summarize", "span_summary",
            "gap_summary", "merge_traces", "SCHEMA_VERSION"]
@@ -58,6 +58,11 @@ def build_trace(xla_trace_dir=None, extra=None):
              "dropped": spans.dropped_events()}
     if xla_trace_dir:
         other["xla_trace_dir"] = os.path.abspath(xla_trace_dir)
+    if lockwitness.witnessing():
+        # MXNET_CONCLINT=witness: ship the lock-contention/inversion record
+        # with the trace so mxtrace renders the table and
+        # `graphlint --concurrency --witness dump.json` can judge it (GL805)
+        other["lock_witness"] = lockwitness.witness_report()
     if extra:
         other.update(extra)
     return {"traceEvents": events, "displayTimeUnit": "ms",

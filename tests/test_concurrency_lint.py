@@ -1,0 +1,251 @@
+"""Lock-witness runtime (telemetry/lockwitness.py) + GL805 wiring.
+
+The static GL801-GL804 trigger/clean pairs live in test_graphlint.py next
+to the other code-case tables; this file covers the MEASURED side: the
+seeded two-thread races the witness must catch, the mode gate, and the
+witness -> trace -> mxtrace/graphlint plumbing."""
+import json
+import threading
+import time
+
+import pytest
+
+from mxnet_tpu.analysis.concurrency_lint import lint_lock_witness
+from mxnet_tpu.telemetry import lockwitness as lw
+
+
+@pytest.fixture
+def witness():
+    lw.set_mode("witness")
+    lw.reset_witness()
+    yield lw
+    lw.set_mode(None)
+    lw.reset_witness()
+
+
+# ------------------------------------------------------------- mode gate
+
+def test_off_mode_returns_plain_primitives(monkeypatch):
+    monkeypatch.delenv("MXNET_CONCLINT", raising=False)
+    lw.set_mode(None)
+    assert not lw.witnessing()
+    assert isinstance(lw.named_lock("x"), type(threading.Lock()))
+    assert isinstance(lw.named_rlock("x"), type(threading.RLock()))
+    assert isinstance(lw.named_condition("x"), threading.Condition)
+
+
+def test_env_arms_witness(monkeypatch):
+    lw.set_mode(None)
+    monkeypatch.setenv("MXNET_CONCLINT", "witness")
+    assert lw.witnessing()
+    monkeypatch.setenv("MXNET_CONCLINT", "off")
+    assert not lw.witnessing()
+
+
+# ------------------------------------------- seeded races (the acceptance)
+
+def test_witness_catches_seeded_two_thread_inversion(witness):
+    """The ISSUE acceptance repro: T1 takes a->b, T2 takes b->a. The
+    interleaving is SEQUENCED (no actual deadlock) — the witness must
+    still report the order inversion, and GL805 must fire on it."""
+    a, b = lw.named_lock("repro.a"), lw.named_lock("repro.b")
+    t1_done = threading.Event()
+
+    def t1():
+        with a:
+            with b:
+                pass
+        t1_done.set()
+
+    def t2():
+        t1_done.wait(5.0)
+        with b:
+            with a:
+                pass
+
+    th1 = threading.Thread(target=t1)
+    th2 = threading.Thread(target=t2)
+    th1.start(); th2.start()
+    th1.join(5.0); th2.join(5.0)
+    rep = lw.witness_report()
+    inv = [e for e in rep["events"] if e["kind"] == "inversion"]
+    assert inv, rep["events"]
+    assert {inv[0]["first"], inv[0]["then"]} == {"repro.a", "repro.b"}
+    diags = lint_lock_witness(rep)
+    assert [d.code for d in diags] == ["GL805"]
+    assert "inversion" in diags[0].message
+
+
+def test_witness_long_hold_across_dispatch_seam(witness, monkeypatch):
+    monkeypatch.setenv("MXNET_CONCLINT_HOLD_MS", "5")
+    lk = lw.named_lock("repro.hold")
+    with lk:
+        lw.note_dispatch()
+        time.sleep(0.02)
+    rep = lw.witness_report()
+    holds = [e for e in rep["events"] if e["kind"] == "long_hold"]
+    assert holds and holds[0]["dispatch_seam"]
+    assert [d.code for d in lint_lock_witness(rep)] == ["GL805"]
+
+
+def test_long_hold_without_seam_is_not_gl805(witness, monkeypatch):
+    monkeypatch.setenv("MXNET_CONCLINT_HOLD_MS", "5")
+    lk = lw.named_lock("repro.hostwork")
+    with lk:
+        time.sleep(0.02)
+    rep = lw.witness_report()
+    holds = [e for e in rep["events"] if e["kind"] == "long_hold"]
+    assert holds and not holds[0]["dispatch_seam"]
+    assert lint_lock_witness(rep) == []
+
+
+def test_same_order_twice_is_not_an_inversion(witness):
+    a, b = lw.named_lock("ok.a"), lw.named_lock("ok.b")
+    for _ in range(2):
+        with a:
+            with b:
+                pass
+    rep = lw.witness_report()
+    assert not [e for e in rep["events"] if e["kind"] == "inversion"]
+    assert lint_lock_witness(rep) == []
+
+
+# ----------------------------------------------------- stats / primitives
+
+def test_contention_and_hold_stats(witness):
+    lk = lw.named_lock("stats.l")
+    started = threading.Event()
+    release = threading.Event()
+
+    def holder():
+        with lk:
+            started.set()
+            release.wait(5.0)
+
+    th = threading.Thread(target=holder)
+    th.start()
+    started.wait(5.0)
+    got = lk.acquire(timeout=0.05)   # contended probe
+    if got:
+        lk.release()
+    release.set()
+    th.join(5.0)
+    with lk:
+        pass
+    row = next(r for r in lw.witness_report()["locks"]
+               if r["name"] == "stats.l")
+    assert row["acquisitions"] >= 2
+    assert row["contentions"] >= 1
+    assert row["hold_ms"] >= 0.0
+    assert len(row["threads"]) >= 2
+
+
+def test_witness_rlock_reentrancy(witness):
+    rl = lw.named_rlock("re.l")
+    with rl:
+        with rl:
+            assert rl._is_owned()
+    row = next(r for r in lw.witness_report()["locks"]
+               if r["name"] == "re.l")
+    # the reentrant inner acquire is not a second top-level acquisition
+    assert row["acquisitions"] == 1
+
+
+def test_witness_condition_wait_notify(witness):
+    lk = lw.named_lock("cv.l")
+    cv = lw.named_condition("cv.l", lk)
+    fired = []
+
+    def waiter():
+        with lk:
+            while not fired:
+                if not cv.wait(5.0):
+                    return
+
+    th = threading.Thread(target=waiter)
+    th.start()
+    time.sleep(0.01)
+    with lk:
+        fired.append(1)
+        cv.notify_all()
+    th.join(5.0)
+    assert not th.is_alive()
+
+
+def test_reset_witness_clears_everything(witness):
+    with lw.named_lock("reset.l"):
+        pass
+    lw.reset_witness()
+    rep = lw.witness_report()
+    assert rep["locks"] == [] and rep["events"] == []
+
+
+# ------------------------------------------------------------- plumbing
+
+def test_trace_embeds_lock_witness_block(witness):
+    from mxnet_tpu.telemetry.trace import build_trace
+
+    with lw.named_lock("trace.l"):
+        pass
+    dump = build_trace()
+    block = dump["otherData"]["lock_witness"]
+    assert block["enabled"]
+    assert any(r["name"] == "trace.l" for r in block["locks"])
+
+
+def test_mxtrace_locks_table_renders(witness):
+    from mxnet_tpu.telemetry.cli import locks_table
+    from mxnet_tpu.telemetry.trace import build_trace
+
+    with lw.named_lock("tbl.l"):
+        pass
+    out = locks_table(build_trace())
+    assert "tbl.l" in out
+    assert "hold_ms" in out
+    # a dump captured without the witness explains itself
+    assert "MXNET_CONCLINT" in locks_table({"otherData": {}})
+
+
+def test_graphlint_witness_flag_judges_a_dump(witness, tmp_path,
+                                              capsys):
+    from mxnet_tpu.analysis.cli import main
+    from mxnet_tpu.telemetry.trace import build_trace
+
+    a, b = lw.named_lock("cli.a"), lw.named_lock("cli.b")
+    with a:
+        with b:
+            pass
+    with b:
+        with a:
+            pass
+    dump = tmp_path / "trace.json"
+    dump.write_text(json.dumps(build_trace()))
+    empty = tmp_path / "empty"
+    empty.mkdir()
+    rc = main(["--concurrency", "--witness", str(dump), "--format",
+               "json", str(empty)])
+    # the target dir has no .py files; the witness GL805 alone fails it
+    out = json.loads(capsys.readouterr().out)
+    assert rc == 1
+    assert [d["code"] for d in out["witness"]] == ["GL805"]
+
+
+def test_bindtime_pass_surfaces_gl805_when_witnessing(witness,
+                                                      monkeypatch):
+    monkeypatch.setenv("MXNET_CONCLINT_HOLD_MS", "5")
+    lk = lw.named_lock("pass.l")
+    with lk:
+        lw.note_dispatch()
+        time.sleep(0.02)
+    import mxnet_tpu as mx
+    from mxnet_tpu import analysis
+
+    net = mx.models.get_symbol("mlp", num_classes=10)
+    report = analysis.lint(net, shapes={"data": (8, 784)},
+                           passes=["concurrency_lint"], target="witness")
+    assert "GL805" in report.codes()
+    # off-witness the pass is silent regardless of recorded state
+    lw.set_mode(None)
+    report = analysis.lint(net, shapes={"data": (8, 784)},
+                           passes=["concurrency_lint"], target="off")
+    assert report.codes() == []

@@ -797,9 +797,247 @@ def test_graph_gl703_fires_on_tokenless_decode_symbol_only():
     assert "GL703" not in _codes(headed, shapes=sh)
 
 
+# --------------------------------------------------------------------------
+# concurrency codes (GL8xx): source snippets through the AST lint
+# (GL801-GL804), witness dumps through the measured lint (GL805)
+# --------------------------------------------------------------------------
+from mxnet_tpu.analysis import concurrency_lint  # noqa: E402
+
+_GL801_BROKEN = """
+import jax
+
+def step(kv):
+    if jax.process_index() == 0:
+        kv.allreduce([1])
+"""
+
+# guarding on world SIZE is rank-uniform — the correct idiom, not divergence
+_GL801_CLEAN = """
+import jax
+
+def step(kv):
+    if jax.process_count() > 1:
+        kv.allreduce([1])
+"""
+
+_GL802_BROKEN = """
+import threading
+
+class Srv:
+    def __init__(self):
+        self._lock = threading.Lock()
+        self.count = 0
+        self._t = threading.Thread(target=self._loop)
+
+    def _loop(self):
+        self.count += 1
+
+    def bump(self):
+        with self._lock:
+            self.count += 1
+"""
+
+_GL802_CLEAN = """
+import threading
+
+class Srv:
+    def __init__(self):
+        self._lock = threading.Lock()
+        self.count = 0
+        self._t = threading.Thread(target=self._loop)
+
+    def _loop(self):
+        with self._lock:
+            self.count += 1
+
+    def bump(self):
+        with self._lock:
+            self.count += 1
+"""
+
+_GL803_BROKEN = """
+import threading
+
+class Srv:
+    def __init__(self):
+        self._a = threading.Lock()
+        self._b = threading.Lock()
+
+    def one(self):
+        with self._a:
+            with self._b:
+                pass
+
+    def two(self):
+        with self._b:
+            with self._a:
+                pass
+"""
+
+_GL803_CLEAN = """
+import threading
+
+class Srv:
+    def __init__(self):
+        self._a = threading.Lock()
+        self._b = threading.Lock()
+
+    def one(self):
+        with self._a:
+            with self._b:
+                pass
+
+    def two(self):
+        with self._a:
+            with self._b:
+                pass
+"""
+
+_GL804_BROKEN = """
+import threading
+
+class Srv:
+    def __init__(self):
+        self._lock = threading.Lock()
+        self._q = None
+
+    def drain(self):
+        with self._lock:
+            return self._q.get()
+"""
+
+# cond.wait() on a condition backed by the held lock RELEASES it — exempt
+_GL804_CLEAN = """
+import threading
+
+class Srv:
+    def __init__(self):
+        self._lock = threading.Lock()
+        self._cv = threading.Condition(self._lock)
+        self._q = None
+
+    def drain(self):
+        with self._lock:
+            self._cv.wait()
+        return self._q.get()
+"""
+
+_GL804_WAIVED = """
+import threading
+
+class Srv:
+    def __init__(self):
+        self._lock = threading.Lock()
+        self._q = None
+
+    def drain(self):
+        with self._lock:
+            return self._q.get()  # graphlint: waive GL804 -- bounded producer
+"""
+
+
+def _cl_codes(src):
+    return {f.code for f in
+            concurrency_lint.lint_concurrency_source("<case>", text=src)}
+
+
+def _gl805_witness(seam):
+    return {"enabled": True, "threshold_ms": 50.0,
+            "events": [{"kind": "long_hold", "lock": "serving.engine",
+                        "hold_ms": 80.0, "threshold_ms": 50.0,
+                        "thread": "T", "dispatch_seam": seam}]}
+
+
+def _gl805_codes(seam):
+    return {d.code for d in concurrency_lint.lint_lock_witness(
+        _gl805_witness(seam))}
+
+
+CONCURRENCY_CODE_CASES = {
+    "GL801": (lambda: _cl_codes(_GL801_BROKEN),
+              lambda: _cl_codes(_GL801_CLEAN)),
+    "GL802": (lambda: _cl_codes(_GL802_BROKEN),
+              lambda: _cl_codes(_GL802_CLEAN)),
+    "GL803": (lambda: _cl_codes(_GL803_BROKEN),
+              lambda: _cl_codes(_GL803_CLEAN)),
+    "GL804": (lambda: _cl_codes(_GL804_BROKEN),
+              lambda: _cl_codes(_GL804_CLEAN)),
+    # measured: a >threshold hold ACROSS a dispatch seam fires; the same
+    # hold with no seam stays in the contention table only
+    "GL805": (lambda: _gl805_codes(True), lambda: _gl805_codes(False)),
+}
+
+
+@pytest.mark.parametrize("code", sorted(CONCURRENCY_CODE_CASES))
+def test_concurrency_code_triggers_on_broken_source(code):
+    assert code in CONCURRENCY_CODE_CASES[code][0]()
+
+
+@pytest.mark.parametrize("code", sorted(CONCURRENCY_CODE_CASES))
+def test_concurrency_code_silent_on_clean_source(code):
+    assert code not in CONCURRENCY_CODE_CASES[code][1]()
+
+
+def test_concurrency_waived_site_reported_but_not_failing():
+    findings = concurrency_lint.lint_concurrency_source(
+        "<case>", text=_GL804_WAIVED)
+    f = next(f for f in findings if f.code == "GL804")
+    assert f.waived
+    d = f.to_diagnostic()
+    assert d.severity == "info"
+    assert d.message.endswith("[waived]")
+    g = next(f for f in concurrency_lint.lint_concurrency_source(
+        "<case>", text=_GL804_BROKEN) if f.code == "GL804")
+    assert not g.waived
+    assert g.to_diagnostic().severity == "warning"
+
+
+def test_concurrency_family_waiver_covers_every_gl8xx_code():
+    src = _GL801_BROKEN.replace(
+        "kv.allreduce([1])",
+        "kv.allreduce([1])  # graphlint: waive GL8xx -- family waiver")
+    findings = concurrency_lint.lint_concurrency_source("<case>", text=src)
+    waived_lines = {f.line for f in findings if f.waived}
+    assert waived_lines, [f.to_dict() for f in findings]
+
+
+def test_gl801_except_handler_is_rank_varying():
+    """A collective inside a caught-exception branch diverges: which rank
+    raises (and what) is runtime-local."""
+    src = """
+def step(kv):
+    try:
+        risky()
+    except Exception:
+        kv._barrier()
+"""
+    assert "GL801" in _cl_codes(src)
+
+
+def test_gl801_provenance_names_the_divergent_read():
+    findings = concurrency_lint.lint_concurrency_source(
+        "<case>", text=_GL801_BROKEN)
+    f = next(f for f in findings if f.code == "GL801")
+    assert any("process_index" in p for p in f.provenance), f.provenance
+
+
+def test_repo_concurrency_scan_is_clean_or_waived():
+    """Acceptance: the default-surface scan exits clean — every finding on
+    the real tree fixed or carrying a waive reason (the CI repo gate)."""
+    report, sites = concurrency_lint.lint_concurrency_paths()
+    unwaived = [s for s in sites if not s["waived"]]
+    assert not unwaived, unwaived
+    # the known protocol-level GL801 in the elastic pause path stays
+    # visible as a waived site (the docs worked example)
+    assert any(s["code"] == "GL801"
+               and s["file"].endswith("module/elastic.py")
+               for s in sites), sites
+
+
 def test_every_diagnostic_code_is_tested():
     covered = (set(GRAPH_CODE_CASES) | set(ENGINE_CODE_CASES) | {"GL105"}
-               | set(REWRITE_CODE_CASES) | set(DISPATCH_CODE_CASES))
+               | set(REWRITE_CODE_CASES) | set(DISPATCH_CODE_CASES)
+               | set(CONCURRENCY_CODE_CASES))
     assert covered == set(CODES), (
         "codes missing a trigger/clean test pair: %s; stale test entries: %s"
         % (sorted(set(CODES) - covered), sorted(covered - set(CODES))))

@@ -267,6 +267,123 @@ def test_key_match_passes(monkeypatch):
     eng._verify_across_workers("round:[('w1', (4,), 'float32')]")  # no raise
 
 
+# ----------------------------------------------- digest window re-arm (PR 19)
+def _digest_eng(monkeypatch, delta):
+    """Skeleton engine whose allgathered digests differ by ``delta`` across
+    the two fake workers; just enough state for ``_close_round``."""
+    import jax
+
+    eng = BucketEngine.__new__(BucketEngine)
+    eng._check_rounds = 2
+    eng._rounds_done = 0
+    eng._round_flushes = []
+    eng._ticked = set()
+    monkeypatch.setattr(jax, "process_count", lambda: 2)
+    monkeypatch.setattr(BucketEngine, "_allgather_digest",
+                        staticmethod(lambda arr: np.array(
+                            [arr[0], arr[0] + delta], dtype=arr.dtype)))
+    return eng
+
+
+def _close_one_round(eng):
+    eng._round_t0 = 1.0
+    eng._round_seq = [("w1", (4,), "float32")]
+    eng._round_flushes = []
+    eng._close_round()
+
+
+def test_digest_window_closes_then_rearms(monkeypatch):
+    """The first-N verify window goes quiet after N rounds; rearm_verify()
+    must re-open it so a post-reform/replan divergence still fails loudly
+    instead of deadlocking in the collective."""
+    eng = _digest_eng(monkeypatch, delta=1)  # every verify would raise
+    eng._rounds_done = eng._check_rounds     # window already spent
+    _close_one_round(eng)                    # past window: digest not checked
+    eng.rearm_verify()
+    assert eng._rounds_done == 0
+    with pytest.raises(MXNetError, match="disagree on the pushed key"):
+        _close_one_round(eng)                # window re-opened: raises again
+
+
+def test_digest_window_counts_rounds(monkeypatch):
+    eng = _digest_eng(monkeypatch, delta=0)  # digests agree
+    for _ in range(5):
+        _close_one_round(eng)
+    assert eng._rounds_done == 5             # silent past the window
+    # divergence introduced AFTER the window closed goes unseen (that is
+    # the window's bargain) ...
+    monkeypatch.setattr(BucketEngine, "_allgather_digest",
+                        staticmethod(lambda arr: np.array(
+                            [arr[0], arr[0] + 1], dtype=arr.dtype)))
+    _close_one_round(eng)
+    # ... unless something re-arms the window
+    eng.rearm_verify()
+    with pytest.raises(MXNetError, match="disagree on the pushed key"):
+        _close_one_round(eng)
+
+
+def test_monolithic_push_round_verify_and_rearm(monkeypatch):
+    """KVStore._verify_push_round (monolithic path) mirrors the engine
+    window: verify first N rounds, go quiet, re-arm on rearm_verify()."""
+    import jax
+
+    from mxnet_tpu.kvstore import KVStore
+
+    kv = KVStore.__new__(KVStore)
+    kv._verify_rounds_done = 0
+    kv._verify_check_rounds = None
+    kv._bucket_engine = None
+    monkeypatch.setattr(jax, "process_count", lambda: 2)
+    monkeypatch.setattr(BucketEngine, "_env_check_rounds",
+                        staticmethod(lambda: 2))
+    monkeypatch.setattr(BucketEngine, "_allgather_digest",
+                        staticmethod(lambda arr: np.array(
+                            [arr[0], arr[0]], dtype=arr.dtype)))
+    kv._verify_push_round(["w1", "w2"])      # rounds 1-2: inside window
+    kv._verify_push_round(["w1", "w2"])
+    monkeypatch.setattr(BucketEngine, "_allgather_digest",
+                        staticmethod(lambda arr: np.array(
+                            [arr[0], arr[0] + 1], dtype=arr.dtype)))
+    kv._verify_push_round(["w1", "w2"])      # round 3: window spent, silent
+    kv.rearm_verify()
+    with pytest.raises(MXNetError, match="disagree on the pushed key"):
+        kv._verify_push_round(["w1", "w2"])  # re-armed: divergence caught
+
+
+def test_kvstore_rearm_propagates_to_engine():
+    from mxnet_tpu.kvstore import KVStore
+
+    class _Eng:
+        rearmed = 0
+
+        def rearm_verify(self):
+            self.rearmed += 1
+
+    kv = KVStore.__new__(KVStore)
+    kv._verify_rounds_done = 9
+    kv._verify_check_rounds = 3
+    kv._bucket_engine = _Eng()
+    kv.rearm_verify()
+    assert kv._verify_rounds_done == 0
+    assert kv._bucket_engine.rearmed == 1
+
+
+def test_reform_rearms_digest_window(monkeypatch):
+    """The ISSUE 19 acceptance: after an elastic reform the survivors must
+    re-prove push-stream agreement — reform() re-opens both windows."""
+    from mxnet_tpu.kvstore import KVStore
+
+    kv = KVStore.__new__(KVStore)
+    kv._type = "dist_sync"
+    kv._verify_rounds_done = 7
+    kv._verify_check_rounds = 3
+    kv._bucket_engine = None
+    monkeypatch.setattr(KVStore, "_set_elastic_state",
+                        lambda self, state: None)
+    kv.reform()
+    assert kv._verify_rounds_done == 0
+
+
 # ---------------------------------------------------------- topo priorities
 def test_param_priorities_follow_topo_order():
     sym = mx.sym.Variable("data")

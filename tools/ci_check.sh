@@ -221,6 +221,16 @@ print("dispatch source gate OK: %d sites (%d waived); kv_decode anchors %s"
 PYEOF
 rm -f "$DISPATCH_SCAN"
 
+# GL8xx concurrency repo gate (docs/static_analysis.md §GL8xx): the static
+# lint over the threaded/distributed surface must be clean — every finding
+# fixed or carrying a '# graphlint: waive GL80x -- reason'. Exit 1 means an
+# unwaived finding (a new rank-divergent collective, unguarded shared
+# attribute, lock-order cycle, or blocking-while-locked site) slipped in.
+JAX_PLATFORMS=cpu python tools/graphlint --concurrency --format json \
+    > /dev/null \
+    || { echo "graphlint --concurrency FAILED (unwaived GL8xx)"; exit 1; }
+echo "concurrency source gate OK (zero unwaived GL8xx)"
+
 echo "== [2/10] source lint (pinned ruff, src_lint.py fallback — always on) =="
 # the rule set is pinned in ruff.toml; when ruff is absent (the CI image
 # ships no third-party linters and must not pip install) the
@@ -426,8 +436,10 @@ python tools/serve_bench.py --model transformer-decode --qps 16 \
 # mid-run hitless reload(); the gate asserts zero hung futures (every
 # request reaches a terminal state), zero post-warmup retraces/compiles,
 # the reload applied, p99 of completed requests in bound, and the engine
-# back to `healthy` once injection stops
-JAX_PLATFORMS=cpu MXNET_DEFAULT_CONTEXT=cpu \
+# back to `healthy` once injection stops. MXNET_CONCLINT=witness arms the
+# lock witness for the run: serve_bench additionally fails on any GL805
+# (witnessed lock-order inversion / >threshold hold across a dispatch seam)
+JAX_PLATFORMS=cpu MXNET_DEFAULT_CONTEXT=cpu MXNET_CONCLINT=witness \
 python tools/serve_bench.py --model mlp --chaos --qps 150 --duration 2 \
     --check \
     || { echo "serve_bench chaos smoke FAILED"; exit 1; }

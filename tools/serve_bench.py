@@ -1109,6 +1109,19 @@ def main(argv=None):
             families = {e[0] for e in telemetry.drain_events()}
             ok = _check(res, families)
         res["check"] = "ok" if ok else "FAILED"
+    if telemetry.witnessing():
+        # MXNET_CONCLINT=witness run: the bench doubles as the GL805 race
+        # gate — any witnessed lock-order inversion or dispatch-seam hold
+        # fails the run (tools/ci_check.sh chaos smoke)
+        from mxnet_tpu.analysis.concurrency_lint import lint_lock_witness
+
+        witness_diags = lint_lock_witness(telemetry.witness_report())
+        res["gl805"] = [d.message for d in witness_diags]
+        if witness_diags:
+            ok = False
+            for d in witness_diags:
+                sys.stderr.write("serve_bench witness GL805: %s\n"
+                                 % d.message)
     if args.json or args.check:
         print(json.dumps(res))
     else:
