@@ -844,7 +844,10 @@ def _bench_checkpoint():
 def _bench_serving():
     """Serving leg (docs/SERVING.md): QPS + p99 under a fixed open-loop
     load for lenet/mlp, continuous-batching-vs-batch-1 saturation speedup
-    on mlp, the transformer KV-cache decode rate, and the FLEET leg — a
+    on mlp, the transformer KV-cache decode rate, the shared-prefix
+    cache + speculative-decoding leg (zipf workload: hit rate, prefill
+    FLOPs saved, accepted-draft rate, p50/p99 vs the prefix-off
+    baseline), and the FLEET leg — a
     4-replica router run under the seeded chaos plan (kill-one + mid-run
     rollout) recording aggregate QPS / p99 / redispatches / restarts next
     to its single-replica closed-loop baseline (docs/SERVING.md §Fleet).
@@ -858,6 +861,8 @@ def _bench_serving():
         "transformer_decode": ["--model", "transformer-decode", "--qps",
                                "30", "--duration", "2", "--rows", "4",
                                "--megastep-k", "8"],
+        "prefix_spec": ["--model", "transformer-decode", "--workload",
+                        "zipf-prefix", "--qps", "20", "--duration", "2"],
         "fleet": ["--model", "mlp", "--fleet", "--fleet-replicas", "4",
                   "--qps", "80", "--duration", "3"],
     }
@@ -884,7 +889,7 @@ def _bench_serving():
                      "qps_single_replica_closed", "replicas",
                      "redispatches", "replica_restarts", "paged_kv",
                      "host_gap_ms", "host_gap_per_token", "host_argmax",
-                     "megastep")
+                     "megastep", "workload", "prefix", "spec")
                     if rec.get(k) is not None}
             if name == "fleet":
                 keep["resolved"] = rec.get("resolved")

@@ -209,7 +209,8 @@ def get_prefill_symbol(vocab_size=32000, num_layers=6, num_heads=8,
 
 def get_decode_symbol(vocab_size=32000, num_layers=6, num_heads=8,
                       model_dim=512, ffn_dim=2048, max_len=64, pos_len=None,
-                      per_stream_slots=False, token_out=True, **kwargs):
+                      per_stream_slots=False, global_slots=False,
+                      token_out=True, **kwargs):
     """Serving single-token decode graph (docs/SERVING.md): ONE token per
     stream through the ``get_symbol`` stack, attending over a preallocated
     ring KV buffer of ``max_len`` slots per layer. Compiles ONCE — every
@@ -242,6 +243,20 @@ def get_decode_symbol(vocab_size=32000, num_layers=6, num_heads=8,
     non-contiguous page placement relies on. The math per lane is
     identical to the shared-slot graph at the same position.
 
+    ``global_slots=True`` (implies per-stream staging) is the
+    SHARED-POOL variant behind the copy-on-write prefix cache
+    (docs/SERVING.md §Prefix cache): the KV buffers collapse from one
+    ring per lane to ONE global slot axis — ``kv_k_i``/``kv_v_i`` become
+    (H, max_len, dh) with ``max_len`` now the TOTAL pool slots — and
+    ``slot_onehot``/``kv_mask`` stay (B, max_len) over that shared axis.
+    Every lane's write is summed into the one pool (lane onehots are
+    disjoint by construction — the page allocator hands a frame to one
+    writer at a time), and every lane attends the whole pool under its
+    own additive mask, so N lanes can read the SAME physical page: that
+    is what makes a shared prefix page a refcount instead of a copy.
+    Masked empty slots contribute exp(-1e9)=0 exactly, so per-lane math
+    is unchanged from the per-lane-ring variant at equal positions.
+
     T=1 collapses attention to a masked weighted sum, so it is composed
     from broadcast primitives (scores = Σ_d q·k, softmax, Σ_s p·v) instead
     of the fused MultiHeadAttention op — same math, fp32-exact against the
@@ -273,13 +288,21 @@ def get_decode_symbol(vocab_size=32000, num_layers=6, num_heads=8,
     pos_idx = sym.Variable("pos_idx")
     oh = sym.Variable("slot_onehot")
     msk = sym.Variable("kv_mask")
-    if per_stream_slots:
+    if per_stream_slots or global_slots:
         oh4 = sym.Reshape(oh, shape=(-1, 1, max_len, 1))
         msk3 = sym.Reshape(msk, shape=(-1, 1, max_len))
     else:
         oh4 = sym.Reshape(oh, shape=(1, 1, max_len, 1))
         msk3 = sym.Reshape(msk, shape=(1, 1, max_len))
-    keep4 = 1.0 - oh4
+    if global_slots:
+        # every lane's write folds into the ONE pool: sum the per-lane
+        # onehots over the batch axis (disjoint slots, so the sum is
+        # still 0/1) for the keep mask, and sum the per-lane writes below
+        keep3 = 1.0 - sym.Reshape(sym.sum(oh, axis=0),
+                                  shape=(1, max_len, 1))
+        keep4 = None
+    else:
+        keep4 = 1.0 - oh4
     emb = sym.Embedding(data=data, input_dim=vocab_size,
                         output_dim=model_dim, name="embed")
     posrow = sym.Embedding(data=pos_idx, input_dim=pos_len,
@@ -294,17 +317,32 @@ def get_decode_symbol(vocab_size=32000, num_layers=6, num_heads=8,
         q, k_new, v_new = _split_fused(qkv, 3, 1, num_heads, dh)
         kv_k = sym.Variable("kv_k_%d" % i)
         kv_v = sym.Variable("kv_v_%d" % i)
-        k_upd = sym.broadcast_add(sym.broadcast_mul(kv_k, keep4),
-                                  sym.broadcast_mul(k_new, oh4),
-                                  name="%s_kupd" % name)
-        v_upd = sym.broadcast_add(sym.broadcast_mul(kv_v, keep4),
-                                  sym.broadcast_mul(v_new, oh4),
-                                  name="%s_vupd" % name)
-        kv_outs += [k_upd, v_upd]
-        scores = sym.sum(sym.broadcast_mul(q, k_upd), axis=3) * scale
+        if global_slots:
+            # pool buffers are (H, S, dh): blend each lane's (B,H,1,dh)
+            # new K/V into its onehot slot, summed over lanes (slots are
+            # writer-disjoint, so the sum IS the scatter)
+            wr_k = sym.sum(sym.broadcast_mul(k_new, oh4), axis=0)
+            wr_v = sym.sum(sym.broadcast_mul(v_new, oh4), axis=0)
+            k_upd = sym.broadcast_add(sym.broadcast_mul(kv_k, keep3),
+                                      wr_k, name="%s_kupd" % name)
+            v_upd = sym.broadcast_add(sym.broadcast_mul(kv_v, keep3),
+                                      wr_v, name="%s_vupd" % name)
+            kv_outs += [k_upd, v_upd]
+            k_att = sym.Reshape(k_upd, shape=(-1, num_heads, max_len, dh))
+            v_att = sym.Reshape(v_upd, shape=(-1, num_heads, max_len, dh))
+        else:
+            k_upd = sym.broadcast_add(sym.broadcast_mul(kv_k, keep4),
+                                      sym.broadcast_mul(k_new, oh4),
+                                      name="%s_kupd" % name)
+            v_upd = sym.broadcast_add(sym.broadcast_mul(kv_v, keep4),
+                                      sym.broadcast_mul(v_new, oh4),
+                                      name="%s_vupd" % name)
+            kv_outs += [k_upd, v_upd]
+            k_att, v_att = k_upd, v_upd
+        scores = sym.sum(sym.broadcast_mul(q, k_att), axis=3) * scale
         scores = sym.broadcast_add(scores, msk3)  # (B, H, S)
         p = sym.softmax(scores, axis=-1)
-        ctx = sym.sum(sym.broadcast_mul(sym.expand_dims(p, axis=3), v_upd),
+        ctx = sym.sum(sym.broadcast_mul(sym.expand_dims(p, axis=3), v_att),
                       axis=2)  # (B, H, dh)
         att = sym.Reshape(
             sym.SwapAxis(sym.Reshape(ctx, shape=(-1, num_heads, 1, dh)),
@@ -322,6 +360,120 @@ def get_decode_symbol(vocab_size=32000, num_layers=6, num_heads=8,
     if token_out:
         outs.append(sym.argmax(logits, axis=-1, name="greedy_token"))
     return sym.Group(outs)
+
+
+def get_chunk_symbol(vocab_size=32000, num_layers=6, num_heads=8,
+                     model_dim=512, ffn_dim=2048, chunk_len=8,
+                     total_slots=64, pos_len=64, token_out=True, **kwargs):
+    """Rectangular T-token chunk graph over the GLOBAL paged slot pool
+    (docs/SERVING.md §Prefix cache & speculative decoding): ONE lane's
+    next ``chunk_len`` positions scored — and optionally written — in a
+    single dispatch. This is both the chunked-prefill program (admit
+    computes only the un-cached tail of a prompt, chunk by chunk) and the
+    speculative VERIFY program (the target model scores all γ+1 draft
+    positions at once) — same symbol, different T.
+
+    Inputs beyond the weights:
+      - ``data`` (1, T): the chunk's token ids (pad rows = 0).
+      - ``pos_idx`` (1, T): absolute positions per row (pad rows clamp
+        to 0; their writes are zeroed so the value never lands).
+      - ``write_onehot`` (T, total_slots): row j's write slot in the
+        global pool. An ALL-ZERO row writes nothing — that is both the
+        pad-row idiom and the zero-write REPLAY mode (a fully-cached
+        prompt re-scores its last chunk against the stored pages:
+        ``kv·1 + Σ(new·0) = kv`` bitwise, so replay logits are
+        bit-identical to the cold chunked prefill that wrote them).
+      - ``att_mask`` (T, total_slots): additive score mask per row — 0 on
+        the lane's earlier slots AND on in-chunk slots of positions
+        <= row j (intra-chunk causality is enforced HERE: all T writes
+        land in ``k_upd`` before attention, the mask hides the future
+        ones). A fully-masked pad row softmaxes uniformly over garbage
+        and is discarded — finite, never NaN (max-subtraction zeroes the
+        row first).
+      - ``kv_k_i`` / ``kv_v_i`` (H, total_slots, dh): the global pool
+        buffers, as in ``get_decode_symbol(global_slots=True)``.
+
+    Outputs: ``[logits (T, vocab), k'_0, v'_0, ...]`` plus — with
+    ``token_out=True`` — a trailing on-device ``chunk_token (T,)`` argmax
+    head so the speculative accept loop pulls T ids, not T·vocab floats.
+    """
+    T, S = int(chunk_len), int(total_slots)
+    dh = model_dim // num_heads
+    scale = 1.0 / float(np.sqrt(dh))
+    data = sym.Variable("data")
+    pos_idx = sym.Variable("pos_idx")
+    w_oh = sym.Variable("write_onehot")
+    msk = sym.Variable("att_mask")
+    w4 = sym.Reshape(w_oh, shape=(1, T, S, 1))
+    keep3 = 1.0 - sym.Reshape(sym.sum(w_oh, axis=0), shape=(1, S, 1))
+    msk3 = sym.Reshape(msk, shape=(1, T, S))
+    emb = sym.Embedding(data=data, input_dim=vocab_size,
+                        output_dim=model_dim, name="embed")
+    posrow = sym.Embedding(data=pos_idx, input_dim=pos_len,
+                           output_dim=model_dim, name="pos_embed")
+    x = emb + posrow  # (1, T, M)
+    kv_outs = []
+    for i in range(num_layers):
+        name = "layer%d" % i
+        ln = _layer_norm(x, "%s_ln1" % name, model_dim)
+        qkv = sym.FullyConnected(data=ln, num_hidden=3 * model_dim,
+                                 flatten=False, name="%s_qkv" % name)
+        q, k_new, v_new = _split_fused(qkv, 3, T, num_heads, dh)
+        kv_k = sym.Variable("kv_k_%d" % i)
+        kv_v = sym.Variable("kv_v_%d" % i)
+        # scatter the T new rows into the pool: (H,T,1,dh)·(1,T,S,1)
+        # summed over the row axis — writer-disjoint slots, so the sum
+        # IS the scatter (all-zero rows vanish)
+        k_rows = sym.Reshape(k_new, shape=(num_heads, T, 1, dh))
+        v_rows = sym.Reshape(v_new, shape=(num_heads, T, 1, dh))
+        wr_k = sym.sum(sym.broadcast_mul(k_rows, w4), axis=1)
+        wr_v = sym.sum(sym.broadcast_mul(v_rows, w4), axis=1)
+        k_upd = sym.broadcast_add(sym.broadcast_mul(kv_k, keep3), wr_k,
+                                  name="%s_kupd" % name)
+        v_upd = sym.broadcast_add(sym.broadcast_mul(kv_v, keep3), wr_v,
+                                  name="%s_vupd" % name)
+        kv_outs += [k_upd, v_upd]
+        q4 = sym.Reshape(q, shape=(num_heads, T, 1, dh))
+        k4 = sym.Reshape(k_upd, shape=(num_heads, 1, S, dh))
+        v4 = sym.Reshape(v_upd, shape=(num_heads, 1, S, dh))
+        scores = sym.sum(sym.broadcast_mul(q4, k4), axis=3) * scale
+        scores = sym.broadcast_add(scores, msk3)  # (H, T, S)
+        p = sym.softmax(scores, axis=-1)
+        ctx = sym.sum(sym.broadcast_mul(sym.expand_dims(p, axis=3), v4),
+                      axis=2)  # (H, T, dh)
+        att = sym.Reshape(
+            sym.SwapAxis(sym.Reshape(ctx, shape=(-1, num_heads, T, dh)),
+                         dim1=1, dim2=2),
+            shape=(-1, T, model_dim))
+        x = x + sym.FullyConnected(data=att, num_hidden=model_dim,
+                                   flatten=False, name="%s_proj" % name)
+        x = x + _ffn(_layer_norm(x, "%s_ln2" % name, model_dim), name,
+                     model_dim, ffn_dim)
+    x = _layer_norm(x, "final_ln", model_dim)
+    logits = sym.FullyConnected(
+        data=sym.Reshape(x, shape=(-1, model_dim)), num_hidden=vocab_size,
+        name="lm_head")
+    outs = [logits] + kv_outs
+    if token_out:
+        outs.append(sym.argmax(logits, axis=-1, name="chunk_token"))
+    return sym.Group(outs)
+
+
+def draft_config(cfg, num_layers=1):
+    """Speculative-decoding draft config: the FIRST ``num_layers`` blocks
+    of a target model's config. Weight names are positional
+    (``layer0..layer{k-1}`` plus the shared ``embed``/``pos_embed``/
+    ``final_ln``/``lm_head``), so a target checkpoint's arg_params dict
+    feeds a draft decoder unchanged — the draft simply stops looking up
+    the deeper layers. docs/SERVING.md §speculative decoding."""
+    k = int(num_layers)
+    if not 0 < k <= int(cfg.get("num_layers", k)):
+        raise ValueError(
+            "draft_config: draft num_layers %d not in (0, %d]"
+            % (k, int(cfg.get("num_layers", k))))
+    out = dict(cfg)
+    out["num_layers"] = k
+    return out
 
 
 def get_symbol(vocab_size=32000, num_layers=6, num_heads=8, model_dim=512,

@@ -28,9 +28,12 @@ from .cache import PersistentExecutableCache
 from .engine import (InferenceEngine, ServeFuture, ServeDeadlineError,
                      ServeOverloadError, ServeClosedError)
 from .kv_decode import KVCacheDecoder, PagedKVDecoder, PagedKVExhausted
+from .prefix_cache import PrefixCache
+from .speculative import SpeculativeDecoder, spec_decode_enabled, spec_gamma
 from . import fleet
 
 __all__ = ["PersistentExecutableCache", "InferenceEngine", "ServeFuture",
            "ServeDeadlineError", "ServeOverloadError", "ServeClosedError",
            "KVCacheDecoder", "PagedKVDecoder", "PagedKVExhausted",
-           "fleet"]
+           "PrefixCache", "SpeculativeDecoder", "spec_decode_enabled",
+           "spec_gamma", "fleet"]

@@ -24,7 +24,6 @@ import json
 import logging
 import os
 import re
-import threading
 from collections import OrderedDict
 from typing import Dict, List, Optional, Sequence
 

@@ -10,6 +10,15 @@ Dispatch policy, in order:
   ``latched``/``stopped``. ``degraded`` replicas are skipped whenever a
   healthy one exists (they still beat shedding when the whole fleet is
   degraded). Draining replicas (mid-rollout) are never picked.
+* **Prefix affinity** — a request submitted with a ``prefix_key`` (the
+  prompt's chunk-hash stem, docs/SERVING.md §Prefix cache) prefers the
+  replica that rendezvous-hashing (HRW over the registered replica set)
+  assigns that key: repeat prefixes keep landing where their KV pages
+  are already cached. Affinity NEVER overrides eligibility — when the
+  assigned replica is stale, unhealthy, draining, or already tried, the
+  pick falls back to the load-aware EWMA policy below
+  (``fleet.affinity_hits`` / ``fleet.affinity_fallbacks``). Disable
+  with ``MXNET_FLEET_AFFINITY=0``.
 * **Load-awareness** — among eligible replicas, lowest EWMA queue wait
   (each engine's own admission-control estimate, exported by
   ``health()``), tie-broken by the router's in-flight count then
@@ -42,6 +51,7 @@ weights — old weights stay live everywhere.
 """
 from __future__ import annotations
 
+import hashlib
 import logging
 import os
 import threading
@@ -95,10 +105,10 @@ class _View:
 
 class _FleetRequest:
     __slots__ = ("inputs", "future", "t_enq", "deadline", "deadline_ms",
-                 "tried", "redispatches", "trace_id")
+                 "tried", "redispatches", "trace_id", "prefix_key")
 
     def __init__(self, inputs, deadline=None, deadline_ms=None,
-                 trace_id=None):
+                 trace_id=None, prefix_key=None):
         self.inputs = inputs
         self.future = ServeFuture()
         self.t_enq = time.perf_counter()
@@ -107,6 +117,7 @@ class _FleetRequest:
         self.tried = set()
         self.redispatches = 0
         self.trace_id = trace_id          # router-minted request trace id
+        self.prefix_key = prefix_key      # prefix-affinity routing key
 
 
 class Router:
@@ -156,6 +167,11 @@ class Router:
         dl = (_env_float("MXNET_FLEET_DEADLINE_MS", 0.0)
               if deadline_ms is None else float(deadline_ms))
         self.default_deadline_s = dl / 1000.0 if dl > 0 else None
+        # prefix-affinity dispatch is on by default; it only engages for
+        # requests that carry a prefix_key, so plain traffic is untouched
+        self.affinity_enabled = os.environ.get(
+            "MXNET_FLEET_AFFINITY", "1").strip().lower() \
+            not in ("0", "off", "false", "no")
         self._views = {}
         self._inflight = {}
         self._draining = set()
@@ -441,13 +457,41 @@ class Router:
                 degraded.append(v)
         return healthy if healthy else degraded
 
-    def _pick_locked(self, now, exclude=()):
+    def _affinity_target(self, prefix_key):
+        """Rendezvous (HRW) hash over the REGISTERED replica set: every
+        router instance maps a prefix key to the same replica without
+        coordination, and a membership change only remaps the keys that
+        hashed to the departed replica. md5, not ``hash()`` — Python's
+        string hash is per-process salted and would shatter the
+        cross-router agreement this exists for."""
+        best, best_score = None, None
+        for rid in self._views:
+            score = hashlib.md5(
+                ("%s|%s" % (prefix_key, rid)).encode()).hexdigest()
+            if best_score is None or score > best_score:
+                best, best_score = rid, score
+        return best
+
+    def _pick_locked(self, now, exclude=(), prefix_key=None):
         """(view, est_wait_ms) of the best eligible replica, or (None,
-        None). Lowest EWMA queue wait wins; in-flight count then
+        None). A prefix_key prefers its rendezvous-assigned replica IF
+        that replica is currently eligible; otherwise — and for plain
+        requests — lowest EWMA queue wait wins; in-flight count then
         round-robin break ties."""
         cands = self._eligible_locked(now, exclude)
         if not cands:
             return None, None
+        if prefix_key is not None and self.affinity_enabled:
+            target = self._affinity_target(prefix_key)
+            for v in cands:
+                if v.rid == target:
+                    if _tm.enabled():
+                        _tm.counter("fleet.affinity_hits").inc()
+                    return v, v.health.get("ewma_queue_wait_ms") or 0.0
+            # assigned replica is stale/unhealthy/draining/excluded:
+            # health and freshness rules outrank page locality
+            if _tm.enabled():
+                _tm.counter("fleet.affinity_fallbacks").inc()
         self._rr += 1
         best, best_key = None, None
         for i, v in enumerate(cands):
@@ -459,11 +503,15 @@ class Router:
         return best, best.health.get("ewma_queue_wait_ms") or 0.0
 
     # -------------------------------------------------------------- submit
-    def submit(self, inputs, deadline_ms=None) -> ServeFuture:
+    def submit(self, inputs, deadline_ms=None,
+               prefix_key=None) -> ServeFuture:
         """Enqueue one request for load-aware dispatch; returns a
         ``ServeFuture``. Sheds at admission (``ServeOverloadError`` with
         ``retry_after_ms``) when no replica is eligible or the best
-        replica's wait estimate exceeds the deadline budget / shed cap."""
+        replica's wait estimate exceeds the deadline budget / shed cap.
+        ``prefix_key`` (any stable string — normally the prompt's prefix
+        chunk hash) opts the request into affinity dispatch: repeat
+        keys land on the replica whose KV pages already hold them."""
         if deadline_ms is None and self.default_deadline_s is not None:
             deadline_ms = self.default_deadline_s * 1000.0
         dl_s = (float(deadline_ms) / 1000.0
@@ -517,7 +565,8 @@ class Router:
                 # span this request touches — router dispatch, RPC frame,
                 # replica engine/decoder — inherits it
                 trace_id=(uuid.uuid4().hex[:16] if _tm.tracing()
-                          else None))
+                          else None),
+                prefix_key=prefix_key)
             self._queue.append(req)
             self._counts["submitted"] += 1
             depth = len(self._queue)
@@ -526,9 +575,10 @@ class Router:
             _tm.gauge("fleet.queue_depth").set(depth)
         return req.future
 
-    def infer(self, inputs, timeout=60.0, deadline_ms=None):
-        return self.submit(inputs, deadline_ms=deadline_ms).result(
-            timeout=timeout)
+    def infer(self, inputs, timeout=60.0, deadline_ms=None,
+              prefix_key=None):
+        return self.submit(inputs, deadline_ms=deadline_ms,
+                           prefix_key=prefix_key).result(timeout=timeout)
 
     # ------------------------------------------------------------ dispatch
     def _worker_loop(self):
@@ -560,12 +610,14 @@ class Router:
                     _tm.counter("fleet.deadline_expired").inc()
                 return
             with self._cond:
-                view, _ = self._pick_locked(now, exclude=req.tried)
+                view, _ = self._pick_locked(now, exclude=req.tried,
+                                            prefix_key=req.prefix_key)
                 if view is None and req.tried:
                     # every replica tried once: forget the exclusions and
                     # allow a retried replica a second look (it may have
                     # recovered) as long as redispatch budget remains
-                    view, _ = self._pick_locked(now)
+                    view, _ = self._pick_locked(
+                        now, prefix_key=req.prefix_key)
                 if view is not None:
                     self._inflight[view.rid] = \
                         self._inflight.get(view.rid, 0) + 1

@@ -26,6 +26,15 @@ PRNG machinery) — so only (K, B) token ids cross the host per dispatch.
 Per-lane early exit reuses the all-zero ``slot_onehot`` idle-lane idiom:
 once a lane emits ``eos_id`` its remaining scan steps write NOTHING to
 its KV slots. K=1 keeps today's single-step path byte-for-byte.
+
+Paged pool (``PagedKVDecoder``): the lanes share ONE global slot axis
+(``lanes * max_len`` slots per layer), carved into refcounted page
+frames. Physical sharing is then free — the prefix cache
+(serving/prefix_cache.py) parks whole prompt chunks at a refcount and
+cached admits adopt them without recompute; ``fork`` clones a sequence
+by increfing its frames; a write into a shared page copy-on-writes; and
+``rollback``/``verify_chunk`` give speculative decoding
+(serving/speculative.py) its accept/reject primitives.
 """
 from __future__ import annotations
 
@@ -169,14 +178,19 @@ class _DecodeMegastep:
         self.k = int(k)
         self.sampler = sampler
         self.rows = dec.batch if hasattr(dec, "batch") else dec.lanes
-        B, S, L = self.rows, dec.max_len, dec.num_layers
+        # the paged decoder's pool is ONE global slot axis shared by all
+        # lanes (kv (H, S_tot, dh)); the classic decoders carry a ring
+        # per lane (kv (B, H, S, dh)) — same scan, different slot space
+        self.global_slots = bool(getattr(dec, "_global_slots", False))
+        B, L = self.rows, dec.num_layers
+        S = dec.total_slots if self.global_slots else dec.max_len
         self._S = S
         pos_len = dec.pos_len
         sym = _tf.get_decode_symbol(
             vocab_size=dec.vocab_size, num_layers=L,
             num_heads=dec.num_heads, model_dim=dec.model_dim,
             ffn_dim=dec.ffn_dim, max_len=S, pos_len=pos_len,
-            per_stream_slots=True)
+            per_stream_slots=True, global_slots=self.global_slots)
         prog = _GraphProgram(sym)
         if prog.aux_names:
             raise MXNetError("decode megastep: the decode graph must carry "
@@ -273,7 +287,8 @@ class _DecodeMegastep:
         H, dh = dec.num_heads, dec.dh
         weights = {n: dec._dec_exe.arg_dict[n]._jax()
                    for n in self.weight_names}
-        kvs = tuple(jnp.zeros((B, H, S, dh), jnp.float32)
+        kv_shape = (H, S, dh) if self.global_slots else (B, H, S, dh)
+        kvs = tuple(jnp.zeros(kv_shape, jnp.float32)
                     for _ in self.kv_names)
         z = self._zero_inputs()
         with _tm.span("serving.megastep_compile", k=self.k, rows=B,
@@ -317,6 +332,103 @@ def _megastep_for(dec, k, sampler):
         ms.warm(dec)
         dec._megasteps[cache_key] = ms
     return ms
+
+
+class _ChunkProgram:
+    """T tokens of ONE lane scored (and optionally written) in a single
+    rectangular dispatch over the global paged pool
+    (models/transformer.py ``get_chunk_symbol``). Chunked prefill — admit
+    computes only a prompt's un-cached tail, C tokens per dispatch — and
+    the speculative draft-verify pass (γ+1 candidate positions at once)
+    are the SAME program at different T. Sealed exactly like the
+    megastep: one warm-time compile, signature drift is a hard retrace
+    error, weights are pulled from the live decode executable at dispatch
+    time so a hitless swap_params lands in the next chunk."""
+
+    def __init__(self, dec, t):
+        import jax
+
+        from ..executor import _GraphProgram
+        from ..models import transformer as _tf
+
+        self.t = int(t)
+        S, L = dec.total_slots, dec.num_layers
+        self._S = S
+        symb = _tf.get_chunk_symbol(
+            vocab_size=dec.vocab_size, num_layers=L,
+            num_heads=dec.num_heads, model_dim=dec.model_dim,
+            ffn_dim=dec.ffn_dim, chunk_len=self.t, total_slots=S,
+            pos_len=dec.pos_len)
+        prog = _GraphProgram(symb)
+        if prog.aux_names:
+            raise MXNetError("chunk program: the chunk graph must carry "
+                             "no aux state, got %r" % (prog.aux_names,))
+        self.kv_names = [n for i in range(L)
+                         for n in ("kv_k_%d" % i, "kv_v_%d" % i)]
+        step_inputs = {"data", "pos_idx", "write_onehot", "att_mask"}
+        step_inputs.update(self.kv_names)
+        self.weight_names = [n for n in prog.arg_names
+                             if n not in step_inputs]
+        arg_names = list(prog.arg_names)
+
+        def run(weights, kvs, data, pos_idx, w_oh, mask, key):
+            feed = {"data": data, "pos_idx": pos_idx,
+                    "write_onehot": w_oh, "att_mask": mask}
+            for i, name in enumerate(self.kv_names):
+                feed[name] = kvs[i]
+            args = [feed[n] if n in feed else weights[n]
+                    for n in arg_names]
+            outs, _ = prog.interpret(args, (), False, key)
+            new_kv = tuple(outs[1 + j] for j in range(2 * L))
+            return outs[0], new_kv, outs[-1]
+
+        self._fn = jax.jit(run)
+        self._sig = None
+
+    def _zero_inputs(self):
+        T, S = self.t, self._S
+        data = np.zeros((1, T), np.float32)
+        pos_idx = np.zeros((1, T), np.float32)
+        w_oh = np.zeros((T, S), np.float32)
+        mask = np.full((T, S), _NEG, np.float32)
+        return data, pos_idx, w_oh, mask
+
+    def warm(self, dec):
+        """Compile NOW with an all-pad (zero-write, fully-masked) chunk,
+        counted as this program's one ``executor.compile``."""
+        import jax
+
+        weights = {n: dec._dec_exe.arg_dict[n]._jax()
+                   for n in self.weight_names}
+        kvs = tuple(dec._dec_exe.arg_dict[n]._jax() for n in self.kv_names)
+        z = self._zero_inputs()
+        with _tm.span("serving.chunk_compile", t=self.t):
+            out = self._fn(weights, kvs, *z, _sampling_key(dec))
+            # graphlint: waive GL7xx -- warm-time compile barrier, not the dispatch path
+            jax.block_until_ready(out)
+        self._sig = _DecodeMegastep._sig_of(*z)
+        if _tm.enabled():
+            _tm.counter("executor.compile").inc()
+
+    def run(self, dec, data, pos_idx, w_oh, mask):
+        """One chunk dispatch. Returns device-resident
+        ``(logits (T, vocab), new_kvs, tokens (T,))`` — the caller
+        pointer-swaps the KV and pulls only what it needs."""
+        sig = _DecodeMegastep._sig_of(data, pos_idx, w_oh, mask)
+        if self._sig is not None and sig != self._sig:
+            if _tm.enabled():
+                _tm.counter("executor.retrace").inc()
+            raise MXNetError(
+                "chunk program (T=%d): input signature drifted from the "
+                "warmed shapes (%r != %r) — chunk programs are sealed "
+                "like the executable cache" % (self.t, sig, self._sig))
+        if _tm.enabled():
+            _tm.counter("executor.cache_hit").inc()
+        weights = {n: dec._dec_exe.arg_dict[n]._jax()
+                   for n in self.weight_names}
+        kvs = tuple(dec._dec_exe.arg_dict[n]._jax() for n in self.kv_names)
+        return self._fn(weights, kvs, data, pos_idx, w_oh, mask,
+                        _sampling_key(dec))
 
 
 class KVCacheDecoder:
@@ -633,17 +745,27 @@ class PagedKVExhausted(MXNetError):
 
 
 class _PagePool:
-    """Block allocator over each lane's slot axis (docs/SERVING.md).
+    """REFCOUNTED block allocator over ONE global slot axis
+    (docs/SERVING.md §Prefix cache).
 
-    A lane's ``max_len`` KV slots are carved into ``slots // page_size``
-    fixed-size page frames. Frames are handed out from a per-lane LIFO
-    free list — a re-admitted sequence deliberately gets the most recently
-    freed frames first, so physical placement is routinely NON-contiguous
-    (the attention math is slot-order-agnostic; the in-graph write goes to
-    whatever slot the host-side onehot names). A global ``budget`` below
-    the physical frame count models admission control against a smaller
-    HBM reservation: acquisitions past it raise ``PagedKVExhausted`` even
-    when the lane itself has free frames."""
+    The pool's ``lanes * slots`` KV slots form a single physical space
+    carved into fixed-size page frames; any lane (and the prefix index)
+    may reference any frame, which is what lets N concurrent sequences —
+    and the cache — point at ONE physical copy of a shared prompt
+    prefix. Every holder owns a reference: ``acquire`` hands out a frame
+    at refcount 1, ``incref`` adds a holder, ``release`` drops one and
+    returns the frame to the free list only when the LAST holder lets
+    go — so eviction/retire can never free a page some other lane still
+    attends (refcount > 1 just decrements).
+
+    Frames come off a LIFO free list, and ``release`` pushes them back
+    REVERSED so a retire-then-readmit (or rollback-then-regrow) replays
+    the original placement order — physical placement is routinely
+    non-contiguous (attention is slot-order-agnostic) but DETERMINISTIC,
+    which the bitwise cached-admit parity gate leans on. A ``budget``
+    below the physical frame count models admission control against a
+    smaller HBM reservation: a shared frame counts ONCE no matter how
+    many holders it has."""
 
     def __init__(self, lanes, slots, page_size, budget=None):
         if slots % page_size:
@@ -652,41 +774,65 @@ class _PagePool:
         self.lanes = int(lanes)
         self.page_size = int(page_size)
         self.frames_per_lane = slots // page_size
-        self.budget = int(budget) if budget else self.lanes * \
-            self.frames_per_lane
-        self._free = [list(range(self.frames_per_lane))
-                      for _ in range(self.lanes)]
-        self.in_use = 0
+        self.total_frames = self.lanes * self.frames_per_lane
+        self.budget = int(budget) if budget else self.total_frames
+        # LIFO: pop() serves the highest-numbered frame first; release()
+        # re-stacks reversed so re-acquisition replays acquisition order
+        self._free = list(range(self.total_frames))
+        self._ref: Dict[int, int] = {}  # frame -> holder count
 
-    def acquire(self, lane):
-        """One frame index within ``lane``'s slot axis, or raise."""
+    @property
+    def in_use(self):
+        """Frames with at least one holder (each counts once — sharing
+        is free under the budget)."""
+        return len(self._ref)
+
+    def can_acquire(self, n=1):
+        return len(self._free) >= n and self.in_use + n <= self.budget
+
+    def acquire(self):
+        """One free frame at refcount 1, or raise ``PagedKVExhausted``."""
         if self.in_use >= self.budget:
             raise PagedKVExhausted(
                 "paged_kv: page budget exhausted (%d/%d frames in use); "
                 "retire a sequence and retry" % (self.in_use, self.budget))
-        free = self._free[lane]
-        if not free:
+        if not self._free:
             raise PagedKVExhausted(
-                "paged_kv: lane %d has no free page frame (%d slots / %d "
-                "per page all allocated) — the sequence outgrew its lane"
-                % (lane, self.frames_per_lane * self.page_size,
-                   self.page_size))
-        self.in_use += 1
-        return free.pop()
+                "paged_kv: no free page frame (%d frames all referenced) "
+                "— retire a sequence or evict cached prefixes and retry"
+                % self.total_frames)
+        f = self._free.pop()
+        self._ref[f] = 1
+        return f
 
-    def release(self, lane, frames):
-        self._free[lane].extend(frames)
-        self.in_use -= len(frames)
+    def incref(self, frame):
+        """Add a holder to an allocated frame (page sharing)."""
+        self._ref[frame] += 1
+
+    def refcount(self, frame):
+        return self._ref.get(frame, 0)
+
+    def release(self, frames):
+        """Drop ONE reference per listed frame; frames whose last holder
+        left go back on the free list (reversed — see class docstring)."""
+        freed = []
+        for f in frames:
+            n = self._ref[f] - 1
+            if n:
+                self._ref[f] = n
+            else:
+                del self._ref[f]
+                freed.append(f)
+        self._free.extend(reversed(freed))
 
 
 class _Lane:
-    __slots__ = ("seq_id", "pos", "frames", "valid_slots")
+    __slots__ = ("seq_id", "pos", "frames")
 
     def __init__(self, seq_id):
         self.seq_id = seq_id
         self.pos = 0            # next position to be written
         self.frames = []        # logical page -> physical frame index
-        self.valid_slots = []   # physical slots holding real context
 
 
 class PagedKVDecoder:
@@ -709,13 +855,30 @@ class PagedKVDecoder:
     slot_onehot/kv_mask row per lane), so multiplexed decode is
     token-identical to sequential per-request decode — the acceptance
     test pins exactly that.
+
+    KV storage is ONE global slot pool (``get_decode_symbol``
+    ``global_slots=True``): per layer the buffers are
+    (H, lanes·max_len, dh) and every lane's onehot/mask row indexes the
+    shared axis, so a page frame is just a slot range ANY lane can
+    reference. That is the substrate for cross-request prefix reuse
+    (serving/prefix_cache.py): with ``prefix_cache=True`` (or
+    ``MXNET_SERVE_PREFIX_CACHE=1``) admit hashes the prompt in
+    ``prefix_chunk``-token chunks, adopts the cached pages of the longest
+    matched chunk chain at a refcount (no copy, no recompute), and
+    chunk-prefills ONLY the unmatched tail through the rectangular chunk
+    program. A lane's first write into a page some other holder still
+    references triggers a copy-on-write private copy (``fork`` shares
+    all pages this way). ``rollback`` truncates a sequence by releasing
+    whole rejected pages — the speculative-decoding accept/reject
+    primitive (serving/speculative.py).
     """
 
     def __init__(self, arg_params: Dict[str, object], vocab_size,
                  num_layers=2, num_heads=2, model_dim=32, ffn_dim=64,
                  max_len=64, page_size=8, lanes=4, page_budget=None,
                  prefill_len: Optional[int] = None,
-                 pos_len: Optional[int] = None, ctx=None,
+                 pos_len: Optional[int] = None, prefix_cache=None,
+                 prefix_chunk=None, ctx=None,
                  dtype="float32", cache_dir=None, model_key=None,
                  sample_seed=None):
         from ..models import transformer as _tf
@@ -736,17 +899,41 @@ class PagedKVDecoder:
         self.pool = _PagePool(self.lanes, self.max_len, page_size,
                               budget=page_budget)
         self.page_size = self.pool.page_size
+        self.total_slots = self.lanes * self.max_len
+        self._global_slots = True
+        if prefix_cache is None:
+            prefix_cache = os.environ.get(
+                "MXNET_SERVE_PREFIX_CACHE", "").strip().lower() \
+                in ("1", "on", "true", "yes")
+        if prefix_cache:
+            from .prefix_cache import PrefixCache
+
+            if prefix_chunk is None:
+                raw = os.environ.get("MXNET_SERVE_PREFIX_CHUNK",
+                                     "").strip()
+                prefix_chunk = int(raw) if raw else self.page_size
+            self.prefix_chunk = int(prefix_chunk)
+            self._prefix = PrefixCache(self.pool, self.prefix_chunk)
+        else:
+            self.prefix_chunk = None
+            self._prefix = None
+        self._prefix_hits = 0
+        self._prefix_misses = 0
         cfg = dict(vocab_size=self.vocab_size, num_layers=self.num_layers,
                    num_heads=self.num_heads, model_dim=self.model_dim,
                    ffn_dim=int(ffn_dim), pos_len=self.pos_len)
-        key = model_key or "transformer_paged_decode"
+        # NOTE the default key differs from the pre-global-pool layout on
+        # purpose: the decode graph's KV shapes changed, and a stale
+        # on-disk cache under the old key must not satisfy this one
+        key = model_key or "transformer_paged_global_decode"
         self._pf_cache = PersistentExecutableCache(
             _tf.get_prefill_symbol(prefill_len=self.prefill_len, **cfg),
             arg_params, {}, ctx=ctx, dtype=dtype, cache_dir=cache_dir,
             model_key=key + "-prefill")
         self._dec_cache = PersistentExecutableCache(
-            _tf.get_decode_symbol(max_len=self.max_len,
-                                  per_stream_slots=True, **cfg),
+            _tf.get_decode_symbol(max_len=self.total_slots,
+                                  per_stream_slots=True,
+                                  global_slots=True, **cfg),
             arg_params, {}, ctx=ctx, dtype=dtype, cache_dir=cache_dir,
             model_key=key + "-decode")
         self._dec_exe = None
@@ -756,54 +943,127 @@ class PagedKVDecoder:
         self._warm = False
         self._last_return_t = None  # dispatch.host_gap interval start
         self._megasteps = {}        # (K, sampler) -> _DecodeMegastep
+        self._chunks = {}           # T -> _ChunkProgram
         self._sample_seed = sample_seed
         self._sample_key = None
 
     # ------------------------------------------------------------ lifecycle
     def _decode_shapes(self):
-        B, S, H, dh = self.lanes, self.max_len, self.num_heads, self.dh
+        B, S, H, dh = self.lanes, self.total_slots, self.num_heads, self.dh
         shapes = {"data": (B, 1), "pos_idx": (B, 1),
                   "slot_onehot": (B, S), "kv_mask": (B, S)}
         for i in range(self.num_layers):
-            shapes["kv_k_%d" % i] = (B, H, S, dh)
-            shapes["kv_v_%d" % i] = (B, H, S, dh)
+            shapes["kv_k_%d" % i] = (H, S, dh)
+            shapes["kv_v_%d" % i] = (H, S, dh)
         return shapes
 
     def warmup(self):
-        """Compile the batch-1 prefill and the multiplexed decode
-        executable; seal both caches (two programs total, any number of
-        concurrent sequences)."""
+        """Compile the multiplexed decode executable plus the admit-side
+        program — the batch-1 prefill bucket classically, the C-token
+        chunk program when the prefix cache is on (chunked admit never
+        touches the prefill bucket: cold and cached admits must replay
+        the SAME program for the bitwise parity gate to hold)."""
         if self._warm:
             return self
-        self._pf_cache.warmup([{"data": (1, self.prefill_len)}])
         self._dec_cache.warmup([self._decode_shapes()])
         self._dec_exe = self._dec_cache.executable(self._decode_shapes())
         self._warm = True
+        if self._prefix is None:
+            self._pf_cache.warmup([{"data": (1, self.prefill_len)}])
+        else:
+            self._chunk_for(self.prefix_chunk)
         return self
 
     def stats(self):
-        return {"lanes": self.lanes,
-                "active": len(self._lanes),
-                "pages_in_use": self.pool.in_use,
-                "page_budget": self.pool.budget,
-                "page_size": self.page_size}
+        out = {"lanes": self.lanes,
+               "active": len(self._lanes),
+               "pages_in_use": self.pool.in_use,
+               "page_budget": self.pool.budget,
+               "page_size": self.page_size}
+        if self._prefix is not None:
+            out["prefix_cache"] = self._prefix.stats()
+            tot = self._prefix_hits + self._prefix_misses
+            out["prefix_hit_rate"] = \
+                (self._prefix_hits / tot) if tot else 0.0
+        return out
 
     # ------------------------------------------------------------ admission
+    def _acquire_frame(self):
+        """One page frame from the pool, evicting cached prefixes (LRU,
+        leaf-first) to make room before giving up."""
+        try:
+            return self.pool.acquire()
+        except PagedKVExhausted:
+            if self._prefix is not None and self._prefix.evict_for(1):
+                return self.pool.acquire()
+            raise
+
+    def _cow_page(self, lane: _Lane, page):
+        """Copy-on-write: give ``lane`` a private copy of logical page
+        ``page`` when some other holder (another lane, or the prefix
+        index) still references its frame. Device-side slot-range copy in
+        every layer's K/V buffer; the shared frame just loses one ref."""
+        frame = lane.frames[page]
+        if self.pool.refcount(frame) <= 1:
+            return frame
+        fresh = self._acquire_frame()
+        P = self.page_size
+        src = frame * P + np.arange(P)
+        dst = fresh * P + np.arange(P)
+        exe = self._dec_exe
+        for i in range(self.num_layers):
+            for tag in ("kv_k_%d" % i, "kv_v_%d" % i):
+                ring = exe.arg_dict[tag]._jax()
+                exe.arg_dict[tag]._set_jax(
+                    ring.at[:, dst, :].set(ring[:, src, :]))
+        self.pool.release([frame])
+        lane.frames[page] = fresh
+        if _tm.enabled():
+            _tm.counter("serving.cow_copies").inc()
+        return fresh
+
     def _phys_slot(self, lane: _Lane, pos):
-        """Physical slot of logical position ``pos``, acquiring a new page
-        frame when the position crosses into an unallocated page."""
+        """Physical slot of logical position ``pos`` FOR WRITING: acquires
+        a new page frame when the position crosses into an unallocated
+        page, and resolves copy-on-write when the page it lands in is
+        still shared (the caller is about to write into it)."""
+        if pos >= self.max_len:
+            raise MXNetError(
+                "paged_kv: position %d exceeds the per-sequence slot "
+                "quota (max_len %d)" % (pos, self.max_len))
         page, off = divmod(pos, self.page_size)
         while len(lane.frames) <= page:
-            lane.frames.append(
-                self.pool.acquire(self._seq_lane[lane.seq_id]))
-        return lane.frames[page] * self.page_size + off
+            lane.frames.append(self._acquire_frame())
+        frame = self._cow_page(lane, page)
+        return frame * self.page_size + off
+
+    def _lane_slots(self, lane: _Lane, upto=None):
+        """Physical slots of positions 0..n-1 (n = ``lane.pos`` unless
+        ``upto`` given) — derived from the frame table, never stored:
+        positions are always contiguous, so the slot list IS the page
+        map."""
+        n = lane.pos if upto is None else int(upto)
+        if n <= 0:
+            return np.zeros((0,), np.int64)
+        P = self.page_size
+        pages = np.asarray(lane.frames[:(n + P - 1) // P], np.int64)
+        slots = pages[:, None] * P + np.arange(P, dtype=np.int64)[None, :]
+        return slots.reshape(-1)[:n]
 
     def admit(self, prompt):
-        """Admit one sequence: a batch-1 prefill seeds its lane's pages.
-        ``prompt`` is a (L,) or (1, L) token array, 0 < L <= prefill_len.
-        Returns ``(seq_id, logits)`` with logits the (vocab,) distribution
-        for the sequence's next token. Raises ``PagedKVExhausted`` when no
-        lane or not enough page frames are free."""
+        """Admit one sequence. ``prompt`` is a (L,) or (1, L) token
+        array, 0 < L <= prefill_len. Returns ``(seq_id, logits)`` with
+        logits the (vocab,) distribution for the sequence's next token.
+        Raises ``PagedKVExhausted`` when no lane or not enough page
+        frames are free.
+
+        Without the prefix cache a batch-1 prefill seeds the lane's
+        pages (classic path). With it, admit is CHUNKED: the prompt's
+        chunk-hash chain is matched against the prefix index, matched
+        chunks are adopted at a refcount (zero recompute, zero copy) and
+        only the unmatched tail runs through the C-token chunk program —
+        cold and cached admits replay the same program over the same
+        physical slots, so their logits are bitwise identical."""
         self.warmup()
         prompt = np.asarray(prompt, dtype=np.float32).reshape(1, -1)
         L = prompt.shape[1]
@@ -822,32 +1082,10 @@ class PagedKVDecoder:
         self._lanes[idx] = lane
         self._seq_lane[seq_id] = idx
         try:
-            phys = [self._phys_slot(lane, p) for p in range(L)]
-            padded = np.zeros((1, self.prefill_len), np.float32)
-            padded[:, :L] = prompt
-            with _tm.span("serving.paged_admit", seq=seq_id, prompt_len=L,
-                          lane=idx):
-                pf = self._pf_cache.executable(
-                    {"data": (1, self.prefill_len)})
-                pf.arg_dict["data"][:] = padded
-                pf.forward(is_train=False)
-                logits = np.asarray(
-                    pf.outputs[0]._jax().reshape(
-                        1, self.prefill_len, self.vocab_size)[0, L - 1, :])
-                # scatter the prompt's K/V into THIS lane's physical
-                # slots — device-side; only the last position's logits
-                # crossed above
-                phys_idx = np.asarray(phys)
-                exe = self._dec_exe
-                for i in range(self.num_layers):
-                    for tag, out in (("kv_k_%d" % i,
-                                      pf.outputs[1 + 2 * i]),
-                                     ("kv_v_%d" % i,
-                                      pf.outputs[2 + 2 * i])):
-                        ring = exe.arg_dict[tag]._jax()
-                        row = ring[idx].at[:, phys_idx, :].set(
-                            out._jax()[0, :, :L, :])
-                        exe.arg_dict[tag]._set_jax(ring.at[idx].set(row))
+            if self._prefix is not None:
+                logits = self._admit_chunked(prompt, lane)
+            else:
+                logits = self._admit_prefill(prompt, lane, idx)
         except BaseException:
             # ANY admit failure (pool exhaustion, a prefill/scatter
             # error) must release the lane and its frames — the caller
@@ -856,7 +1094,6 @@ class PagedKVDecoder:
             self._evict(idx)
             raise
         lane.pos = L
-        lane.valid_slots = phys
         self._last_return_t = None  # admit breaks the steady decode chain
         if _tm.enabled():
             _tm.counter("serving.paged_admits").inc()
@@ -864,10 +1101,147 @@ class PagedKVDecoder:
             _tm.gauge("serving.paged_pages_in_use").set(self.pool.in_use)
         return seq_id, logits
 
+    def _admit_prefill(self, prompt, lane, idx):
+        """Classic admit: one batch-1 prefill dispatch, device-side
+        scatter of the prompt's K/V into the lane's physical slots."""
+        L = prompt.shape[1]
+        phys = [self._phys_slot(lane, p) for p in range(L)]
+        padded = np.zeros((1, self.prefill_len), np.float32)
+        padded[:, :L] = prompt
+        with _tm.span("serving.paged_admit", seq=lane.seq_id,
+                      prompt_len=L, lane=idx):
+            pf = self._pf_cache.executable(
+                {"data": (1, self.prefill_len)})
+            pf.arg_dict["data"][:] = padded
+            pf.forward(is_train=False)
+            logits = np.asarray(
+                pf.outputs[0]._jax().reshape(
+                    1, self.prefill_len, self.vocab_size)[0, L - 1, :])
+            # scatter the prompt's K/V into THIS lane's physical
+            # slots — device-side; only the last position's logits
+            # crossed above
+            phys_idx = np.asarray(phys)
+            exe = self._dec_exe
+            for i in range(self.num_layers):
+                for tag, out in (("kv_k_%d" % i,
+                                  pf.outputs[1 + 2 * i]),
+                                 ("kv_v_%d" % i,
+                                  pf.outputs[2 + 2 * i])):
+                    ring = exe.arg_dict[tag]._jax()
+                    exe.arg_dict[tag]._set_jax(
+                        ring.at[:, phys_idx, :].set(
+                            out._jax()[0, :, :L, :]))
+        return logits
+
+    def _chunk_for(self, t):
+        """The sealed T-token chunk program, compiled on first use."""
+        prog = self._chunks.get(t)
+        if prog is None:
+            prog = _ChunkProgram(self, t)
+            prog.warm(self)
+            self._chunks[t] = prog
+        return prog
+
+    def _run_chunk(self, lane: _Lane, tokens, base, write, prog=None):
+        """Dispatch ``tokens`` (length <= T) of ``lane`` at positions
+        ``base..base+len-1`` through the chunk program, writing K/V when
+        ``write`` (rows past ``len`` are pad: zero write-onehot, fully
+        masked — they soak up a uniform softmax and touch nothing).
+        Returns host logits rows (len, vocab)."""
+        prog = prog or self._chunk_for(self.prefix_chunk)
+        T, S = prog.t, self.total_slots
+        n = len(tokens)
+        data = np.zeros((1, T), np.float32)
+        pos_idx = np.zeros((1, T), np.float32)
+        w_oh = np.zeros((T, S), np.float32)
+        mask = np.full((T, S), _NEG, np.float32)
+        data[0, :n] = tokens
+        pos_idx[0, :n] = np.arange(base, base + n)
+        if write:
+            phys = [self._phys_slot(lane, base + j) for j in range(n)]
+        else:
+            phys = self._lane_slots(lane, base + n)[base:]
+        seen = self._lane_slots(lane, base)
+        for j in range(n):
+            if write:
+                w_oh[j, phys[j]] = 1.0
+            mask[j, seen] = 0.0
+            mask[j, phys[: j + 1]] = 0.0
+        _gap_mark(self, "serving.chunk_prefill")
+        with _tm.span("serving.chunk_prefill", t=T, rows=n,
+                      write=bool(write)):
+            logits, new_kvs, _tok = prog.run(self, data, pos_idx, w_oh,
+                                             mask)
+            out = np.asarray(logits)[:n]
+        _gap_return(self)
+        if write:
+            for name, arr in zip(prog.kv_names, new_kvs):
+                self._dec_exe.arg_dict[name]._set_jax(arr)
+        return out
+
+    def _admit_chunked(self, prompt, lane):
+        """Prefix-cache admit: match the prompt's chunk-hash chain,
+        adopt matched pages at a refcount, chunk-prefill only the tail.
+        A fully-matched prompt replays its last chunk with a ZERO
+        write-onehot — ``kv*1 + new*0`` leaves every buffer bitwise
+        untouched while producing the exact logits a cold admit did."""
+        C = self.prefix_chunk
+        toks = np.asarray(prompt, np.int64).reshape(-1)
+        L = toks.shape[0]
+        n_full = L // C
+        hashes = self._prefix.chain_hashes(toks[:n_full * C])
+        matched, frames = self._prefix.match(hashes)
+        for f in frames:
+            self.pool.incref(f)
+        lane.frames = list(frames)
+        if _tm.enabled() and frames:
+            _tm.counter("serving.pages_shared").inc(len(frames))
+        if matched:
+            self._prefix_hits += 1
+            if _tm.enabled():
+                _tm.counter("serving.prefix_hits").inc(matched)
+                _tm.counter("serving.prefill_tokens_saved").inc(
+                    matched * C)
+        else:
+            self._prefix_misses += 1
+        if _tm.enabled():
+            _tm.counter("serving.prefix_misses").inc(n_full - matched)
+        logits = None
+        with _tm.span("serving.paged_admit", seq=lane.seq_id,
+                      prompt_len=L, cached_tokens=matched * C):
+            for c in range(matched, n_full):
+                base = c * C
+                rows = self._run_chunk(lane, toks[base:base + C], base,
+                                       write=True)
+                logits = rows[-1]
+                # whole chunks become cache currency the moment they
+                # are computed — the index increfs the frames itself
+                self._prefix.insert(
+                    hashes[c],
+                    lane.frames[base // self.page_size:
+                                (base + C) // self.page_size],
+                    parent=hashes[c - 1] if c else None)
+            tail = L - n_full * C
+            if tail:
+                rows = self._run_chunk(lane, toks[L - tail:], L - tail,
+                                       write=True)
+                logits = rows[-1]
+            elif logits is None:
+                # full match: zero-write replay of the last chunk
+                base = (n_full - 1) * C
+                rows = self._run_chunk(lane, toks[base:base + C], base,
+                                       write=False)
+                logits = rows[-1]
+        if _tm.enabled():
+            tot = self._prefix_hits + self._prefix_misses
+            _tm.gauge("serving.prefix_hit_rate").set(
+                self._prefix_hits / tot if tot else 0.0)
+        return logits
+
     def _evict(self, idx):
         lane = self._lanes.pop(idx)
         self._seq_lane.pop(lane.seq_id, None)
-        self.pool.release(idx, lane.frames)
+        self.pool.release(lane.frames)
 
     def retire(self, seq_id):
         """Free a finished sequence's lane and page frames (the slots are
@@ -887,6 +1261,90 @@ class PagedKVDecoder:
     def position(self, seq_id):
         return self._lanes[self._seq_lane[seq_id]].pos
 
+    # ----------------------------------------------------- fork / rollback
+    def fork(self, seq_id):
+        """Clone a sequence into a free lane by SHARING every page frame
+        at a refcount — zero copy, zero recompute (the parallel-sampling
+        idiom). Either side's next write into a shared page triggers its
+        private copy-on-write. Returns the clone's seq_id."""
+        idx = self._seq_lane.get(seq_id)
+        if idx is None:
+            raise MXNetError("paged_kv: unknown seq_id %r" % (seq_id,))
+        src = self._lanes[idx]
+        free_lanes = [i for i in range(self.lanes) if i not in self._lanes]
+        if not free_lanes:
+            raise PagedKVExhausted(
+                "paged_kv: all %d lanes occupied; retire a sequence first"
+                % self.lanes)
+        new_idx = free_lanes[0]
+        new_id = self._next_seq
+        self._next_seq += 1
+        lane = _Lane(new_id)
+        lane.pos = src.pos
+        lane.frames = list(src.frames)
+        for f in lane.frames:
+            self.pool.incref(f)
+        self._lanes[new_idx] = lane
+        self._seq_lane[new_id] = new_idx
+        if _tm.enabled():
+            _tm.counter("serving.pages_shared").inc(len(lane.frames))
+            _tm.gauge("serving.paged_pages_in_use").set(self.pool.in_use)
+        return new_id
+
+    def rollback(self, seq_id, pos):
+        """Truncate a sequence back to ``pos`` written positions: whole
+        pages past the boundary are RELEASED (decref — a frame another
+        holder shares just loses this lane's ref), the partial boundary
+        page is kept with its stale tail slots simply excluded from the
+        derived valid-slot set. No copy, no device work — this is the
+        speculative-decoding reject primitive."""
+        idx = self._seq_lane.get(seq_id)
+        if idx is None:
+            raise MXNetError("paged_kv: unknown seq_id %r" % (seq_id,))
+        lane = self._lanes[idx]
+        pos = int(pos)
+        if not 0 <= pos <= lane.pos:
+            raise MXNetError(
+                "paged_kv: rollback target %d outside [0, %d]"
+                % (pos, lane.pos))
+        keep = (pos + self.page_size - 1) // self.page_size
+        dropped = lane.frames[keep:]
+        del lane.frames[keep:]
+        self.pool.release(dropped)
+        lane.pos = pos
+        if _tm.enabled():
+            _tm.counter("spec.rollbacks").inc()
+            _tm.gauge("serving.paged_pages_in_use").set(self.pool.in_use)
+
+    def verify_chunk(self, seq_id, tokens):
+        """Score ``tokens`` (length T) at the sequence's next T positions
+        in ONE rectangular dispatch, writing their K/V (row j attends to
+        everything before it plus rows 0..j — exactly T successive
+        ``step`` calls fused). Advances the position by T; the caller
+        accepts a prefix and ``rollback``s the rest. Returns (T, vocab)
+        logits. This is the speculative-decoding verify pass."""
+        self.warmup()
+        idx = self._seq_lane.get(seq_id)
+        if idx is None:
+            raise MXNetError("paged_kv: unknown seq_id %r" % (seq_id,))
+        lane = self._lanes[idx]
+        toks = np.asarray(tokens, np.int64).reshape(-1)
+        t = toks.shape[0]
+        if t < 1:
+            raise MXNetError("verify_chunk: need at least one token")
+        if lane.pos + t > self.pos_len:
+            raise MXNetError(
+                "paged_kv: seq %d verify positions %d..%d exceed the "
+                "trained position table (%d rows)"
+                % (seq_id, lane.pos, lane.pos + t - 1, self.pos_len))
+        prog = self._chunk_for(t)
+        rows = self._run_chunk(lane, toks, lane.pos, write=True,
+                               prog=prog)
+        lane.pos += t
+        if _tm.enabled():
+            _tm.gauge("serving.paged_pages_in_use").set(self.pool.in_use)
+        return rows
+
     # --------------------------------------------------------------- decode
     def step(self, tokens: Dict[int, object]):
         """One multiplexed decode dispatch: ``tokens`` maps seq_id -> next
@@ -898,7 +1356,7 @@ class PagedKVDecoder:
         self.warmup()
         if not tokens:
             return {}
-        B, S = self.lanes, self.max_len
+        B, S = self.lanes, self.total_slots
         data = np.zeros((B, 1), np.float32)
         pos_idx = np.zeros((B, 1), np.float32)
         oh = np.zeros((B, S), np.float32)
@@ -918,7 +1376,7 @@ class PagedKVDecoder:
             data[idx, 0] = float(np.asarray(tok).reshape(()))
             pos_idx[idx, 0] = lane.pos
             oh[idx, phys] = 1.0
-            mask[idx, lane.valid_slots] = 0.0
+            mask[idx, self._lane_slots(lane)] = 0.0
             mask[idx, phys] = 0.0
             stepped.append((seq_id, idx, lane, phys))
         exe = self._dec_exe
@@ -940,7 +1398,6 @@ class PagedKVDecoder:
                 exe.outputs[2 + 2 * i]._jax())
         out = {}
         for seq_id, idx, lane, phys in stepped:
-            lane.valid_slots.append(phys)
             lane.pos += 1
             out[seq_id] = logits[idx]
         if _tm.enabled():
@@ -970,7 +1427,7 @@ class PagedKVDecoder:
             raise MXNetError("step_megastep: K must be >= 1, got %d" % k)
         if not tokens:
             return {}
-        B, S = self.lanes, self.max_len
+        B, S = self.lanes, self.total_slots
         stepped = []
         for seq_id, tok in tokens.items():
             idx = self._seq_lane.get(seq_id)
@@ -998,7 +1455,7 @@ class PagedKVDecoder:
             tok0[idx] = int(np.asarray(tok).reshape(()))
             posv[idx] = lane.pos
             slots[idx] = phys[seq_id]
-            base_mask[idx, lane.valid_slots] = 0.0
+            base_mask[idx, self._lane_slots(lane)] = 0.0
             done0[idx] = False
         eos = np.int32(-1 if eos_id is None else int(eos_id))
         _gap_mark(self, "serving.paged_megastep")
@@ -1015,9 +1472,8 @@ class PagedKVDecoder:
         written = 0
         for seq_id, idx, lane, tok in stepped:
             # active steps form a prefix (done latches): exactly the
-            # steps whose KV write landed — only THOSE slots go valid
+            # steps whose KV write landed — only THOSE positions advance
             n_w = int(acts_h[:, idx].sum())
-            lane.valid_slots.extend(phys[seq_id][:n_w])
             lane.pos += n_w
             written += n_w
             out[seq_id] = ids[:, idx].astype(np.int64)

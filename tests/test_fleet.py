@@ -126,6 +126,76 @@ def test_load_aware_pick_prefers_lowest_wait(payload):
     assert fakes[1].served == 0
 
 
+def test_prefix_affinity_pins_key_to_rendezvous_replica(payload):
+    """Requests carrying a prefix_key land on the HRW-assigned replica
+    even when EWMA load-awareness would pick a lighter one — that is
+    where the prefix's KV pages live; plain requests are untouched."""
+    fakes = {i: FakeReplica(i, wait_ms=1.0 + 40.0 * i) for i in range(3)}
+    telemetry.reset()
+    telemetry.set_mode("counters")
+    try:
+        with make_router(fakes) as r:
+            _wait_fresh(r, 3)
+            key = "prefix-chain-abc123"
+            target = r._affinity_target(key)
+            futs = [r.submit(payload, prefix_key=key) for _ in range(8)]
+            for f in futs:
+                f.result(timeout=5)
+            assert fakes[target].served == 8
+            # the same key maps to the same replica, call after call
+            assert all(r._affinity_target(key) == target
+                       for _ in range(4))
+            c = telemetry.counters()
+            assert c.get("fleet.affinity_hits", 0) == 8
+            assert c.get("fleet.affinity_fallbacks", 0) == 0
+            # plain traffic still follows EWMA to the lightest replica
+            r.infer(payload, timeout=5)
+            assert fakes[0].served >= (1 if target != 0 else 9)
+    finally:
+        telemetry.set_mode(None)
+        telemetry.reset()
+
+
+def test_prefix_affinity_falls_back_when_target_unhealthy(payload):
+    """Health and freshness outrank page locality: latch the assigned
+    replica and the key's traffic reroutes through the load-aware pick,
+    counting fleet.affinity_fallbacks."""
+    fakes = {i: FakeReplica(i, wait_ms=1.0 + 10.0 * i) for i in range(3)}
+    telemetry.reset()
+    telemetry.set_mode("counters")
+    try:
+        with make_router(fakes) as r:
+            _wait_fresh(r, 3)
+            key = "prefix-chain-def456"
+            target = r._affinity_target(key)
+            fakes[target].state = "latched"
+            _wait_fresh(r, 3)
+            time.sleep(0.1)
+            for _ in range(5):
+                r.infer(payload, timeout=5, prefix_key=key)
+            assert fakes[target].served == 0
+            others = [f.served for rid, f in fakes.items() if rid != target]
+            assert sum(others) == 5
+            c = telemetry.counters()
+            assert c.get("fleet.affinity_fallbacks", 0) == 5
+    finally:
+        telemetry.set_mode(None)
+        telemetry.reset()
+
+
+def test_prefix_affinity_disabled_by_env(payload, monkeypatch):
+    """MXNET_FLEET_AFFINITY=0: prefix keys are ignored and dispatch is
+    pure EWMA — byte-for-byte the pre-affinity policy."""
+    monkeypatch.setenv("MXNET_FLEET_AFFINITY", "0")
+    fakes = {0: FakeReplica(0, wait_ms=2.0), 1: FakeReplica(1, wait_ms=80.0)}
+    with make_router(fakes) as r:
+        _wait_fresh(r, 2)
+        futs = [r.submit(payload, prefix_key="anything") for _ in range(6)]
+        for f in futs:
+            f.result(timeout=5)
+    assert fakes[0].served == 6 and fakes[1].served == 0
+
+
 def test_degraded_and_latched_skip(payload):
     fakes = {0: FakeReplica(0, wait_ms=1.0, state="degraded"),
              1: FakeReplica(1, wait_ms=90.0),

@@ -166,25 +166,42 @@ def test_serving_symbols_share_training_weight_names():
 # ----------------------------------------------------------- paged decode
 def test_page_pool_accounting_and_reuse():
     """Allocator unit contract (no device work): frames hand out LIFO
-    (non-contiguous physical placement is routine), release returns them,
-    the global budget caps acquisitions, and page_size must divide the
-    slot count."""
+    over ONE global frame space (non-contiguous physical placement is
+    routine), refcounts gate the free list, release re-stacks reversed
+    so re-acquisition replays placement, the budget caps distinct frames
+    in use, and page_size must divide the slot count."""
     from mxnet_tpu.serving.kv_decode import _PagePool, PagedKVExhausted
 
     pool = _PagePool(lanes=2, slots=16, page_size=4)
     assert pool.frames_per_lane == 4 and pool.budget == 8
-    a = [pool.acquire(0) for _ in range(4)]
-    assert sorted(a) == [0, 1, 2, 3] and pool.in_use == 4
+    a = [pool.acquire() for _ in range(8)]
+    assert sorted(a) == list(range(8)) and pool.in_use == 8
+    with pytest.raises(PagedKVExhausted, match="budget exhausted"):
+        pool.acquire()
+    # refcounted sharing: only the LAST holder frees the frame
+    f = a[0]
+    pool.incref(f)
+    assert pool.refcount(f) == 2
+    pool.release([f])
+    assert pool.refcount(f) == 1 and pool.in_use == 8
+    pool.release([f])
+    assert pool.refcount(f) == 0 and pool.in_use == 7
+    # deterministic placement: release re-stacks reversed, so a
+    # re-acquisition sequence replays the original frame order
+    x = a[3:6]
+    pool.release(x)
+    assert [pool.acquire() for _ in range(3)] == x
+    # a budget above the physical frame count exposes the free-list wall
+    wide = _PagePool(lanes=1, slots=16, page_size=4, budget=10)
+    for _ in range(4):
+        wide.acquire()
     with pytest.raises(PagedKVExhausted, match="no free page frame"):
-        pool.acquire(0)  # lane 0 exhausted; lane 1 still has frames
-    pool.release(0, a[:2])
-    b = pool.acquire(0)
-    assert b in a[:2] and pool.in_use == 3  # freed frames come back LIFO
+        wide.acquire()
     # global budget below the physical frame count gates admission
     tight = _PagePool(lanes=2, slots=16, page_size=4, budget=1)
-    tight.acquire(0)
+    tight.acquire()
     with pytest.raises(PagedKVExhausted, match="budget"):
-        tight.acquire(1)
+        tight.acquire()
     with pytest.raises(MXNetError, match="divide"):
         _PagePool(lanes=1, slots=10, page_size=4)
 

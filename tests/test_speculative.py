@@ -1,0 +1,133 @@
+"""Speculative decoding (mxnet_tpu/serving/speculative.py,
+docs/SERVING.md §Prefix cache & speculative decoding): the accept/
+rollback protocol is TOKEN-IDENTICAL to non-speculative greedy decode
+no matter how good or bad the draft is, full-accept rounds re-sync the
+draft, rejections release pages, and the steady state never compiles."""
+import numpy as np
+import pytest
+
+import mxnet_tpu as mx
+from mxnet_tpu import telemetry
+from mxnet_tpu.models import transformer as tfm
+from mxnet_tpu.serving import PagedKVDecoder, SpeculativeDecoder
+from mxnet_tpu.serving.speculative import spec_decode_enabled, spec_gamma
+
+CFG = dict(vocab_size=50, num_layers=2, num_heads=2, model_dim=32,
+           ffn_dim=64)
+SERVE = dict(max_len=32, page_size=4, lanes=1, prefill_len=8, pos_len=32,
+             prefix_cache=False)
+
+
+@pytest.fixture
+def tm():
+    telemetry.reset()
+    telemetry.clear_events()
+    saved = telemetry.current_override()
+    yield telemetry
+    telemetry.set_mode(saved)
+    telemetry.reset()
+    telemetry.clear_events()
+
+
+def _trained_params(S, seed=0):
+    net = tfm.get_symbol(seq_len=S, **CFG)
+    exe = net.simple_bind(mx.cpu(), grad_req="null", data=(1, S),
+                          softmax_label=(1, S))
+    rs = np.random.RandomState(seed)
+    params = {}
+    for name, arr in exe.arg_dict.items():
+        if name in ("data", "softmax_label"):
+            continue
+        w = (rs.randn(*arr.shape) * 0.1).astype("float32")
+        arr[:] = w
+        params[name] = w
+    return params
+
+
+def _want(params, prompt, n):
+    """Oracle: plain non-speculative greedy on the target alone."""
+    dec = PagedKVDecoder(params, **CFG, **SERVE)
+    return dec.greedy([prompt], n, k=1)[0]
+
+
+def test_env_knobs(monkeypatch):
+    monkeypatch.delenv("MXNET_SPEC_DECODE", raising=False)
+    monkeypatch.delenv("MXNET_SPEC_GAMMA", raising=False)
+    assert not spec_decode_enabled() and spec_gamma() == 4
+    monkeypatch.setenv("MXNET_SPEC_DECODE", "on")
+    monkeypatch.setenv("MXNET_SPEC_GAMMA", "7")
+    assert spec_decode_enabled() and spec_gamma() == 7
+    monkeypatch.setenv("MXNET_SPEC_GAMMA", "junk")
+    assert spec_gamma(3) == 3
+    monkeypatch.setenv("MXNET_SPEC_GAMMA", "-2")
+    assert spec_gamma(3) == 3
+
+
+def test_spec_greedy_token_identical_truncated_draft(tm):
+    """The ci parity bar: a 1-layer draft truncated from the 2-layer
+    target's own checkpoint (positional weight names) speculates, and
+    the emitted stream is token-identical to non-speculative greedy —
+    with zero post-warmup compiles or retraces."""
+    tm.set_mode("counters")
+    params = _trained_params(32)
+    rs = np.random.RandomState(7)
+    prompt = rs.randint(1, CFG["vocab_size"], (5,)).astype(np.float32)
+    want = _want(params, prompt, 18)
+
+    spec = SpeculativeDecoder.build(params, draft_layers=1, gamma=3,
+                                    **CFG, **SERVE).warmup()
+    c0 = telemetry.counters()
+    got = spec.greedy(prompt, 18)
+    c1 = telemetry.counters()
+    np.testing.assert_array_equal(got, want)
+    assert c1.get("spec.proposed_tokens", 0) > 0
+    assert c1.get("spec.accepted_tokens", 0) >= 0
+    assert c1.get("executor.compile", 0) == c0.get("executor.compile", 0)
+    assert c1.get("executor.retrace", 0) == c0.get("executor.retrace", 0)
+    # every page released: both decoders fully retired their lanes
+    assert spec.target.stats()["pages_in_use"] == 0
+    assert spec.draft.stats()["pages_in_use"] == 0
+
+
+def test_spec_full_accept_self_draft_resyncs(tm):
+    """Draft == target (draft_layers == num_layers): every proposal is
+    accepted, no round ever rolls back, and the catch-up step keeps the
+    pair position-aligned across rounds."""
+    tm.set_mode("counters")
+    params = _trained_params(32)
+    rs = np.random.RandomState(11)
+    prompt = rs.randint(1, CFG["vocab_size"], (4,)).astype(np.float32)
+    want = _want(params, prompt, 16)
+
+    spec = SpeculativeDecoder.build(params, draft_layers=CFG["num_layers"],
+                                    gamma=4, **CFG, **SERVE).warmup()
+    got = spec.greedy(prompt, 16)
+    c = telemetry.counters()
+    np.testing.assert_array_equal(got, want)
+    assert c.get("spec.accepted_tokens", 0) == c.get("spec.proposed_tokens")
+    assert c.get("spec.rollbacks", 0) == 0
+
+
+def test_spec_hostile_draft_still_token_identical(tm):
+    """Acceptance may hit ZERO (a draft with unrelated random weights):
+    rounds then emit exactly the target's own token, rollbacks release
+    the rejected pages, and the output is STILL token-identical — the
+    draft can only cost dispatches, never change the stream."""
+    tm.set_mode("counters")
+    params = _trained_params(32, seed=0)
+    hostile = _trained_params(32, seed=99)
+    rs = np.random.RandomState(13)
+    prompt = rs.randint(1, CFG["vocab_size"], (5,)).astype(np.float32)
+    want = _want(params, prompt, 14)
+
+    target = PagedKVDecoder(params, **CFG, **SERVE)
+    draft = PagedKVDecoder(hostile, model_key="spec_hostile_draft",
+                           **CFG, **SERVE)
+    spec = SpeculativeDecoder(target, draft, gamma=4).warmup()
+    got = spec.greedy(prompt, 14)
+    c = telemetry.counters()
+    np.testing.assert_array_equal(got, want)
+    assert c.get("spec.rollbacks", 0) >= 1
+    assert c.get("spec.accepted_tokens", 0) < c.get("spec.proposed_tokens")
+    assert target.stats()["pages_in_use"] == 0
+    assert draft.stats()["pages_in_use"] == 0

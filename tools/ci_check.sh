@@ -40,8 +40,10 @@
 # QPS/p99 under a tiny open-loop load with zero post-warmup retraces, for
 # both the bucketed engine and the transformer KV-cache decode path
 # including the K=8 decode-megastep leg (token-identical parity +
-# host-gap-per-token >=2x drop, docs/SERVING.md §Megasteps), plus the
-# serving CHAOS smoke (--chaos): deterministic
+# host-gap-per-token >=2x drop, docs/SERVING.md §Megasteps), the
+# shared-prefix cache + speculative-decoding smoke (--workload
+# zipf-prefix: hit rate, bitwise cached-vs-cold admits, spec-vs-greedy
+# token parity and p50), plus the serving CHAOS smoke (--chaos): deterministic
 # fault injection on the dispatch path + a mid-run hitless weight reload,
 # gated on zero hung futures, zero retraces, and recovery to `healthy`
 # (docs/RESILIENCE.md).
@@ -431,6 +433,17 @@ JAX_PLATFORMS=cpu MXNET_DEFAULT_CONTEXT=cpu \
 python tools/serve_bench.py --model transformer-decode --qps 16 \
     --duration 1 --rows 2 --megastep-k 8 --check \
     || { echo "serve_bench kv-decode smoke FAILED"; exit 1; }
+# shared-prefix cache + speculative decoding smoke (docs/SERVING.md
+# §Prefix cache & speculative decoding): zipf shared-prefix workload
+# against the COW paged pool, gated on chunk hit rate > 0.5, prefill
+# FLOPs saved > 0, BITWISE-identical cached-vs-cold admit logits,
+# speculative greedy token-identical to plain greedy with accepted-draft
+# rate > 0 and per-token p50 <= the non-speculative baseline, and zero
+# post-warmup retraces/compiles across both legs
+JAX_PLATFORMS=cpu MXNET_DEFAULT_CONTEXT=cpu \
+python tools/serve_bench.py --workload zipf-prefix --qps 20 \
+    --duration 2 --check \
+    || { echo "serve_bench prefix/speculative smoke FAILED"; exit 1; }
 # serving chaos smoke (docs/RESILIENCE.md): open-loop load with seeded
 # dispatch raises + delays injected (mxnet_tpu/faultinject.py) and one
 # mid-run hitless reload(); the gate asserts zero hung futures (every

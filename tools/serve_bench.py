@@ -27,6 +27,18 @@ the ``lax.scan`` megastep program (docs/SERVING.md §Megasteps), gated
 under ``--check`` on token-identical parity with single-step greedy AND
 ``host_gap_per_token`` at K ≤ 0.5× the K=1 baseline.
 
+``--workload zipf-prefix`` is the shared-prefix serving smoke
+(docs/SERVING.md §Prefix cache & speculative decoding): requests draw
+their prompt head from a small Zipf-distributed set of shared prefixes,
+measured once against a prefix-cache-off PagedKVDecoder baseline and
+once with the copy-on-write prefix cache on — reporting the chunk hit
+rate, prefill tokens/FLOPs saved, a bitwise cached-vs-cold admit
+subcheck, and the speculative-decoding leg (draft-verify megasteps,
+``--spec-gamma`` / ``--spec-draft-layers``): accepted-draft rate plus
+per-token p50/p99 against plain greedy, gated under ``--check`` on
+token-identical parity, hit rate > 0.5, accepted rate > 0, spec p50 <=
+baseline, and zero post-warmup retraces/compiles.
+
 ``--chaos`` is the serving resilience smoke (docs/RESILIENCE.md): the same
 open-loop load, but with deterministic fault injection live on the
 dispatch path (``serving.dispatch`` raise + delay plans,
@@ -399,6 +411,209 @@ def bench_decode(args):
     res["compiles_post_warmup"] = c_end.get("executor.compile", 0) \
         - c_warm.get("executor.compile", 0)
     return res
+
+
+def _decode_params(cfg, S, seed=0):
+    """Random transformer weights straight from the training graph's own
+    shapes (the decode/prefill/chunk programs bind the same names)."""
+    from mxnet_tpu.models import transformer as _tf
+    from mxnet_tpu import context as _ctx
+
+    probe = _tf.get_symbol(seq_len=S, **cfg).simple_bind(
+        _ctx.current_context(), grad_req="null", data=(1, S),
+        softmax_label=(1, S))
+    rs = np.random.RandomState(seed)
+    return {k: (rs.randn(*a.shape) * 0.1).astype("float32")
+            for k, a in probe.arg_dict.items()
+            if k not in ("data", "softmax_label")}
+
+
+def _zipf_prompts(rs, n_requests, vocab, prefixes, suffix_len, alpha):
+    """Shared-prefix workload: each request draws its prompt head from
+    ``prefixes`` with Zipf(alpha) popularity and appends a unique random
+    suffix — the distribution real multi-tenant serving sees (few hot
+    system prompts, long unique tails)."""
+    ranks = np.arange(1, len(prefixes) + 1, dtype=np.float64)
+    pz = ranks ** -float(alpha)
+    pz /= pz.sum()
+    picks = rs.choice(len(prefixes), size=n_requests, p=pz)
+    out = []
+    for i in picks:
+        sfx = rs.randint(1, vocab, (suffix_len,))
+        out.append(np.concatenate([prefixes[int(i)],
+                                   sfx]).astype("float32"))
+    return out
+
+
+def bench_prefix_spec(args):
+    """--workload zipf-prefix: the shared-prefix cache + speculative
+    decoding leg. One decoder with the prefix cache OFF is the latency
+    baseline; the same workload then replays against the COW prefix
+    cache, and a draft-verify SpeculativeDecoder races plain greedy."""
+    from mxnet_tpu import telemetry
+    from mxnet_tpu.serving import PagedKVDecoder, SpeculativeDecoder
+
+    cfg = dict(vocab_size=256, num_layers=2, num_heads=2, model_dim=64,
+               ffn_dim=128)
+    S = 64
+    params = _decode_params(cfg, S)
+    n_params = int(sum(int(np.prod(v.shape)) for v in params.values()))
+
+    C = 8                       # prefix chunk == page size
+    plen = 3 * C                # shared head: 3 cacheable chunks
+    suffix_len = C              # unique tail: 1 chunk per request
+    n_decode = 4                # decode tail per request
+    n_req = max(24, min(200, int(args.qps * args.duration)))
+    rs = np.random.RandomState(0)
+    prefixes = [rs.randint(1, cfg["vocab_size"], (plen,))
+                for _ in range(4)]
+    prompts = _zipf_prompts(rs, n_req, cfg["vocab_size"], prefixes,
+                            suffix_len, alpha=1.1)
+    serve = dict(max_len=S, page_size=C, lanes=4,
+                 prefill_len=plen + suffix_len, pos_len=S,
+                 cache_dir=args.cache_dir)
+
+    def _run_requests(dec, plist):
+        lat = []
+        for p in plist:
+            t0 = time.perf_counter()
+            sid, logits = dec.admit(p)
+            # graphlint: waive GL703 -- one argmax per admitted request
+            tok = int(np.argmax(logits))
+            for _ in range(n_decode):
+                # graphlint: waive GL702 -- the per-request decode tail IS the workload
+                out = dec.step({sid: tok})
+                # graphlint: waive GL703 -- bench workload loop, one id per step
+                tok = int(np.argmax(out[sid]))
+            dec.retire(sid)
+            lat.append((time.perf_counter() - t0) * 1000.0)
+        return lat
+
+    base = PagedKVDecoder(params, prefix_cache=False, **cfg,
+                          **serve).warmup()
+    cached = PagedKVDecoder(params, prefix_cache=True, prefix_chunk=C,
+                            **cfg, **serve).warmup()
+    # burn-in (one-time jax dispatch-path setup) with prompts ALIEN to
+    # the workload's prefixes, so the measured hit rate is untouched
+    alien = [np.concatenate([rs.randint(1, 256, (plen,)),
+                             rs.randint(1, 256, (suffix_len,))
+                             ]).astype("float32") for _ in range(2)]
+    _run_requests(base, alien[:1])
+    _run_requests(cached, alien[:1])
+    # bitwise cached-vs-cold: the SAME prompt admitted cold, retired,
+    # then admitted again off the cache must produce identical logits
+    sid, cold = cached.admit(alien[1])
+    cached.retire(sid)
+    sid, warm2 = cached.admit(alien[1])
+    cached.retire(sid)
+    bitwise = bool(np.array_equal(cold, warm2))
+
+    # build + warm the speculative pair BEFORE the compile snapshot:
+    # the zero-post-warmup gate below covers BOTH measured legs
+    g = max(1, int(args.spec_gamma))
+    dl = int(args.spec_draft_layers) or cfg["num_layers"]
+    sserve = dict(max_len=S, page_size=C, lanes=1, prefill_len=16,
+                  pos_len=S, prefix_cache=False,
+                  cache_dir=args.cache_dir)
+    spec = SpeculativeDecoder.build(params, draft_layers=dl, gamma=g,
+                                    **cfg, **sserve).warmup()
+    sbase = PagedKVDecoder(params, **cfg, **sserve).warmup()
+    n_tok = 24
+    sprompts = [rs.randint(1, cfg["vocab_size"], (8,)).astype("float32")
+                for _ in range(6)]
+    # parity subcheck doubles as the burn-in for both timed paths
+    parity = bool(np.array_equal(
+        spec.greedy(sprompts[0], n_tok),
+        sbase.greedy([sprompts[0]], n_tok, k=1)[0]))
+
+    c_warm = _counters()
+    t0 = time.perf_counter()
+    lat_base = _run_requests(base, prompts)
+    lat_cache = _run_requests(cached, prompts)
+    elapsed = time.perf_counter() - t0
+    c_mid = _counters()
+
+    hits = c_mid.get("serving.prefix_hits", 0) \
+        - c_warm.get("serving.prefix_hits", 0)
+    misses = c_mid.get("serving.prefix_misses", 0) \
+        - c_warm.get("serving.prefix_misses", 0)
+    saved = c_mid.get("serving.prefill_tokens_saved", 0) \
+        - c_warm.get("serving.prefill_tokens_saved", 0)
+    p50b, p99b = _percentiles(lat_base)
+    p50c, p99c = _percentiles(lat_cache)
+    prefix = {
+        "chunk_hits": hits,
+        "chunk_misses": misses,
+        "hit_rate": round(hits / (hits + misses), 4)
+        if hits + misses else 0.0,
+        "tokens_saved": saved,
+        # ~2 FLOPs per weight per token (matmul-dominated forward): the
+        # standard estimate, reported as such
+        "param_count": n_params,
+        "prefill_flops_saved": int(saved * 2 * n_params),
+        "pages_shared": c_mid.get("serving.pages_shared", 0)
+        - c_warm.get("serving.pages_shared", 0),
+        "cow_copies": c_mid.get("serving.cow_copies", 0)
+        - c_warm.get("serving.cow_copies", 0),
+        "evictions": c_mid.get("serving.prefix_evictions", 0)
+        - c_warm.get("serving.prefix_evictions", 0),
+        "p50_ms": round(p50c, 3), "p99_ms": round(p99c, 3),
+        "baseline_p50_ms": round(p50b, 3),
+        "baseline_p99_ms": round(p99b, 3),
+        "bitwise_cached_vs_cold": bitwise,
+        "cache": cached.stats().get("prefix_cache"),
+    }
+
+    # ---- speculative leg: draft proposes gamma tokens per round, the
+    # target scores all gamma+1 in one rectangular verify dispatch
+    c_sp0 = c_mid
+    sl_base, sl_spec = [], []
+    for p in sprompts:
+        t1 = time.perf_counter()
+        sbase.greedy([p], n_tok, k=1)
+        sl_base.append((time.perf_counter() - t1) * 1000.0 / n_tok)
+    for p in sprompts:
+        t1 = time.perf_counter()
+        spec.greedy(p, n_tok)
+        sl_spec.append((time.perf_counter() - t1) * 1000.0 / n_tok)
+    c_end = _counters()
+    proposed = c_end.get("spec.proposed_tokens", 0) \
+        - c_sp0.get("spec.proposed_tokens", 0)
+    accepted = c_end.get("spec.accepted_tokens", 0) \
+        - c_sp0.get("spec.accepted_tokens", 0)
+    sp50b, sp99b = _percentiles(sl_base)
+    sp50s, sp99s = _percentiles(sl_spec)
+    spec_res = {
+        "gamma": g, "draft_layers": dl,
+        "proposed_tokens": proposed, "accepted_tokens": accepted,
+        "accepted_rate": round(accepted / proposed, 4)
+        if proposed else 0.0,
+        "rollbacks": c_end.get("spec.rollbacks", 0)
+        - c_sp0.get("spec.rollbacks", 0),
+        "p50_ms_per_token": round(sp50s, 4),
+        "p99_ms_per_token": round(sp99s, 4),
+        "baseline_p50_ms_per_token": round(sp50b, 4),
+        "baseline_p99_ms_per_token": round(sp99b, 4),
+        "parity_token_identical": parity,
+    }
+    return {
+        "mode": "prefix_spec",
+        "model": "transformer-decode",
+        "workload": "zipf-prefix",
+        "requests": n_req,
+        "prefixes": len(prefixes),
+        "zipf_alpha": 1.1,
+        "prompt_len": plen + suffix_len,
+        "prefix_chunk": C,
+        "qps": round(n_req / elapsed, 2) if elapsed else 0.0,
+        "p50_ms": prefix["p50_ms"], "p99_ms": prefix["p99_ms"],
+        "prefix": prefix,
+        "spec": spec_res,
+        "retraces_post_warmup": c_end.get("executor.retrace", 0)
+        - c_warm.get("executor.retrace", 0),
+        "compiles_post_warmup": c_end.get("executor.compile", 0)
+        - c_warm.get("executor.compile", 0),
+    }
 
 
 def bench_chaos(args):
@@ -970,6 +1185,47 @@ def _check_chaos(res):
     return ok
 
 
+def _check_prefix_spec(res):
+    ok = True
+
+    def _fail(msg):
+        nonlocal ok
+        ok = False
+        sys.stderr.write("serve_bench --workload zipf-prefix --check "
+                         "FAILED: %s\n" % msg)
+
+    pre = res["prefix"]
+    if pre["hit_rate"] <= 0.5:
+        _fail("prefix chunk hit rate %.3f not > 0.5 under the zipf "
+              "workload (%d hits / %d misses)"
+              % (pre["hit_rate"], pre["chunk_hits"],
+                 pre["chunk_misses"]))
+    if pre["tokens_saved"] <= 0 or not pre["prefill_flops_saved"]:
+        _fail("no prefill work saved: tokens_saved=%r flops_saved=%r"
+              % (pre["tokens_saved"], pre["prefill_flops_saved"]))
+    if not pre["bitwise_cached_vs_cold"]:
+        _fail("cached admit logits are NOT bitwise identical to the "
+              "cold admit of the same prompt")
+    sp = res["spec"]
+    if not sp["parity_token_identical"]:
+        _fail("speculative greedy diverged from non-speculative greedy "
+              "(gamma=%d draft_layers=%d)" % (sp["gamma"],
+                                              sp["draft_layers"]))
+    if sp["accepted_rate"] <= 0.0:
+        _fail("accepted-draft rate %.3f not > 0 (%d proposed)"
+              % (sp["accepted_rate"], sp["proposed_tokens"]))
+    if sp["p50_ms_per_token"] > sp["baseline_p50_ms_per_token"]:
+        _fail("speculative p50 %.4f ms/token not <= plain-greedy "
+              "baseline %.4f ms/token"
+              % (sp["p50_ms_per_token"],
+                 sp["baseline_p50_ms_per_token"]))
+    if res["retraces_post_warmup"]:
+        _fail("post-warmup retraces: %d" % res["retraces_post_warmup"])
+    if res["compiles_post_warmup"]:
+        _fail("post-warmup compiles: %d" % res["compiles_post_warmup"])
+    return ok
+
+
 def _check(res, trace_families):
     ok = True
 
@@ -1042,6 +1298,20 @@ def main(argv=None):
     ap.add_argument("--quant", default=None, choices=[None, "off", "bf16",
                                                       "int8"],
                     help="sets MXNET_SERVE_QUANT for the run")
+    ap.add_argument("--workload", default="uniform",
+                    choices=["uniform", "zipf-prefix"],
+                    help="zipf-prefix: shared-prefix KV-cache + "
+                         "speculative-decoding leg (transformer decode; "
+                         "docs/SERVING.md §Prefix cache & speculative "
+                         "decoding)")
+    ap.add_argument("--spec-gamma", type=int, default=4,
+                    help="zipf-prefix: draft tokens per speculative "
+                         "round (MXNET_SPEC_GAMMA)")
+    ap.add_argument("--spec-draft-layers", type=int, default=0,
+                    help="zipf-prefix: layers truncated from the target "
+                         "checkpoint for the draft model; 0 = self-draft "
+                         "(draft == target, acceptance 1.0 — the "
+                         "amortization smoke)")
     ap.add_argument("--fleet", action="store_true",
                     help="fleet smoke (docs/SERVING.md §Fleet): N replica "
                          "processes behind the router under open-loop "
@@ -1093,6 +1363,8 @@ def main(argv=None):
             ap.error("--chaos drives the bucketed engine; pick an "
                      "ITEM_SHAPES model")
         res = bench_chaos(args)
+    elif args.workload == "zipf-prefix":
+        res = bench_prefix_spec(args)
     elif args.model == "transformer-decode":
         res = bench_decode(args)
     else:
@@ -1105,6 +1377,8 @@ def main(argv=None):
             ok = _check_fleet(res)
         elif args.chaos:
             ok = _check_chaos(res)
+        elif args.workload == "zipf-prefix":
+            ok = _check_prefix_spec(res)
         else:
             families = {e[0] for e in telemetry.drain_events()}
             ok = _check(res, families)
